@@ -1,0 +1,42 @@
+"""predictionio_tpu — a TPU-native machine-learning server framework.
+
+A ground-up re-design of the capabilities of Apache PredictionIO
+(reference: /root/reference, Scala/Spark) for TPU hardware:
+
+- Event Server: REST event collection into pluggable storage backends
+  (reference: data/src/main/scala/.../data/api/EventServer.scala).
+- DASE controller API: DataSource / Preparator / Algorithm(s) / Serving /
+  Evaluation, typed engine components
+  (reference: core/src/main/scala/.../controller/Engine.scala:83).
+- Training workflow: runs an engine's train pipeline on a JAX device mesh
+  (replacing Spark) and persists models
+  (reference: core/.../workflow/CoreWorkflow.scala:45).
+- Deployment server: loads trained models, answers prediction queries over
+  REST with pre-jitted predict functions
+  (reference: core/.../workflow/CreateServer.scala).
+- Evaluation/tuning workflow: grid-searches engine params against metrics
+  (reference: core/.../controller/MetricEvaluator.scala).
+- CLI (`pio`) orchestrating all of the above
+  (reference: tools/.../console/Console.scala).
+- Pluggable storage backends behind three repositories
+  (metadata / event data / model data)
+  (reference: data/.../storage/Storage.scala).
+
+Where the reference distributes work over Spark executors, this framework
+distributes over a `jax.sharding.Mesh` of TPU devices: pjit/shard_map with
+XLA collectives (psum, all_gather, all_to_all) replace shuffle/broadcast;
+host-side Arrow/NumPy batch loading replaces RDD reads.
+"""
+
+__version__ = "0.1.0"
+
+from predictionio_tpu.core.datamap import DataMap, PropertyMap
+from predictionio_tpu.core.event import Event, EventValidation
+
+__all__ = [
+    "DataMap",
+    "PropertyMap",
+    "Event",
+    "EventValidation",
+    "__version__",
+]

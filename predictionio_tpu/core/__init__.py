@@ -1,0 +1,1 @@
+"""Core event-data model: Event, DataMap, PropertyMap, aggregation."""

@@ -1,0 +1,1 @@
+"""Utility primitives: BiMap id indexing, JSON codecs, time helpers."""

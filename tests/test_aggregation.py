@@ -1,0 +1,156 @@
+"""Property aggregation tests: local fold + EventOp monoid.
+
+Modeled on LEventAggregatorSpec / PEventAggregatorSpec over the shared
+TestEvents fixture (reference: data/src/test/scala/.../storage/
+{LEventAggregatorSpec,PEventAggregatorSpec,TestEvents}.scala). The key
+extra property tested here: the EventOp monoid must agree with the
+ordered local fold under any partitioning/permutation of the events —
+that is what makes shard-parallel aggregation correct.
+"""
+
+import itertools
+import random
+from datetime import datetime, timedelta, timezone
+
+from predictionio_tpu.core.aggregation import (
+    EventOp,
+    aggregate_properties,
+    aggregate_properties_parallel,
+    aggregate_properties_single,
+)
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+
+
+def ev(name, entity, minutes, props=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=entity,
+        properties=DataMap(props or {}),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+def test_set_merge_last_wins():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", 0, {"a": 1, "b": 2}),
+            ev("$set", "u1", 10, {"b": 20, "c": 30}),
+        ]
+    )
+    assert pm.fields == {"a": 1, "b": 20, "c": 30}
+    assert pm.first_updated == T0
+    assert pm.last_updated == T0 + timedelta(minutes=10)
+
+
+def test_unset_removes_fields():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", 0, {"a": 1, "b": 2}),
+            ev("$unset", "u1", 5, {"a": None}),
+        ]
+    )
+    assert pm.fields == {"b": 2}
+
+
+def test_delete_then_nothing():
+    assert (
+        aggregate_properties_single(
+            [ev("$set", "u1", 0, {"a": 1}), ev("$delete", "u1", 5)]
+        )
+        is None
+    )
+
+
+def test_delete_then_set_again():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", 0, {"a": 1, "b": 2}),
+            ev("$delete", "u1", 5),
+            ev("$set", "u1", 10, {"c": 3}),
+        ]
+    )
+    assert pm.fields == {"c": 3}
+
+
+def test_non_special_events_ignored():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", 0, {"a": 1}),
+            ev("rate", "u1", 5, {"rating": 5}),
+        ]
+    )
+    assert pm.fields == {"a": 1}
+    assert pm.last_updated == T0  # rate event does not touch updated times
+    assert aggregate_properties_single([ev("rate", "u1", 5, {"r": 1})]) is None
+
+
+def test_group_by_entity_and_filter_deleted():
+    out = aggregate_properties(
+        [
+            ev("$set", "u1", 0, {"a": 1}),
+            ev("$set", "u2", 0, {"b": 2}),
+            ev("$delete", "u2", 1),
+            ev("rate", "u3", 0, {"r": 1}),
+        ]
+    )
+    assert set(out) == {"u1"}
+    assert out["u1"].fields == {"a": 1}
+
+
+EVENT_STREAM = [
+    ev("$set", "u1", 0, {"a": 1, "b": 2, "c": 3}),
+    ev("$unset", "u1", 4, {"b": None}),
+    ev("$set", "u1", 7, {"b": 22, "d": 4}),
+    ev("$delete", "u1", 9),
+    ev("$set", "u1", 11, {"e": 5}),
+    ev("$set", "u1", 13, {"a": 10}),
+    ev("$unset", "u1", 15, {"e": None}),
+    ev("rate", "u1", 16, {"ignored": 1}),
+    ev("$set", "u2", 2, {"x": 1}),
+    ev("$delete", "u3", 1),
+]
+
+
+def test_monoid_matches_local_fold_under_permutation():
+    expected = aggregate_properties(EVENT_STREAM)
+    rng = random.Random(0)
+    for _ in range(25):
+        shuffled = EVENT_STREAM[:]
+        rng.shuffle(shuffled)
+        # random partition into 3 shards
+        shards = [[], [], []]
+        for e in shuffled:
+            shards[rng.randrange(3)].append(e)
+        got = aggregate_properties_parallel(shards)
+        assert set(got) == set(expected)
+        for k in expected:
+            assert got[k].fields == expected[k].fields, k
+            assert got[k].first_updated == expected[k].first_updated
+            assert got[k].last_updated == expected[k].last_updated
+
+
+def test_monoid_associativity():
+    ops = [EventOp.from_event(e) for e in EVENT_STREAM if e.entity_id == "u1"]
+    # fold left vs fold right vs tree
+    left = ops[0]
+    for o in ops[1:]:
+        left = left + o
+    right = ops[-1]
+    for o in reversed(ops[:-1]):
+        right = o + right
+    assert left.to_property_map().fields == right.to_property_map().fields
+    for a, b, c in itertools.combinations(ops, 3):
+        assert ((a + b) + c).to_property_map() == (a + (b + c)).to_property_map() or (
+            ((a + b) + c).to_property_map().fields == (a + (b + c)).to_property_map().fields
+        )
+
+
+def test_unset_without_set_is_none():
+    assert EventOp.from_event(ev("$unset", "u1", 0, {"a": 1})).to_property_map() is None
+    assert EventOp.from_event(ev("$delete", "u1", 0)).to_property_map() is None
+    assert EventOp().to_property_map() is None

@@ -1,0 +1,39 @@
+"""BiMap / EntityIdIxMap tests (reference: BiMapSpec.scala)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.utils.bimap import BiMap, EntityIdIxMap
+
+
+def test_basic_bidirectional():
+    m = BiMap({"a": 1, "b": 2})
+    assert m["a"] == 1
+    assert m.inverse[2] == "b"
+    assert m.inverse.inverse is m
+    assert m.get("zz") is None
+    assert m.get_or_else("zz", 9) == 9
+    assert "a" in m and "zz" not in m
+    assert len(m) == 2
+
+
+def test_duplicate_values_rejected():
+    with pytest.raises(ValueError):
+        BiMap({"a": 1, "b": 1})
+
+
+def test_string_int_contiguous_and_deduped():
+    m = BiMap.string_int(["u3", "u1", "u3", "u2", "u1"])
+    assert sorted(m.to_dict().values()) == [0, 1, 2]
+    assert m["u3"] == 0  # first-seen order
+    assert m["u1"] == 1
+    assert m["u2"] == 2
+
+
+def test_entity_ix_map_vectorized():
+    ix = EntityIdIxMap.from_ids(["a", "b", "c"])
+    out = ix.to_index(["c", "a", "nope", "b"])
+    assert out.dtype == np.int32
+    assert out.tolist() == [2, 0, -1, 1]
+    assert ix.to_ids(np.array([0, 2])) == ["a", "c"]
+    assert len(ix) == 3 and "b" in ix

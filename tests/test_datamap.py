@@ -1,0 +1,130 @@
+"""DataMap/PropertyMap behavior tests.
+
+Modeled on the reference's DataMapSpec
+(reference: data/src/test/scala/.../storage/DataMapSpec.scala).
+"""
+
+import dataclasses
+from datetime import datetime, timezone
+
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap, DataMapError, PropertyMap
+
+
+@pytest.fixture
+def dm():
+    return DataMap(
+        {
+            "a": 1,
+            "b": "bee",
+            "c": [1, 2, 3],
+            "d": 4.5,
+            "e": None,
+            "f": True,
+        }
+    )
+
+
+def test_typed_get(dm):
+    assert dm.get("a", int) == 1
+    assert dm.get("b", str) == "bee"
+    assert dm.get("c", list) == [1, 2, 3]
+    assert dm.get("d", float) == 4.5
+    assert dm.get("f", bool) is True
+
+
+def test_int_promotes_to_float(dm):
+    assert dm.get("a", float) == 1.0
+
+
+def test_bool_is_not_int(dm):
+    with pytest.raises(DataMapError):
+        dm.get("f", int)
+
+
+def test_get_missing_raises(dm):
+    with pytest.raises(DataMapError):
+        dm.get("nope", int)
+
+
+def test_get_null_raises(dm):
+    # explicit JSON null behaves as absent (DataMap.scala:96-129)
+    with pytest.raises(DataMapError):
+        dm.get("e", int)
+    assert dm.get_opt("e", int) is None
+
+
+def test_get_opt_and_or_else(dm):
+    assert dm.get_opt("a", int) == 1
+    assert dm.get_opt("nope", int) is None
+    assert dm.get_or_else("nope", 7) == 7
+    assert dm.get_or_else("a", 7) == 1
+
+
+def test_wrong_type_raises(dm):
+    with pytest.raises(DataMapError):
+        dm.get("b", int)
+
+
+def test_get_list_typed(dm):
+    assert dm.get_list("c", int) == [1, 2, 3]
+    with pytest.raises(DataMapError):
+        dm.get_list("c", str)
+    assert dm.get_list_opt("nope", int) is None
+
+
+def test_merge_right_biased():
+    left = DataMap({"a": 1, "b": 2})
+    right = DataMap({"b": 20, "c": 30})
+    merged = left + right
+    assert merged.fields == {"a": 1, "b": 20, "c": 30}
+    # originals untouched (immutability)
+    assert left.fields == {"a": 1, "b": 2}
+
+
+def test_remove_keys():
+    m = DataMap({"a": 1, "b": 2, "c": 3})
+    assert (m - ["a", "c"]).fields == {"b": 2}
+    assert (m - ["nope"]).fields == m.fields
+
+
+def test_extract_dataclass():
+    @dataclasses.dataclass
+    class Q:
+        a: int
+        b: str
+        d: float | None = None
+        missing: str | None = None
+
+    q = DataMap({"a": 1, "b": "bee", "d": 4.5}).extract(Q)
+    assert q == Q(a=1, b="bee", d=4.5, missing=None)
+
+
+def test_extract_missing_required_raises():
+    @dataclasses.dataclass
+    class Q:
+        a: int
+        z: str
+
+    with pytest.raises(DataMapError):
+        DataMap({"a": 1}).extract(Q)
+
+
+def test_property_map_preserves_times_through_ops():
+    t0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+    t1 = datetime(2021, 1, 1, tzinfo=timezone.utc)
+    pm = PropertyMap({"a": 1, "b": 2}, t0, t1)
+    pm2 = pm + DataMap({"c": 3})
+    assert isinstance(pm2, PropertyMap)
+    assert pm2.first_updated == t0 and pm2.last_updated == t1
+    pm3 = pm - ["a"]
+    assert isinstance(pm3, PropertyMap)
+    assert pm3.fields == {"b": 2}
+
+
+def test_equality_and_mapping_protocol(dm):
+    assert dm == DataMap(dm.fields)
+    assert dict(dm)["a"] == 1
+    assert len(dm) == 6
+    assert "a" in dm and "zz" not in dm

@@ -1,0 +1,119 @@
+"""Event model + validation + JSON wire codec tests.
+
+Validation rules per reference Event.scala:113-143; wire format per
+EventJson4sSupport.scala.
+"""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event, EventValidation, EventValidationError
+from predictionio_tpu.core.json_codec import (
+    event_from_json,
+    event_to_json,
+    format_datetime,
+    parse_datetime,
+)
+
+
+def ok(**kw):
+    e = Event(**{"event": "rate", "entity_type": "user", "entity_id": "u1", **kw})
+    EventValidation.validate(e)
+    return e
+
+
+def bad(**kw):
+    with pytest.raises(EventValidationError):
+        ok(**kw)
+
+
+def test_minimal_valid_event():
+    e = ok()
+    assert e.event_time.tzinfo is not None  # normalized to aware UTC
+
+
+def test_empty_fields_rejected():
+    bad(event="")
+    bad(entity_type="")
+    bad(entity_id="")
+    bad(target_entity_type="", target_entity_id="i1")
+    bad(target_entity_type="item", target_entity_id="")
+
+
+def test_target_entity_must_be_paired():
+    bad(target_entity_type="item")
+    bad(target_entity_id="i1")
+    ok(target_entity_type="item", target_entity_id="i1")
+
+
+def test_special_events():
+    ok(event="$set", properties=DataMap({"a": 1}))
+    ok(event="$set")  # $set with empty properties is allowed
+    ok(event="$unset", properties=DataMap({"a": 1}))
+    bad(event="$unset")  # $unset requires non-empty properties
+    ok(event="$delete")
+
+
+def test_reserved_prefixes():
+    bad(event="$custom")
+    bad(event="pio_thing")
+    bad(entity_type="pio_user")
+    ok(entity_type="pio_pr")  # built-in entity type allowed
+    bad(target_entity_type="pio_x", target_entity_id="i")
+    ok(target_entity_type="pio_pr", target_entity_id="i")
+
+
+def test_special_event_cannot_have_target():
+    bad(event="$set", target_entity_type="item", target_entity_id="i1")
+
+
+def test_reserved_property_names():
+    bad(properties=DataMap({"pio_score": 1}))
+    bad(properties=DataMap({"$weird": 1}))
+    ok(properties=DataMap({"score": 1}))
+
+
+def test_datetime_roundtrip():
+    t = datetime(2004, 12, 13, 21, 39, 45, 618000, tzinfo=timezone.utc)
+    s = format_datetime(t)
+    assert s == "2004-12-13T21:39:45.618Z"
+    assert parse_datetime(s) == t
+    # offset form parses too
+    assert parse_datetime("2004-12-13T21:39:45.618-07:00").utcoffset().total_seconds() == -7 * 3600
+
+
+def test_json_roundtrip():
+    e = ok(
+        event="buy",
+        target_entity_type="item",
+        target_entity_id="i1",
+        properties=DataMap({"price": 9.99, "tags": ["x"]}),
+        event_time=datetime(2020, 5, 1, 12, 0, 0, 123000, tzinfo=timezone.utc),
+        tags=["t1", "t2"],
+        pr_id="pr-1",
+        creation_time=datetime(2020, 5, 1, 12, 0, 1, 456000, tzinfo=timezone.utc),
+        event_id="e-42",
+    )
+    j = event_to_json(e)
+    assert j["event"] == "buy"
+    assert j["entityType"] == "user"
+    assert j["eventTime"] == "2020-05-01T12:00:00.123Z"
+    e2 = event_from_json(j)
+    assert e2 == e
+
+
+def test_json_defaults_and_validation():
+    e = event_from_json({"event": "view", "entityType": "user", "entityId": "u9"})
+    assert e.properties.is_empty() and e.tags == []
+    with pytest.raises(EventValidationError):
+        event_from_json({"event": "view", "entityType": "user"})  # no entityId
+    with pytest.raises(EventValidationError):
+        event_from_json(
+            {"event": "$unset", "entityType": "user", "entityId": "u1", "properties": {}}
+        )
+    with pytest.raises(EventValidationError):
+        event_from_json(
+            {"event": "view", "entityType": "user", "entityId": "u1", "eventTime": "not-a-time"}
+        )
