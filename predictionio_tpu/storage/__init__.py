@@ -1,0 +1,41 @@
+"""Pluggable storage: registry, DAO interfaces, backends.
+
+Reference: data/src/main/scala/.../data/storage/ (abstraction) and
+storage/* modules (backends).
+"""
+
+from predictionio_tpu.storage.base import (
+    AccessKey,
+    AccessKeys,
+    App,
+    Apps,
+    BaseStorageClient,
+    Channel,
+    Channels,
+    EngineInstance,
+    EngineInstances,
+    EvaluationInstance,
+    EvaluationInstances,
+    EventFilter,
+    Events,
+    Model,
+    Models,
+    StorageClientConfig,
+)
+from predictionio_tpu.storage.registry import (
+    EVENT_DATA,
+    META_DATA,
+    MODEL_DATA,
+    Storage,
+    StorageError,
+    register_backend,
+)
+
+__all__ = [
+    "AccessKey", "AccessKeys", "App", "Apps", "BaseStorageClient",
+    "Channel", "Channels", "EngineInstance", "EngineInstances",
+    "EvaluationInstance", "EvaluationInstances", "EventFilter", "Events",
+    "Model", "Models", "StorageClientConfig",
+    "EVENT_DATA", "META_DATA", "MODEL_DATA",
+    "Storage", "StorageError", "register_backend",
+]

@@ -1,0 +1,248 @@
+"""Env-var driven storage registry and repository wiring.
+
+Parity with the reference Storage object
+(reference: data/src/main/scala/.../data/storage/Storage.scala:120-423):
+
+- Sources are declared as ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` plus
+  arbitrary ``PIO_STORAGE_SOURCES_<NAME>_<KEY>`` properties.
+- Repositories bind to sources via
+  ``PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}``.
+- Clients are created lazily and cached per source.
+
+Where the reference discovers DAO classes by reflected class name
+(Storage.scala:218-233, 279-328), this registry keeps an explicit
+``BACKENDS`` mapping of type name -> StorageClient factory — the
+idiomatic-Python equivalent (no classpath scanning), extensible via
+``register_backend``.
+
+When no env config is present at all, a self-contained default is used
+(sqlite metadata+events+models under $PIO_FS_BASEDIR or ~/.pio_store) so
+the framework works out of the box — the reference instead hard-fails
+(Storage.scala:166-177); the default serves its conf/pio-env.sh.template
+role.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Mapping
+
+from predictionio_tpu.storage.base import (
+    AccessKeys,
+    Apps,
+    BaseStorageClient,
+    Channels,
+    EngineInstances,
+    EvaluationInstances,
+    Events,
+    Models,
+    StorageClientConfig,
+)
+
+logger = logging.getLogger(__name__)
+
+EVENT_DATA = "EVENTDATA"
+META_DATA = "METADATA"
+MODEL_DATA = "MODELDATA"
+
+_SOURCES_PREFIX = "PIO_STORAGE_SOURCES"
+_REPOSITORIES_PREFIX = "PIO_STORAGE_REPOSITORIES"
+
+BackendFactory = Callable[[StorageClientConfig], BaseStorageClient]
+_BACKENDS: dict[str, BackendFactory] = {}
+_builtins_loaded = False
+
+
+class StorageError(RuntimeError):
+    """Misconfigured or unsupported storage (Storage.scala StorageException)."""
+
+
+def register_backend(type_name: str, factory: BackendFactory) -> None:
+    """Register a backend type (the plugin-registry replacement for the
+    reference's class-name reflection, Storage.scala:218-233)."""
+    _BACKENDS[type_name] = factory
+
+
+def _builtin_backends() -> None:
+    global _builtins_loaded
+    if _builtins_loaded:
+        return
+    _builtins_loaded = True
+    from predictionio_tpu.storage.localfs import LocalFSStorageClient
+    from predictionio_tpu.storage.memory import MemoryStorageClient
+    from predictionio_tpu.storage.sqlite import SQLiteStorageClient
+
+    _BACKENDS.setdefault("memory", MemoryStorageClient)
+    _BACKENDS.setdefault("sqlite", SQLiteStorageClient)
+    # "jdbc" maps to the embedded SQL backend so reference pio-env.sh files
+    # whose sources say TYPE=jdbc keep working.
+    _BACKENDS.setdefault("jdbc", SQLiteStorageClient)
+    _BACKENDS.setdefault("localfs", LocalFSStorageClient)
+
+
+class Storage:
+    """Lazily-constructed registry of storage clients + repository DAOs.
+
+    A ``Storage`` instance is the analogue of the reference's global
+    ``Storage`` object; instance-scoped here so tests can build isolated
+    registries. ``Storage.default()`` gives the process-wide one.
+    """
+
+    _default: "Storage | None" = None
+    _default_lock = threading.Lock()
+
+    def __init__(self, env: Mapping[str, str] | None = None):
+        self._env = dict(env if env is not None else os.environ)
+        self._clients: dict[str, BaseStorageClient] = {}
+        self._lock = threading.RLock()
+        self._sources = self._parse_sources()
+        self._repositories = self._parse_repositories()
+
+    # -- global default -----------------------------------------------------
+    @classmethod
+    def default(cls) -> "Storage":
+        with cls._default_lock:
+            if cls._default is None:
+                cls._default = Storage()
+            return cls._default
+
+    @classmethod
+    def reset_default(cls) -> None:
+        with cls._default_lock:
+            if cls._default is not None:
+                cls._default.close()
+            cls._default = None
+
+    # -- env parsing (Storage.scala:120-199) --------------------------------
+    def _parse_sources(self) -> dict[str, tuple[str, StorageClientConfig]]:
+        sources: dict[str, tuple[str, StorageClientConfig]] = {}
+        names = {
+            k.split("_")[3]
+            for k in self._env
+            if k.startswith(_SOURCES_PREFIX + "_") and len(k.split("_")) >= 5
+        }
+        for name in names:
+            type_key = f"{_SOURCES_PREFIX}_{name}_TYPE"
+            if type_key not in self._env:
+                logger.warning("Storage source %s has no TYPE; skipping", name)
+                continue
+            prefix = f"{_SOURCES_PREFIX}_{name}_"
+            props = {
+                k[len(prefix):]: v
+                for k, v in self._env.items()
+                if k.startswith(prefix) and k != type_key
+            }
+            sources[name] = (
+                self._env[type_key],
+                StorageClientConfig(
+                    parallel=props.pop("PARALLEL", "false").lower() == "true",
+                    test=props.pop("TEST", "false").lower() == "true",
+                    properties=props,
+                ),
+            )
+        return sources
+
+    def _parse_repositories(self) -> dict[str, str]:
+        repos: dict[str, str] = {}
+        for repo in (META_DATA, EVENT_DATA, MODEL_DATA):
+            source = self._env.get(f"{_REPOSITORIES_PREFIX}_{repo}_SOURCE")
+            if source:
+                repos[repo] = source
+        if not repos:
+            repos = self._default_repositories()
+        missing = [r for r in (META_DATA, EVENT_DATA, MODEL_DATA) if r not in repos]
+        if missing:
+            raise StorageError(
+                f"Repositories {missing} have no configured source. Set "
+                f"{_REPOSITORIES_PREFIX}_<REPO>_SOURCE and matching "
+                f"{_SOURCES_PREFIX}_<NAME>_TYPE environment variables."
+            )
+        return repos
+
+    def _default_repositories(self) -> dict[str, str]:
+        base = self._env.get(
+            "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
+        )
+        self._sources.setdefault(
+            "DEFAULT_SQLITE",
+            (
+                "sqlite",
+                StorageClientConfig(
+                    properties={"PATH": os.path.join(base, "pio.sqlite")}
+                ),
+            ),
+        )
+        self._sources.setdefault(
+            "DEFAULT_LOCALFS",
+            (
+                "localfs",
+                StorageClientConfig(properties={"PATH": os.path.join(base, "models")}),
+            ),
+        )
+        return {
+            META_DATA: "DEFAULT_SQLITE",
+            EVENT_DATA: "DEFAULT_SQLITE",
+            MODEL_DATA: "DEFAULT_LOCALFS",
+        }
+
+    # -- client construction (Storage.scala:201-276) ------------------------
+    def client_for_source(self, source_name: str) -> BaseStorageClient:
+        with self._lock:
+            if source_name in self._clients:
+                return self._clients[source_name]
+            if source_name not in self._sources:
+                raise StorageError(f"Undefined storage source: {source_name}")
+            type_name, config = self._sources[source_name]
+            _builtin_backends()
+            if type_name not in _BACKENDS:
+                raise StorageError(
+                    f"Storage type {type_name!r} is not registered "
+                    f"(available: {sorted(_BACKENDS)})"
+                )
+            client = _BACKENDS[type_name](config)
+            self._clients[source_name] = client
+            return client
+
+    def _repo_client(self, repo: str) -> BaseStorageClient:
+        return self.client_for_source(self._repositories[repo])
+
+    # -- repository accessors (Storage.scala:370-423) -----------------------
+    def get_events(self) -> Events:
+        return self._repo_client(EVENT_DATA).events()
+
+    def get_meta_data_apps(self) -> Apps:
+        return self._repo_client(META_DATA).apps()
+
+    def get_meta_data_access_keys(self) -> AccessKeys:
+        return self._repo_client(META_DATA).access_keys()
+
+    def get_meta_data_channels(self) -> Channels:
+        return self._repo_client(META_DATA).channels()
+
+    def get_meta_data_engine_instances(self) -> EngineInstances:
+        return self._repo_client(META_DATA).engine_instances()
+
+    def get_meta_data_evaluation_instances(self) -> EvaluationInstances:
+        return self._repo_client(META_DATA).evaluation_instances()
+
+    def get_model_data_models(self) -> Models:
+        return self._repo_client(MODEL_DATA).models()
+
+    # -- verification (Storage.scala:341-363) -------------------------------
+    def verify_all_data_objects(self) -> None:
+        """Touch every repository DAO; used by `pio status`."""
+        self.get_meta_data_apps()
+        self.get_meta_data_access_keys()
+        self.get_meta_data_channels()
+        self.get_meta_data_engine_instances()
+        self.get_meta_data_evaluation_instances()
+        self.get_model_data_models()
+        self.get_events()
+
+    def close(self) -> None:
+        with self._lock:
+            for client in self._clients.values():
+                client.close()
+            self._clients.clear()

@@ -1,0 +1,782 @@
+"""Embedded SQL storage backend (sqlite3) — the "jdbc" analogue.
+
+Mirrors the reference's JDBC backend design
+(reference: storage/jdbc/src/main/scala/.../jdbc/{StorageClient,JDBCLEvents,
+JDBCPEvents,JDBCUtils,JDBCApps,JDBCAccessKeys,JDBCChannels,
+JDBCEngineInstances,JDBCEvaluationInstances,JDBCModels}.scala): one event
+table per (app, channel) named ``pio_event_<app>[_<channel>]``
+(JDBCUtils.eventTableName), metadata tables ``pio_meta_*``, model blobs in
+``pio_model_data``. Implemented on Python's stdlib sqlite3 with WAL mode;
+serves as both the embedded default store and the conformance model for
+external SQL backends.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import uuid
+from datetime import datetime
+from typing import Iterator
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.core.json_codec import format_datetime, parse_datetime
+from predictionio_tpu.storage import base
+from predictionio_tpu.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    EventFilter,
+    Model,
+    StorageClientConfig,
+)
+
+
+def event_table_name(app_id: int, channel_id: int | None) -> str:
+    """Parity: JDBCUtils.eventTableName."""
+    suffix = f"_{channel_id}" if channel_id is not None else ""
+    return f"pio_event_{app_id}{suffix}"
+
+
+class _Connection:
+    """One sqlite connection per thread over a shared db file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._local = threading.local()
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # :memory: must share one connection across threads
+        self._memory_conn: sqlite3.Connection | None = None
+        self._memory_lock = threading.RLock()
+        self._closed = False
+        self._all_conns: list[sqlite3.Connection] = []
+        self._all_conns_lock = threading.Lock()
+        if path == ":memory:":
+            self._memory_conn = sqlite3.connect(":memory:", check_same_thread=False)
+
+    def get(self) -> tuple[sqlite3.Connection, threading.RLock | None]:
+        if self._closed:
+            raise sqlite3.ProgrammingError("storage connection is closed")
+        if self._memory_conn is not None:
+            return self._memory_conn, self._memory_lock
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            # check_same_thread=False so close() can reap it from another
+            # thread; each connection is still only *used* by its own thread.
+            conn = sqlite3.connect(self.path, timeout=30.0, check_same_thread=False)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+            with self._all_conns_lock:
+                self._all_conns.append(conn)
+        return conn, None
+
+    def execute(self, sql: str, params: tuple = ()) -> list[tuple]:
+        conn, lock = self.get()
+        if lock:
+            with lock:
+                cur = conn.execute(sql, params)
+                rows = cur.fetchall()
+                conn.commit()
+                return rows
+        cur = conn.execute(sql, params)
+        rows = cur.fetchall()
+        conn.commit()
+        return rows
+
+    def executemany(self, sql: str, seq: list[tuple]) -> None:
+        conn, lock = self.get()
+        if lock:
+            with lock:
+                conn.executemany(sql, seq)
+                conn.commit()
+            return
+        conn.executemany(sql, seq)
+        conn.commit()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._memory_conn is not None:
+            self._memory_conn.close()
+            self._memory_conn = None
+        with self._all_conns_lock:
+            for conn in self._all_conns:
+                try:
+                    conn.close()
+                except sqlite3.ProgrammingError:
+                    pass  # connection created by a thread that already exited
+            self._all_conns.clear()
+        self._local = threading.local()
+
+
+def _is_no_table(err: sqlite3.OperationalError) -> bool:
+    return "no such table" in str(err)
+
+
+_EVENT_COLUMNS = (
+    "id, event, entityType, entityId, targetEntityType, targetEntityId, "
+    "properties, eventTime, tags, prId, creationTime"
+)
+
+
+def _fmt_utc(t: datetime) -> str:
+    """Store times normalized to UTC so the TEXT column sorts by instant."""
+    from datetime import timezone
+
+    return format_datetime(t.astimezone(timezone.utc))
+
+
+def _event_to_row(event_id: str, e: Event) -> tuple:
+    return (
+        event_id,
+        e.event,
+        e.entity_type,
+        e.entity_id,
+        e.target_entity_type,
+        e.target_entity_id,
+        json.dumps(e.properties.to_json()),
+        _fmt_utc(e.event_time),
+        json.dumps(list(e.tags)),
+        e.pr_id,
+        _fmt_utc(e.creation_time),
+    )
+
+
+def _row_to_event(row: tuple) -> Event:
+    return Event(
+        event_id=row[0],
+        event=row[1],
+        entity_type=row[2],
+        entity_id=row[3],
+        target_entity_type=row[4],
+        target_entity_id=row[5],
+        properties=DataMap.from_json(json.loads(row[6])),
+        event_time=parse_datetime(row[7]),
+        tags=tuple(json.loads(row[8])),
+        pr_id=row[9],
+        creation_time=parse_datetime(row[10]),
+    )
+
+
+class SQLiteEvents(base.Events):
+    """Event DAO on sqlite. Parity: JDBCLEvents.scala:37-289."""
+
+    def __init__(self, conn: _Connection):
+        self._conn = conn
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        t = event_table_name(app_id, channel_id)
+        self._conn.execute(
+            f"""CREATE TABLE IF NOT EXISTS {t} (
+                id TEXT NOT NULL PRIMARY KEY,
+                event TEXT NOT NULL,
+                entityType TEXT NOT NULL,
+                entityId TEXT NOT NULL,
+                targetEntityType TEXT,
+                targetEntityId TEXT,
+                properties TEXT,
+                eventTime TEXT NOT NULL,
+                tags TEXT,
+                prId TEXT,
+                creationTime TEXT NOT NULL)"""
+        )
+        # entity-clustered time-ordered access path, the role the HBase
+        # backend gives its rowkey design (HBEventsUtil.scala:84-131)
+        self._conn.execute(
+            f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t} "
+            "(entityType, entityId, eventTime)"
+        )
+        self._conn.execute(
+            f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (eventTime)"
+        )
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        self._conn.execute(f"DROP TABLE IF EXISTS {event_table_name(app_id, channel_id)}")
+        return True
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        event_id = event.event_id or uuid.uuid4().hex
+        t = event_table_name(app_id, channel_id)
+        sql = (
+            f"INSERT OR REPLACE INTO {t} ({_EVENT_COLUMNS}) "
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?)"
+        )
+        row = _event_to_row(event_id, event)
+        try:
+            self._conn.execute(sql, row)
+        except sqlite3.OperationalError as err:
+            if not _is_no_table(err):
+                raise
+            # auto-init on first insert: same contract as the memory backend
+            self.init(app_id, channel_id)
+            self._conn.execute(sql, row)
+        return event_id
+
+    def insert_batch(
+        self, events, app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        ids = [e.event_id or uuid.uuid4().hex for e in events]
+        t = event_table_name(app_id, channel_id)
+        sql = (
+            f"INSERT OR REPLACE INTO {t} ({_EVENT_COLUMNS}) "
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?)"
+        )
+        rows = [_event_to_row(i, e) for i, e in zip(ids, events)]
+        try:
+            self._conn.executemany(sql, rows)
+        except sqlite3.OperationalError as err:
+            if not _is_no_table(err):
+                raise
+            self.init(app_id, channel_id)
+            self._conn.executemany(sql, rows)
+        return ids
+
+    def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None:
+        t = event_table_name(app_id, channel_id)
+        try:
+            rows = self._conn.execute(
+                f"SELECT {_EVENT_COLUMNS} FROM {t} WHERE id = ?", (event_id,)
+            )
+        except sqlite3.OperationalError as err:
+            if _is_no_table(err):
+                return None
+            raise
+        return _row_to_event(rows[0]) if rows else None
+
+    def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
+        t = event_table_name(app_id, channel_id)
+        try:
+            existed = bool(
+                self._conn.execute(f"SELECT 1 FROM {t} WHERE id = ?", (event_id,))
+            )
+            self._conn.execute(f"DELETE FROM {t} WHERE id = ?", (event_id,))
+        except sqlite3.OperationalError as err:
+            if _is_no_table(err):
+                return False
+            raise
+        return existed
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter = EventFilter(),
+    ) -> Iterator[Event]:
+        """WHERE-clause assembly parity: JDBCPEvents.find:33-120."""
+        t = event_table_name(app_id, channel_id)
+        clauses, params = [], []
+        f = filter
+        if f.start_time is not None:
+            clauses.append("eventTime >= ?")
+            params.append(_fmt_utc(f.start_time))
+        if f.until_time is not None:
+            clauses.append("eventTime < ?")
+            params.append(_fmt_utc(f.until_time))
+        if f.entity_type is not None:
+            clauses.append("entityType = ?")
+            params.append(f.entity_type)
+        if f.entity_id is not None:
+            clauses.append("entityId = ?")
+            params.append(f.entity_id)
+        if f.event_names is not None:
+            placeholders = ",".join("?" * len(f.event_names))
+            clauses.append(f"event IN ({placeholders})")
+            params.extend(f.event_names)
+        if f.target_entity_type is not ...:
+            if f.target_entity_type is None:
+                clauses.append("targetEntityType IS NULL")
+            else:
+                clauses.append("targetEntityType = ?")
+                params.append(f.target_entity_type)
+        if f.target_entity_id is not ...:
+            if f.target_entity_id is None:
+                clauses.append("targetEntityId IS NULL")
+            else:
+                clauses.append("targetEntityId = ?")
+                params.append(f.target_entity_id)
+        where = f" WHERE {' AND '.join(clauses)}" if clauses else ""
+        order = " ORDER BY eventTime DESC" if f.reversed else " ORDER BY eventTime"
+        limit = (
+            f" LIMIT {int(f.limit)}" if f.limit is not None and f.limit >= 0 else ""
+        )
+        try:
+            rows = self._conn.execute(
+                f"SELECT {_EVENT_COLUMNS} FROM {t}{where}{order}{limit}", tuple(params)
+            )
+        except sqlite3.OperationalError as err:
+            if _is_no_table(err):
+                return iter(())
+            raise
+        return (_row_to_event(r) for r in rows)
+
+
+class SQLiteApps(base.Apps):
+    def __init__(self, conn: _Connection):
+        self._conn = conn
+        self._conn.execute(
+            """CREATE TABLE IF NOT EXISTS pio_meta_apps (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT NOT NULL UNIQUE,
+                description TEXT)"""
+        )
+
+    def insert(self, app: App) -> int | None:
+        try:
+            if app.id > 0:
+                self._conn.execute(
+                    "INSERT INTO pio_meta_apps (id, name, description) VALUES (?,?,?)",
+                    (app.id, app.name, app.description),
+                )
+                return app.id
+            self._conn.execute(
+                "INSERT INTO pio_meta_apps (name, description) VALUES (?,?)",
+                (app.name, app.description),
+            )
+            rows = self._conn.execute(
+                "SELECT id FROM pio_meta_apps WHERE name = ?", (app.name,)
+            )
+            return int(rows[0][0])
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, app_id: int) -> App | None:
+        rows = self._conn.execute(
+            "SELECT id, name, description FROM pio_meta_apps WHERE id = ?", (app_id,)
+        )
+        return App(*rows[0]) if rows else None
+
+    def get_by_name(self, name: str) -> App | None:
+        rows = self._conn.execute(
+            "SELECT id, name, description FROM pio_meta_apps WHERE name = ?", (name,)
+        )
+        return App(*rows[0]) if rows else None
+
+    def get_all(self) -> list[App]:
+        return [
+            App(*r)
+            for r in self._conn.execute(
+                "SELECT id, name, description FROM pio_meta_apps ORDER BY id"
+            )
+        ]
+
+    def update(self, app: App) -> None:
+        self._conn.execute(
+            "UPDATE pio_meta_apps SET name = ?, description = ? WHERE id = ?",
+            (app.name, app.description, app.id),
+        )
+
+    def delete(self, app_id: int) -> None:
+        self._conn.execute("DELETE FROM pio_meta_apps WHERE id = ?", (app_id,))
+
+
+class SQLiteAccessKeys(base.AccessKeys):
+    def __init__(self, conn: _Connection):
+        self._conn = conn
+        self._conn.execute(
+            """CREATE TABLE IF NOT EXISTS pio_meta_accesskeys (
+                accesskey TEXT NOT NULL PRIMARY KEY,
+                appid INTEGER NOT NULL,
+                events TEXT)"""
+        )
+
+    def insert(self, access_key: AccessKey) -> str | None:
+        key = access_key.key or self.generate_key()
+        try:
+            self._conn.execute(
+                "INSERT INTO pio_meta_accesskeys (accesskey, appid, events) VALUES (?,?,?)",
+                (key, access_key.appid, json.dumps(list(access_key.events))),
+            )
+            return key
+        except sqlite3.IntegrityError:
+            return None
+
+    def _row(self, r: tuple) -> AccessKey:
+        return AccessKey(r[0], r[1], tuple(json.loads(r[2] or "[]")))
+
+    def get(self, key: str) -> AccessKey | None:
+        rows = self._conn.execute(
+            "SELECT accesskey, appid, events FROM pio_meta_accesskeys WHERE accesskey = ?",
+            (key,),
+        )
+        return self._row(rows[0]) if rows else None
+
+    def get_all(self) -> list[AccessKey]:
+        return [
+            self._row(r)
+            for r in self._conn.execute(
+                "SELECT accesskey, appid, events FROM pio_meta_accesskeys"
+            )
+        ]
+
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
+        return [
+            self._row(r)
+            for r in self._conn.execute(
+                "SELECT accesskey, appid, events FROM pio_meta_accesskeys WHERE appid = ?",
+                (app_id,),
+            )
+        ]
+
+    def update(self, access_key: AccessKey) -> None:
+        self._conn.execute(
+            "UPDATE pio_meta_accesskeys SET appid = ?, events = ? WHERE accesskey = ?",
+            (access_key.appid, json.dumps(list(access_key.events)), access_key.key),
+        )
+
+    def delete(self, key: str) -> None:
+        self._conn.execute(
+            "DELETE FROM pio_meta_accesskeys WHERE accesskey = ?", (key,)
+        )
+
+
+class SQLiteChannels(base.Channels):
+    def __init__(self, conn: _Connection):
+        self._conn = conn
+        self._conn.execute(
+            """CREATE TABLE IF NOT EXISTS pio_meta_channels (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT NOT NULL,
+                appid INTEGER NOT NULL)"""
+        )
+
+    def insert(self, channel: Channel) -> int | None:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        try:
+            if channel.id > 0:
+                self._conn.execute(
+                    "INSERT INTO pio_meta_channels (id, name, appid) VALUES (?,?,?)",
+                    (channel.id, channel.name, channel.appid),
+                )
+                return channel.id
+            self._conn.execute(
+                "INSERT INTO pio_meta_channels (name, appid) VALUES (?,?)",
+                (channel.name, channel.appid),
+            )
+        except sqlite3.IntegrityError:
+            return None
+        rows = self._conn.execute("SELECT last_insert_rowid()")
+        return int(rows[0][0])
+
+    def get(self, channel_id: int) -> Channel | None:
+        rows = self._conn.execute(
+            "SELECT id, name, appid FROM pio_meta_channels WHERE id = ?", (channel_id,)
+        )
+        return Channel(*rows[0]) if rows else None
+
+    def get_by_app_id(self, app_id: int) -> list[Channel]:
+        return [
+            Channel(*r)
+            for r in self._conn.execute(
+                "SELECT id, name, appid FROM pio_meta_channels WHERE appid = ?",
+                (app_id,),
+            )
+        ]
+
+    def delete(self, channel_id: int) -> None:
+        self._conn.execute("DELETE FROM pio_meta_channels WHERE id = ?", (channel_id,))
+
+
+class SQLiteEngineInstances(base.EngineInstances):
+    def __init__(self, conn: _Connection):
+        self._conn = conn
+        self._conn.execute(
+            """CREATE TABLE IF NOT EXISTS pio_meta_engineinstances (
+                id TEXT NOT NULL PRIMARY KEY,
+                status TEXT NOT NULL,
+                startTime TEXT NOT NULL,
+                completionTime TEXT NOT NULL,
+                engineId TEXT NOT NULL,
+                engineVersion TEXT NOT NULL,
+                engineVariant TEXT NOT NULL,
+                engineFactory TEXT NOT NULL,
+                batch TEXT,
+                env TEXT,
+                meshConf TEXT,
+                dataSourceParams TEXT,
+                preparatorParams TEXT,
+                algorithmsParams TEXT,
+                servingParams TEXT)"""
+        )
+
+    _COLS = (
+        "id, status, startTime, completionTime, engineId, engineVersion, "
+        "engineVariant, engineFactory, batch, env, meshConf, dataSourceParams, "
+        "preparatorParams, algorithmsParams, servingParams"
+    )
+
+    def _to_row(self, i: EngineInstance) -> tuple:
+        return (
+            i.id,
+            i.status,
+            _fmt_utc(i.start_time),
+            _fmt_utc(i.completion_time),
+            i.engine_id,
+            i.engine_version,
+            i.engine_variant,
+            i.engine_factory,
+            i.batch,
+            json.dumps(i.env),
+            json.dumps(i.mesh_conf),
+            i.data_source_params,
+            i.preparator_params,
+            i.algorithms_params,
+            i.serving_params,
+        )
+
+    def _from_row(self, r: tuple) -> EngineInstance:
+        return EngineInstance(
+            id=r[0],
+            status=r[1],
+            start_time=parse_datetime(r[2]),
+            completion_time=parse_datetime(r[3]),
+            engine_id=r[4],
+            engine_version=r[5],
+            engine_variant=r[6],
+            engine_factory=r[7],
+            batch=r[8] or "",
+            env=json.loads(r[9] or "{}"),
+            mesh_conf=json.loads(r[10] or "{}"),
+            data_source_params=r[11] or "",
+            preparator_params=r[12] or "",
+            algorithms_params=r[13] or "",
+            serving_params=r[14] or "",
+        )
+
+    def insert(self, instance: EngineInstance) -> str:
+        import dataclasses as _dc
+
+        instance_id = instance.id or uuid.uuid4().hex
+        if not instance.id:
+            instance = _dc.replace(instance, id=instance_id)
+        self._conn.execute(
+            f"INSERT OR REPLACE INTO pio_meta_engineinstances ({self._COLS}) "
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            self._to_row(instance),
+        )
+        return instance_id
+
+    def get(self, instance_id: str) -> EngineInstance | None:
+        rows = self._conn.execute(
+            f"SELECT {self._COLS} FROM pio_meta_engineinstances WHERE id = ?",
+            (instance_id,),
+        )
+        return self._from_row(rows[0]) if rows else None
+
+    def get_all(self) -> list[EngineInstance]:
+        return [
+            self._from_row(r)
+            for r in self._conn.execute(
+                f"SELECT {self._COLS} FROM pio_meta_engineinstances"
+            )
+        ]
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]:
+        return [
+            self._from_row(r)
+            for r in self._conn.execute(
+                f"SELECT {self._COLS} FROM pio_meta_engineinstances "
+                "WHERE status = 'COMPLETED' AND engineId = ? AND "
+                "engineVersion = ? AND engineVariant = ? ORDER BY startTime DESC",
+                (engine_id, engine_version, engine_variant),
+            )
+        ]
+
+    def update(self, instance: EngineInstance) -> None:
+        self.insert(instance)
+
+    def delete(self, instance_id: str) -> None:
+        self._conn.execute(
+            "DELETE FROM pio_meta_engineinstances WHERE id = ?", (instance_id,)
+        )
+
+
+class SQLiteEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, conn: _Connection):
+        self._conn = conn
+        self._conn.execute(
+            """CREATE TABLE IF NOT EXISTS pio_meta_evaluationinstances (
+                id TEXT NOT NULL PRIMARY KEY,
+                status TEXT NOT NULL,
+                startTime TEXT NOT NULL,
+                completionTime TEXT NOT NULL,
+                evaluationClass TEXT,
+                engineParamsGeneratorClass TEXT,
+                batch TEXT,
+                env TEXT,
+                meshConf TEXT,
+                evaluatorResults TEXT,
+                evaluatorResultsHTML TEXT,
+                evaluatorResultsJSON TEXT)"""
+        )
+
+    _COLS = (
+        "id, status, startTime, completionTime, evaluationClass, "
+        "engineParamsGeneratorClass, batch, env, meshConf, evaluatorResults, "
+        "evaluatorResultsHTML, evaluatorResultsJSON"
+    )
+
+    def _to_row(self, i: EvaluationInstance) -> tuple:
+        return (
+            i.id,
+            i.status,
+            _fmt_utc(i.start_time),
+            _fmt_utc(i.completion_time),
+            i.evaluation_class,
+            i.engine_params_generator_class,
+            i.batch,
+            json.dumps(i.env),
+            json.dumps(i.mesh_conf),
+            i.evaluator_results,
+            i.evaluator_results_html,
+            i.evaluator_results_json,
+        )
+
+    def _from_row(self, r: tuple) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=r[0],
+            status=r[1],
+            start_time=parse_datetime(r[2]),
+            completion_time=parse_datetime(r[3]),
+            evaluation_class=r[4] or "",
+            engine_params_generator_class=r[5] or "",
+            batch=r[6] or "",
+            env=json.loads(r[7] or "{}"),
+            mesh_conf=json.loads(r[8] or "{}"),
+            evaluator_results=r[9] or "",
+            evaluator_results_html=r[10] or "",
+            evaluator_results_json=r[11] or "",
+        )
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        import dataclasses as _dc
+
+        instance_id = instance.id or uuid.uuid4().hex
+        if not instance.id:
+            instance = _dc.replace(instance, id=instance_id)
+        self._conn.execute(
+            f"INSERT OR REPLACE INTO pio_meta_evaluationinstances ({self._COLS}) "
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+            self._to_row(instance),
+        )
+        return instance_id
+
+    def get(self, instance_id: str) -> EvaluationInstance | None:
+        rows = self._conn.execute(
+            f"SELECT {self._COLS} FROM pio_meta_evaluationinstances WHERE id = ?",
+            (instance_id,),
+        )
+        return self._from_row(rows[0]) if rows else None
+
+    def get_all(self) -> list[EvaluationInstance]:
+        return [
+            self._from_row(r)
+            for r in self._conn.execute(
+                f"SELECT {self._COLS} FROM pio_meta_evaluationinstances"
+            )
+        ]
+
+    def get_completed(self) -> list[EvaluationInstance]:
+        return [
+            self._from_row(r)
+            for r in self._conn.execute(
+                f"SELECT {self._COLS} FROM pio_meta_evaluationinstances "
+                "WHERE status = 'EVALCOMPLETED' ORDER BY startTime DESC"
+            )
+        ]
+
+    def update(self, instance: EvaluationInstance) -> None:
+        self.insert(instance)
+
+    def delete(self, instance_id: str) -> None:
+        self._conn.execute(
+            "DELETE FROM pio_meta_evaluationinstances WHERE id = ?", (instance_id,)
+        )
+
+
+class SQLiteModels(base.Models):
+    """Model blobs in SQL. Parity: JDBCModels.scala."""
+
+    def __init__(self, conn: _Connection):
+        self._conn = conn
+        self._conn.execute(
+            """CREATE TABLE IF NOT EXISTS pio_model_data (
+                id TEXT NOT NULL PRIMARY KEY,
+                models BLOB NOT NULL)"""
+        )
+
+    def insert(self, model: Model) -> None:
+        self._conn.execute(
+            "INSERT OR REPLACE INTO pio_model_data (id, models) VALUES (?,?)",
+            (model.id, model.models),
+        )
+
+    def get(self, model_id: str) -> Model | None:
+        rows = self._conn.execute(
+            "SELECT id, models FROM pio_model_data WHERE id = ?", (model_id,)
+        )
+        return Model(rows[0][0], bytes(rows[0][1])) if rows else None
+
+    def delete(self, model_id: str) -> None:
+        self._conn.execute("DELETE FROM pio_model_data WHERE id = ?", (model_id,))
+
+
+class SQLiteStorageClient(base.BaseStorageClient):
+    """All three repositories on one sqlite database file.
+
+    Config properties: PATH (db file; default pio.sqlite in cwd, or
+    ":memory:" for tests). Parity role: storage/jdbc StorageClient.scala.
+    """
+
+    prefix = "SQLite"
+
+    def __init__(self, config: StorageClientConfig = StorageClientConfig()):
+        super().__init__(config)
+        path = config.properties.get("PATH", "pio.sqlite")
+        if config.test and "PATH" not in config.properties:
+            path = ":memory:"
+        self._conn = _Connection(path)
+        self._lock = threading.RLock()
+        self._cache: dict[str, object] = {}
+
+    def _cached(self, key: str, factory):
+        with self._lock:
+            if key not in self._cache:
+                self._cache[key] = factory(self._conn)
+            return self._cache[key]
+
+    def events(self) -> SQLiteEvents:
+        return self._cached("events", SQLiteEvents)
+
+    def apps(self) -> SQLiteApps:
+        return self._cached("apps", SQLiteApps)
+
+    def access_keys(self) -> SQLiteAccessKeys:
+        return self._cached("access_keys", SQLiteAccessKeys)
+
+    def channels(self) -> SQLiteChannels:
+        return self._cached("channels", SQLiteChannels)
+
+    def engine_instances(self) -> SQLiteEngineInstances:
+        return self._cached("engine_instances", SQLiteEngineInstances)
+
+    def evaluation_instances(self) -> SQLiteEvaluationInstances:
+        return self._cached("evaluation_instances", SQLiteEvaluationInstances)
+
+    def models(self) -> SQLiteModels:
+        return self._cached("models", SQLiteModels)
+
+    def close(self) -> None:
+        self._conn.close()

@@ -1,0 +1,370 @@
+"""Storage-backend conformance suite.
+
+One spec, every backend — the reference runs the same LEventsSpec /
+PEventsSpec against each live store (reference: storage/jdbc/src/test/...,
+storage/hbase/src/test/...; SURVEY.md §4.2). Parameterized here over the
+in-memory and sqlite backends (and sqlite-on-disk via tmp_path).
+"""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    EventFilter,
+    Model,
+    StorageClientConfig,
+)
+from predictionio_tpu.storage.memory import MemoryStorageClient
+from predictionio_tpu.storage.sqlite import SQLiteStorageClient
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+
+
+@pytest.fixture(params=["memory", "sqlite", "sqlite_file"])
+def client(request, tmp_path):
+    if request.param == "memory":
+        c = MemoryStorageClient()
+    elif request.param == "sqlite":
+        c = SQLiteStorageClient(StorageClientConfig(test=True))
+    else:
+        c = SQLiteStorageClient(
+            StorageClientConfig(properties={"PATH": str(tmp_path / "pio.sqlite")})
+        )
+    yield c
+    c.close()
+
+
+def ev(name="rate", entity="u1", minutes=0, target=None, props=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=entity,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Events
+# ---------------------------------------------------------------------------
+
+class TestEvents:
+    def test_insert_get_delete_roundtrip(self, client):
+        events = client.events()
+        events.init(1)
+        e = ev(props={"rating": 4.5, "note": "good"}, target="i1")
+        eid = events.insert(e, 1)
+        got = events.get(eid, 1)
+        assert got.event_id == eid
+        assert got.properties.fields == {"rating": 4.5, "note": "good"}
+        assert got.event_time == e.event_time
+        assert got.target_entity_id == "i1"
+        assert events.delete(eid, 1) is True
+        assert events.delete(eid, 1) is False
+        assert events.get(eid, 1) is None
+
+    def test_channel_isolation(self, client):
+        events = client.events()
+        events.init(1)
+        events.init(1, 5)
+        eid = events.insert(ev(), 1, 5)
+        assert events.get(eid, 1) is None
+        assert events.get(eid, 1, 5) is not None
+        assert list(events.find(1)) == []
+        assert len(list(events.find(1, 5))) == 1
+
+    def test_app_isolation(self, client):
+        events = client.events()
+        events.init(1)
+        events.init(2)
+        events.insert(ev(), 1)
+        assert list(events.find(2)) == []
+
+    def test_find_filters(self, client):
+        events = client.events()
+        events.init(1)
+        events.insert_batch(
+            [
+                ev("rate", "u1", 0, target="i1"),
+                ev("rate", "u1", 10, target="i2"),
+                ev("buy", "u1", 20, target="i2"),
+                ev("rate", "u2", 30, target="i3"),
+                ev("$set", "u1", 40, props={"a": 1}),
+            ],
+            1,
+        )
+        f = lambda **kw: list(events.find(1, None, EventFilter(**kw)))
+        assert len(f()) == 5
+        assert len(f(entity_id="u1")) == 4
+        assert len(f(event_names=["rate"])) == 3
+        assert len(f(event_names=["rate", "buy"])) == 4
+        assert len(f(start_time=T0 + timedelta(minutes=10))) == 4
+        assert len(f(until_time=T0 + timedelta(minutes=10))) == 1
+        assert (
+            len(f(start_time=T0 + timedelta(minutes=10), until_time=T0 + timedelta(minutes=30)))
+            == 2
+        )
+        assert len(f(target_entity_id="i2")) == 2
+        assert len(f(target_entity_type=None)) == 1  # only the $set
+        assert len(f(entity_type="user")) == 5
+        assert len(f(entity_type="other")) == 0
+
+    def test_find_order_limit_reversed(self, client):
+        events = client.events()
+        events.init(1)
+        events.insert_batch([ev(minutes=m) for m in (30, 10, 20)], 1)
+        times = [e.event_time for e in events.find(1)]
+        assert times == sorted(times)
+        newest = list(events.find(1, None, EventFilter(limit=1, reversed=True)))
+        assert newest[0].event_time == T0 + timedelta(minutes=30)
+        two = list(events.find(1, None, EventFilter(limit=2)))
+        assert len(two) == 2
+
+    def test_aggregate_properties(self, client):
+        events = client.events()
+        events.init(1)
+        events.insert_batch(
+            [
+                Event(
+                    event="$set", entity_type="user", entity_id="u1",
+                    properties=DataMap({"a": 1, "b": 2}), event_time=T0,
+                ),
+                Event(
+                    event="$unset", entity_type="user", entity_id="u1",
+                    properties=DataMap({"b": None}),
+                    event_time=T0 + timedelta(minutes=1),
+                ),
+                Event(
+                    event="$set", entity_type="user", entity_id="u2",
+                    properties=DataMap({"c": 3}), event_time=T0,
+                ),
+                Event(
+                    event="$delete", entity_type="user", entity_id="u2",
+                    event_time=T0 + timedelta(minutes=1),
+                ),
+                Event(
+                    event="$set", entity_type="item", entity_id="i1",
+                    properties=DataMap({"x": 9}), event_time=T0,
+                ),
+            ],
+            1,
+        )
+        out = events.aggregate_properties(1, "user")
+        assert set(out) == {"u1"}
+        assert out["u1"].fields == {"a": 1}
+        # required-fields filter (LEvents.scala:246-252)
+        assert events.aggregate_properties(1, "user", required=["missing"]) == {}
+
+    def test_find_single_entity_latest(self, client):
+        events = client.events()
+        events.init(1)
+        events.insert_batch([ev("view", "u1", m, target=f"i{m}") for m in range(5)], 1)
+        got = list(
+            events.find_single_entity(1, "user", "u1", event_names=["view"], limit=2)
+        )
+        assert [e.target_entity_id for e in got] == ["i4", "i3"]
+
+    def test_remove_drops_data(self, client):
+        events = client.events()
+        events.init(1)
+        events.insert(ev(), 1)
+        events.remove(1)
+        assert list(events.find(1)) == []
+
+
+# ---------------------------------------------------------------------------
+# Metadata DAOs
+# ---------------------------------------------------------------------------
+
+class TestApps:
+    def test_crud(self, client):
+        apps = client.apps()
+        app_id = apps.insert(App(0, "myapp", "desc"))
+        assert app_id is not None and app_id > 0
+        assert apps.get(app_id).name == "myapp"
+        assert apps.get_by_name("myapp").id == app_id
+        assert apps.insert(App(0, "myapp")) is None  # duplicate name
+        apps.update(App(app_id, "renamed", None))
+        assert apps.get_by_name("renamed") is not None
+        id2 = apps.insert(App(0, "two"))
+        assert id2 != app_id
+        assert [a.id for a in apps.get_all()] == sorted([app_id, id2])
+        apps.delete(app_id)
+        assert apps.get(app_id) is None
+
+
+class TestAccessKeys:
+    def test_crud_and_generation(self, client):
+        apps = client.apps()
+        keys = client.access_keys()
+        app_id = apps.insert(App(0, "a"))
+        k = keys.insert(AccessKey("", app_id, ()))
+        assert k and len(k) >= 32
+        assert keys.get(k).appid == app_id
+        k2 = keys.insert(AccessKey("explicit-key", app_id, ("rate", "buy")))
+        assert k2 == "explicit-key"
+        assert set(keys.get(k2).events) == {"rate", "buy"}
+        assert keys.insert(AccessKey("explicit-key", app_id)) is None  # dup
+        assert {a.key for a in keys.get_by_app_id(app_id)} == {k, k2}
+        keys.update(AccessKey(k2, app_id, ("view",)))
+        assert list(keys.get(k2).events) == ["view"]
+        keys.delete(k)
+        assert keys.get(k) is None
+
+
+class TestChannels:
+    def test_crud_and_name_validation(self, client):
+        channels = client.channels()
+        cid = channels.insert(Channel(0, "ch-1", 7))
+        assert cid > 0
+        assert channels.get(cid).name == "ch-1"
+        assert channels.insert(Channel(0, "bad name!", 7)) is None
+        assert channels.insert(Channel(0, "x" * 17, 7)) is None
+        cid2 = channels.insert(Channel(0, "ch-2", 7))
+        assert {c.id for c in channels.get_by_app_id(7)} == {cid, cid2}
+        channels.delete(cid)
+        assert channels.get(cid) is None
+
+
+def make_instance(status="INIT", start=T0, variant="v1"):
+    return EngineInstance(
+        id="",
+        status=status,
+        start_time=start,
+        completion_time=start,
+        engine_id="eng",
+        engine_version="1",
+        engine_variant=variant,
+        engine_factory="my.Factory",
+        env={"K": "v"},
+        mesh_conf={"mesh": [2, 4]},
+        algorithms_params='[{"name":"als"}]',
+    )
+
+
+class TestEngineInstances:
+    def test_crud_and_latest_completed(self, client):
+        insts = client.engine_instances()
+        i1 = insts.insert(make_instance("COMPLETED", T0))
+        i2 = insts.insert(make_instance("COMPLETED", T0 + timedelta(hours=1)))
+        insts.insert(make_instance("INIT", T0 + timedelta(hours=2)))
+        insts.insert(make_instance("COMPLETED", T0 + timedelta(hours=3), variant="v2"))
+        got = insts.get(i1)
+        assert got.env == {"K": "v"} and got.mesh_conf == {"mesh": [2, 4]}
+        latest = insts.get_latest_completed("eng", "1", "v1")
+        assert latest.id == i2
+        assert len(insts.get_completed("eng", "1", "v1")) == 2
+        import dataclasses
+
+        insts.update(dataclasses.replace(got, status="FAILED"))
+        assert insts.get(i1).status == "FAILED"
+        insts.delete(i1)
+        assert insts.get(i1) is None
+        assert len(insts.get_all()) == 3
+
+    def test_latest_completed_none(self, client):
+        assert client.engine_instances().get_latest_completed("x", "y", "z") is None
+
+
+class TestEvaluationInstances:
+    def test_crud(self, client):
+        insts = client.evaluation_instances()
+        iid = insts.insert(
+            EvaluationInstance(
+                id="", status="INIT", start_time=T0, completion_time=T0,
+                evaluation_class="my.Eval", evaluator_results="one-liner",
+            )
+        )
+        got = insts.get(iid)
+        assert got.evaluation_class == "my.Eval"
+        import dataclasses
+
+        insts.update(dataclasses.replace(got, status="EVALCOMPLETED"))
+        assert [i.id for i in insts.get_completed()] == [iid]
+        insts.delete(iid)
+        assert insts.get(iid) is None
+
+
+class TestModels:
+    def test_roundtrip(self, client):
+        models = client.models()
+        blob = bytes(range(256)) * 10
+        models.insert(Model("m1", blob))
+        assert models.get("m1").models == blob
+        models.insert(Model("m1", b"replaced"))
+        assert models.get("m1").models == b"replaced"
+        models.delete("m1")
+        assert models.get("m1") is None
+        assert models.get("never") is None
+
+
+# ---------------------------------------------------------------------------
+# Regression tests for review findings
+# ---------------------------------------------------------------------------
+
+class TestReviewRegressions:
+    def test_naive_datetime_filter_consistent(self, client):
+        """Naive filter bounds are interpreted as UTC on every backend."""
+        events = client.events()
+        events.init(1)
+        events.insert(ev(minutes=0), 1)
+        events.insert(ev(minutes=60), 1)
+        naive = datetime(2020, 1, 1, 0, 30)  # no tzinfo
+        got = list(events.find(1, None, EventFilter(start_time=naive)))
+        assert len(got) == 1
+
+    def test_insert_auto_inits_table(self, client):
+        """insert without init() works identically on all backends."""
+        events = client.events()
+        eid = events.insert(ev(), 42)
+        assert events.get(eid, 42) is not None
+        ids = events.insert_batch([ev(minutes=1), ev(minutes=2)], 43)
+        assert len(list(events.find(43))) == 2
+        assert len(ids) == 2
+
+    def test_channel_duplicate_id_returns_none(self, client):
+        channels = client.channels()
+        assert channels.insert(Channel(5, "a", 1)) == 5
+        assert channels.insert(Channel(5, "b", 1)) is None
+
+
+def test_register_backend_keeps_builtins(tmp_path):
+    """Registering a plugin backend must not disable builtins."""
+    from predictionio_tpu.storage import register_backend
+    from predictionio_tpu.storage.memory import MemoryStorageClient
+    from predictionio_tpu.storage.registry import Storage
+
+    register_backend("custom-test", MemoryStorageClient)
+    env = {
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    }
+    Storage(env).verify_all_data_objects()
+
+
+def test_engine_instance_mixed_offset_ordering(client):
+    """Latest-completed must compare instants, not offset strings."""
+    from datetime import timedelta, timezone as tz
+
+    insts = client.engine_instances()
+    # A at 12:00Z; B at 23:00+14:00 == 09:00Z (earlier instant, later string)
+    a = insts.insert(make_instance("COMPLETED", T0.replace(hour=12)))
+    insts.insert(
+        make_instance(
+            "COMPLETED",
+            T0.replace(hour=23, tzinfo=tz(timedelta(hours=14))),
+        )
+    )
+    assert insts.get_latest_completed("eng", "1", "v1").id == a

@@ -1,0 +1,90 @@
+"""Storage registry env parsing + repository wiring tests
+(reference behavior: Storage.scala:120-199, 341-363)."""
+
+import pytest
+
+from predictionio_tpu.storage.base import App, Model
+from predictionio_tpu.storage.registry import Storage, StorageError
+
+
+def test_env_parsing_and_wiring(tmp_path):
+    env = {
+        "PIO_STORAGE_SOURCES_MYSQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_MYSQL_PATH": str(tmp_path / "db.sqlite"),
+        "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "models"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "pio_meta",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MYSQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "pio_event",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MYSQL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "pio_model",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+    }
+    storage = Storage(env)
+    storage.verify_all_data_objects()
+    app_id = storage.get_meta_data_apps().insert(App(0, "app"))
+    assert storage.get_meta_data_apps().get(app_id).name == "app"
+    storage.get_model_data_models().insert(Model("m", b"x"))
+    assert (tmp_path / "models").exists()
+    # clients are cached per source
+    assert storage.client_for_source("MYSQL") is storage.client_for_source("MYSQL")
+    storage.close()
+
+
+def test_missing_source_raises():
+    env = {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NOPE",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NOPE",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NOPE",
+    }
+    storage = Storage(env)
+    with pytest.raises(StorageError):
+        storage.get_meta_data_apps()
+
+
+def test_partial_repositories_raises(tmp_path):
+    env = {
+        "PIO_STORAGE_SOURCES_A_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "A",
+    }
+    with pytest.raises(StorageError):
+        Storage(env)
+
+
+def test_unknown_type_raises():
+    env = {
+        "PIO_STORAGE_SOURCES_A_TYPE": "martian",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "A",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "A",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "A",
+    }
+    storage = Storage(env)
+    with pytest.raises(StorageError):
+        storage.get_events()
+
+
+def test_default_config_when_env_empty(tmp_path):
+    storage = Storage({"PIO_FS_BASEDIR": str(tmp_path)})
+    storage.verify_all_data_objects()
+    storage.get_events().init(1)
+    eid = None
+    from predictionio_tpu.core.event import Event
+
+    eid = storage.get_events().insert(
+        Event(event="x", entity_type="user", entity_id="u"), 1
+    )
+    assert storage.get_events().get(eid, 1) is not None
+    assert (tmp_path / "pio.sqlite").exists()
+    storage.close()
+
+
+def test_memory_backend_registration():
+    env = {
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    }
+    storage = Storage(env)
+    storage.verify_all_data_objects()
+    storage.close()
