@@ -1,0 +1,9 @@
+"""Event Server REST API, stats, webhooks, plugins.
+
+Reference: data/src/main/scala/.../data/api/ (EventServer.scala, Stats.scala,
+Webhooks.scala, EventServerPlugin.scala).
+"""
+
+from predictionio_tpu.api.event_server import EventServer, EventServerConfig, EventService
+
+__all__ = ["EventServer", "EventServerConfig", "EventService"]

@@ -1,0 +1,474 @@
+"""The Event Server: REST event collection on :7070.
+
+Route and status-code parity with the reference
+(reference: data/src/main/scala/.../data/api/EventServer.scala):
+
+- ``GET /``                      alive check (:148-155)
+- ``GET /plugins.json``          plugin listing (:157-177)
+- ``GET|DELETE /events/{id}.json``  single event (:210-259)
+- ``POST /events.json``          insert, 201 + eventId (:261-299)
+- ``GET /events.json``           filtered query, default limit 20 (:300-375)
+- ``POST /batch/events.json``    ≤50 events, per-event statuses (:376-460)
+- ``GET /stats.json``            hourly stats when enabled (:463-489)
+- ``POST|GET /webhooks/{site}.json|.form``  connectors (:491-592)
+
+Auth (:88-131): ``accessKey`` query param, else HTTP Basic user part;
+``channel`` query param selects a named channel. Event-name whitelists on
+access keys are enforced (403).
+
+Architecture: ``EventService`` is transport-free request logic (the
+spray-route equivalent, testable like spray-testkit specs);
+``EventServer`` adapts it onto a stdlib ThreadingHTTPServer — the
+reference's spray/Akka HTTP stack maps to plain threaded HTTP since the
+serving plane carries no TPU compute.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Mapping
+from urllib.parse import parse_qs, urlparse
+
+from predictionio_tpu.api.plugins import EventInfo, EventServerPluginContext
+from predictionio_tpu.api.stats import StatsKeeper
+from predictionio_tpu.api.webhooks import (
+    FORM_CONNECTORS,
+    JSON_CONNECTORS,
+    ConnectorError,
+    connector_to_event,
+)
+from predictionio_tpu.core.event import EventValidationError
+from predictionio_tpu.core.json_codec import (
+    event_from_json,
+    event_to_json,
+    parse_datetime,
+)
+from predictionio_tpu.storage.base import EventFilter
+from predictionio_tpu.storage.registry import Storage
+
+logger = logging.getLogger(__name__)
+
+#: Parity: MaxNumberOfEventsPerBatchRequest (EventServer.scala:51).
+MAX_EVENTS_PER_BATCH = 50
+
+
+@dataclasses.dataclass(frozen=True)
+class EventServerConfig:
+    """Parity: EventServerConfig (EventServer.scala:626-630)."""
+    ip: str = "0.0.0.0"
+    port: int = 7070
+    plugins: str = "plugins"
+    stats: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class AuthData:
+    """Parity: AuthData (EventServer.scala:88)."""
+    app_id: int
+    channel_id: int | None
+    events: tuple[str, ...]
+
+
+class _Reject(Exception):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        self.message = message
+
+
+Response = tuple[int, Any]  # (HTTP status, JSON-serializable body)
+
+
+class EventService:
+    """Transport-free event-server request logic."""
+
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        config: EventServerConfig = EventServerConfig(),
+        plugin_context: EventServerPluginContext | None = None,
+    ):
+        self.storage = storage or Storage.default()
+        self.config = config
+        self.events = self.storage.get_events()
+        self.access_keys = self.storage.get_meta_data_access_keys()
+        self.channels = self.storage.get_meta_data_channels()
+        self.plugin_context = plugin_context or EventServerPluginContext()
+        self.stats = StatsKeeper() if config.stats else None
+
+    # -- auth (EventServer.scala:92-131) ------------------------------------
+    def authenticate(
+        self, params: Mapping[str, str], headers: Mapping[str, str]
+    ) -> AuthData:
+        key = params.get("accessKey")
+        if not key:
+            auth = headers.get("Authorization", "")
+            if auth.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(auth[len("Basic "):]).decode()
+                    key = decoded.strip().split(":")[0]
+                except Exception:
+                    raise _Reject(401, "Invalid accessKey.")
+        if not key:
+            raise _Reject(401, "Missing accessKey.")
+        access_key = self.access_keys.get(key)
+        if access_key is None:
+            raise _Reject(401, "Invalid accessKey.")
+        channel_id: int | None = None
+        channel_name = params.get("channel")
+        if channel_name:
+            channel_map = {
+                c.name: c.id for c in self.channels.get_by_app_id(access_key.appid)
+            }
+            if channel_name not in channel_map:
+                raise _Reject(401, f"Invalid channel '{channel_name}'.")
+            channel_id = channel_map[channel_name]
+        return AuthData(access_key.appid, channel_id, tuple(access_key.events))
+
+    # -- route handlers ------------------------------------------------------
+    def alive(self) -> Response:
+        return 200, {"status": "alive"}
+
+    def plugins_json(self) -> Response:
+        return 200, self.plugin_context.describe()
+
+    def post_event(
+        self, params: Mapping[str, str], headers: Mapping[str, str], body: Any
+    ) -> Response:
+        auth = self.authenticate(params, headers)
+        if not isinstance(body, Mapping):
+            return 400, {"message": "request body must be a JSON object"}
+        try:
+            event = event_from_json(body)
+        except EventValidationError as exc:
+            return 400, {"message": str(exc)}
+        if auth.events and event.event not in auth.events:
+            return 403, {"message": f"{event.event} events are not allowed"}
+        try:
+            self.plugin_context.run_blockers(
+                EventInfo(auth.app_id, auth.channel_id, event)
+            )
+        except Exception as exc:
+            return 403, {"message": str(exc)}
+        event_id = self.events.insert(event, auth.app_id, auth.channel_id)
+        self.plugin_context.notify_sniffers(
+            EventInfo(auth.app_id, auth.channel_id, event)
+        )
+        if self.stats:
+            self.stats.update(auth.app_id, 201, event)
+        return 201, {"eventId": event_id}
+
+    def get_event(
+        self, event_id: str, params: Mapping[str, str], headers: Mapping[str, str]
+    ) -> Response:
+        auth = self.authenticate(params, headers)
+        event = self.events.get(event_id, auth.app_id, auth.channel_id)
+        if event is None:
+            return 404, {"message": "Not Found"}
+        return 200, event_to_json(event)
+
+    def delete_event(
+        self, event_id: str, params: Mapping[str, str], headers: Mapping[str, str]
+    ) -> Response:
+        auth = self.authenticate(params, headers)
+        found = self.events.delete(event_id, auth.app_id, auth.channel_id)
+        if found:
+            return 200, {"message": "Found"}
+        return 404, {"message": "Not Found"}
+
+    def get_events(
+        self, params: Mapping[str, str], headers: Mapping[str, str]
+    ) -> Response:
+        """Query contract parity: EventServer.scala:300-375."""
+        auth = self.authenticate(params, headers)
+        try:
+            reversed_ = params.get("reversed", "false").lower() == "true"
+            entity_type = params.get("entityType")
+            entity_id = params.get("entityId")
+            if reversed_ and not (entity_type and entity_id):
+                return 400, {
+                    "message": "the parameter reversed can only be used with "
+                    "both entityType and entityId specified."
+                }
+            limit = int(params.get("limit", 20))
+            event_name = params.get("event")
+            filter = EventFilter(
+                start_time=(
+                    parse_datetime(params["startTime"])
+                    if "startTime" in params else None
+                ),
+                until_time=(
+                    parse_datetime(params["untilTime"])
+                    if "untilTime" in params else None
+                ),
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=[event_name] if event_name else None,
+                target_entity_type=params.get("targetEntityType", ...),
+                target_entity_id=params.get("targetEntityId", ...),
+                limit=limit,
+                reversed=reversed_,
+            )
+        except (ValueError, KeyError) as exc:
+            return 400, {"message": str(exc)}
+        found = [
+            event_to_json(e)
+            for e in self.events.find(auth.app_id, auth.channel_id, filter)
+        ]
+        if not found:
+            return 404, {"message": "Not Found"}
+        return 200, found
+
+    def post_batch(
+        self, params: Mapping[str, str], headers: Mapping[str, str], body: Any
+    ) -> Response:
+        """Batch contract parity: EventServer.scala:376-460 — per-event
+        statuses in original order; whole request rejected only when >50."""
+        auth = self.authenticate(params, headers)
+        if not isinstance(body, list):
+            return 400, {"message": "request body must be a JSON array"}
+        if len(body) > MAX_EVENTS_PER_BATCH:
+            return 400, {
+                "message": "Batch request must have less than or equal to "
+                f"{MAX_EVENTS_PER_BATCH} events"
+            }
+        results: list[dict[str, Any]] = []
+        for item in body:
+            try:
+                if not isinstance(item, Mapping):
+                    raise EventValidationError("event must be a JSON object")
+                event = event_from_json(item)
+            except EventValidationError as exc:
+                results.append({"status": 400, "message": str(exc)})
+                continue
+            if auth.events and event.event not in auth.events:
+                results.append(
+                    {"status": 403, "message": f"{event.event} events are not allowed"}
+                )
+                continue
+            try:
+                self.plugin_context.run_blockers(
+                    EventInfo(auth.app_id, auth.channel_id, event)
+                )
+            except Exception as exc:
+                results.append({"status": 403, "message": str(exc)})
+                continue
+            try:
+                event_id = self.events.insert(event, auth.app_id, auth.channel_id)
+            except Exception as exc:  # per-event insert failure (scala :440-444)
+                results.append({"status": 500, "message": str(exc)})
+                continue
+            self.plugin_context.notify_sniffers(
+                EventInfo(auth.app_id, auth.channel_id, event)
+            )
+            if self.stats:
+                self.stats.update(auth.app_id, 201, event)
+            results.append({"status": 201, "eventId": event_id})
+        return 200, results
+
+    def stats_json(
+        self, params: Mapping[str, str], headers: Mapping[str, str]
+    ) -> Response:
+        auth = self.authenticate(params, headers)
+        if not self.stats:
+            return 404, {
+                "message": "To see stats, launch Event Server with --stats argument."
+            }
+        return 200, self.stats.get(auth.app_id)
+
+    def post_webhook(
+        self,
+        site: str,
+        form: bool,
+        params: Mapping[str, str],
+        headers: Mapping[str, str],
+        body: Any,
+    ) -> Response:
+        """Parity: Webhooks.postJson/postForm (api/Webhooks.scala:45-114)."""
+        auth = self.authenticate(params, headers)
+        connectors = FORM_CONNECTORS if form else JSON_CONNECTORS
+        connector = connectors.get(site)
+        if connector is None:
+            return 404, {"message": f"webhooks connection for {site} is not supported."}
+        try:
+            event = connector_to_event(connector, body)
+        except (ConnectorError, EventValidationError) as exc:
+            return 400, {"message": str(exc)}
+        event_id = self.events.insert(event, auth.app_id, auth.channel_id)
+        if self.stats:
+            self.stats.update(auth.app_id, 201, event)
+        return 201, {"eventId": event_id}
+
+    def get_webhook(self, site: str, form: bool, params, headers) -> Response:
+        """Existence check (Webhooks.getJson/getForm, api/Webhooks.scala:116-154)."""
+        self.authenticate(params, headers)
+        connectors = FORM_CONNECTORS if form else JSON_CONNECTORS
+        if site not in connectors:
+            return 404, {"message": f"webhooks connection for {site} is not supported."}
+        return 200, {"message": f"Webhooks connection for {site} is supported."}
+
+    # -- dispatch ------------------------------------------------------------
+    _EVENT_PATH = re.compile(r"^/events/(?P<id>[^/]+)\.json$")
+    _WEBHOOK_JSON = re.compile(r"^/webhooks/(?P<site>[^/.]+)\.json$")
+    _WEBHOOK_FORM = re.compile(r"^/webhooks/(?P<site>[^/.]+)\.form$")
+
+    def handle(
+        self,
+        method: str,
+        path: str,
+        params: Mapping[str, str],
+        headers: Mapping[str, str],
+        body: Any = None,
+    ) -> Response:
+        """Single dispatch point for all transports."""
+        try:
+            if path == "/" and method == "GET":
+                return self.alive()
+            if path == "/plugins.json" and method == "GET":
+                return self.plugins_json()
+            if path == "/events.json":
+                if method == "POST":
+                    return self.post_event(params, headers, body)
+                if method == "GET":
+                    return self.get_events(params, headers)
+            if path == "/batch/events.json" and method == "POST":
+                return self.post_batch(params, headers, body)
+            if path == "/stats.json" and method == "GET":
+                return self.stats_json(params, headers)
+            m = self._EVENT_PATH.match(path)
+            if m:
+                if method == "GET":
+                    return self.get_event(m.group("id"), params, headers)
+                if method == "DELETE":
+                    return self.delete_event(m.group("id"), params, headers)
+            m = self._WEBHOOK_JSON.match(path)
+            if m:
+                if method == "POST":
+                    return self.post_webhook(m.group("site"), False, params, headers, body)
+                if method == "GET":
+                    return self.get_webhook(m.group("site"), False, params, headers)
+            m = self._WEBHOOK_FORM.match(path)
+            if m:
+                if method == "POST":
+                    return self.post_webhook(m.group("site"), True, params, headers, body)
+                if method == "GET":
+                    return self.get_webhook(m.group("site"), True, params, headers)
+            return 404, {"message": "Not Found"}
+        except _Reject as r:
+            return r.status, {"message": r.message}
+        except Exception as exc:  # Common.exceptionHandler parity
+            logger.exception("internal error handling %s %s", method, path)
+            return 500, {"message": str(exc)}
+
+    def close(self) -> None:
+        self.plugin_context.close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: EventService  # set on subclass
+
+    protocol_version = "HTTP/1.1"
+
+    def _params(self) -> dict[str, str]:
+        q = parse_qs(urlparse(self.path).query)
+        return {k: v[0] for k, v in q.items()}
+
+    def _body(self) -> Any:
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        if not raw:
+            return None
+        content_type = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if content_type == "application/x-www-form-urlencoded":
+            return {k: v[0] for k, v in parse_qs(raw.decode()).items()}
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return _MALFORMED
+
+    def _respond(self, status: int, payload: Any) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _dispatch(self, method: str) -> None:
+        path = urlparse(self.path).path
+        body = self._body() if method in ("POST", "PUT") else None
+        if body is _MALFORMED:
+            self._respond(400, {"message": "the request body is not valid JSON"})
+            return
+        status, payload = self.service.handle(
+            method, path, self._params(), dict(self.headers.items()), body
+        )
+        self._respond(status, payload)
+
+    def do_GET(self) -> None:  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._dispatch("POST")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        self._dispatch("DELETE")
+
+    def log_message(self, format: str, *args) -> None:
+        logger.debug("%s - %s", self.address_string(), format % args)
+
+
+_MALFORMED = object()
+
+
+class EventServer:
+    """HTTP wrapper. Parity: EventServer.createEventServer
+    (EventServer.scala:632-654) — wires DAOs and binds the port."""
+
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        config: EventServerConfig = EventServerConfig(),
+        plugin_context: EventServerPluginContext | None = None,
+    ):
+        self.config = config
+        self.service = EventService(storage, config, plugin_context)
+        handler = type("BoundHandler", (_Handler,), {"service": self.service})
+        self._httpd = ThreadingHTTPServer((config.ip, config.port), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        """Serve on a background thread (returns immediately)."""
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pio-eventserver", daemon=True
+        )
+        self._thread.start()
+        logger.info("Event Server listening on %s:%s", self.config.ip, self.port)
+
+    def serve_forever(self) -> None:
+        logger.info("Event Server listening on %s:%s", self.config.ip, self.port)
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self.service.close()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def create_event_server(
+    storage: Storage | None = None,
+    config: EventServerConfig = EventServerConfig(),
+) -> EventServer:
+    return EventServer(storage, config)

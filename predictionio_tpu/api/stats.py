@@ -1,0 +1,109 @@
+"""Event-server bookkeeping: per-app counts of status codes and
+(entityType, targetEntityType, event) triples, kept in hourly buckets.
+
+Parity: data/src/main/scala/.../data/api/{Stats.scala:30-82,
+StatsActor.scala} — the reference rotates a ``Stats`` per hour inside
+``StatsActor``; here ``StatsKeeper`` owns the rotation under a lock
+instead of an actor mailbox.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import Counter
+from datetime import datetime, timezone
+
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.core.json_codec import format_datetime
+
+
+@dataclasses.dataclass(frozen=True)
+class EntityTypesEvent:
+    """Parity: EntityTypesEvent (Stats.scala:30-39)."""
+    entity_type: str
+    target_entity_type: str | None
+    event: str
+
+    @staticmethod
+    def of(e: Event) -> "EntityTypesEvent":
+        return EntityTypesEvent(e.entity_type, e.target_entity_type, e.event)
+
+
+class Stats:
+    """One bucket of counts. Parity: Stats (Stats.scala:51-82)."""
+
+    def __init__(self, start_time: datetime):
+        self.start_time = start_time
+        self.end_time: datetime | None = None
+        self.status_code_count: Counter[tuple[int, int]] = Counter()
+        self.ete_count: Counter[tuple[int, EntityTypesEvent]] = Counter()
+
+    def cutoff(self, end_time: datetime) -> None:
+        self.end_time = end_time
+
+    def update(self, app_id: int, status_code: int, event: Event) -> None:
+        self.status_code_count[(app_id, status_code)] += 1
+        self.ete_count[(app_id, EntityTypesEvent.of(event))] += 1
+
+    def get(self, app_id: int) -> dict:
+        """JSON snapshot for one app (Stats.get -> StatsSnapshot)."""
+        return {
+            "startTime": format_datetime(self.start_time),
+            "endTime": format_datetime(self.end_time) if self.end_time else None,
+            "basic": [
+                {
+                    "key": {
+                        "entityType": k[1].entity_type,
+                        "targetEntityType": k[1].target_entity_type,
+                        "event": k[1].event,
+                    },
+                    "value": v,
+                }
+                for k, v in sorted(self.ete_count.items(), key=lambda kv: repr(kv[0]))
+                if k[0] == app_id
+            ],
+            "statusCode": [
+                {"key": k[1], "value": v}
+                for k, v in sorted(self.status_code_count.items())
+                if k[0] == app_id
+            ],
+        }
+
+
+def _hour_floor(t: datetime) -> datetime:
+    return t.replace(minute=0, second=0, microsecond=0)
+
+
+class StatsKeeper:
+    """Thread-safe hourly rotation: current hour + previous hour.
+    Parity: StatsActor's Bookkeeping/GetStats handling."""
+
+    def __init__(self):
+        now = datetime.now(timezone.utc)
+        self._lock = threading.Lock()
+        self._current = Stats(_hour_floor(now))
+        self._previous = Stats(_hour_floor(now))
+
+    def _rotate(self, now: datetime) -> None:
+        hour = _hour_floor(now)
+        if hour > self._current.start_time:
+            self._current.cutoff(hour)
+            self._previous = self._current
+            self._current = Stats(hour)
+
+    def update(self, app_id: int, status_code: int, event: Event) -> None:
+        now = datetime.now(timezone.utc)
+        with self._lock:
+            self._rotate(now)
+            self._current.update(app_id, status_code, event)
+
+    def get(self, app_id: int) -> dict:
+        """Both buckets, keyed like the reference's Map[String, StatsSnapshot]."""
+        with self._lock:
+            self._rotate(datetime.now(timezone.utc))
+            return {
+                "time": format_datetime(datetime.now(timezone.utc)),
+                "currentHour": self._current.get(app_id),
+                "prevHour": self._previous.get(app_id),
+            }

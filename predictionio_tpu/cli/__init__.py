@@ -1,0 +1,4 @@
+"""The `pio` command-line interface.
+
+Reference: tools/src/main/scala/.../tools/console/Console.scala and bin/pio.
+"""

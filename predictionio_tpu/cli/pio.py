@@ -1,0 +1,284 @@
+"""`pio` CLI — app/key/channel administration and server launch.
+
+Subcommand surface mirrors the reference console
+(reference: tools/.../console/Console.scala:78-768, Pio.scala:62-340).
+Train/eval/deploy subcommands are wired in by the workflow layer as it
+lands; this module keeps the registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from predictionio_tpu import __version__
+from predictionio_tpu.storage.base import AccessKey, App, Channel
+from predictionio_tpu.storage.registry import Storage
+
+
+def _cmd_version(args, storage: Storage) -> int:
+    print(__version__)
+    return 0
+
+
+def _cmd_status(args, storage: Storage) -> int:
+    """Parity: commands/Management.scala:99-181 (pio status)."""
+    print("[INFO] Inspecting predictionio_tpu...")
+    try:
+        storage.verify_all_data_objects()
+        print("[INFO] Storage: all repositories verified (metadata/eventdata/modeldata)")
+    except Exception as exc:
+        print(f"[ERROR] Storage check failed: {exc}")
+        return 1
+    try:
+        import jax
+
+        devices = jax.devices()
+        print(f"[INFO] JAX backend: {devices[0].platform} x{len(devices)}")
+    except Exception as exc:
+        print(f"[WARN] JAX unavailable: {exc}")
+    print("[INFO] Your system is all ready to go.")
+    return 0
+
+
+def _cmd_eventserver(args, storage: Storage) -> int:
+    from predictionio_tpu.api.event_server import EventServer, EventServerConfig
+
+    server = EventServer(
+        storage,
+        EventServerConfig(ip=args.ip, port=args.port, stats=args.stats),
+    )
+    print(f"[INFO] Event Server listening on {args.ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def _cmd_app(args, storage: Storage) -> int:
+    """Parity: commands/App.scala:25-365."""
+    apps = storage.get_meta_data_apps()
+    keys = storage.get_meta_data_access_keys()
+    channels = storage.get_meta_data_channels()
+    events = storage.get_events()
+    if args.app_command == "new":
+        app_id = apps.insert(App(args.id or 0, args.name, args.description))
+        if app_id is None:
+            print(f"[ERROR] App {args.name} already exists.")
+            return 1
+        events.init(app_id)
+        key = keys.insert(AccessKey(args.access_key or "", app_id, ()))
+        print(f"[INFO] Created a new app:")
+        print(f"[INFO]         Name: {args.name}")
+        print(f"[INFO]           ID: {app_id}")
+        print(f"[INFO]   Access Key: {key}")
+        return 0
+    if args.app_command == "list":
+        for app in apps.get_all():
+            app_keys = keys.get_by_app_id(app.id)
+            key_str = app_keys[0].key if app_keys else ""
+            print(f"[INFO]   {app.name} (id={app.id}) key={key_str}")
+        return 0
+    if args.app_command == "show":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            print(f"[ERROR] App {args.name} does not exist.")
+            return 1
+        print(f"[INFO]     App Name: {app.name}")
+        print(f"[INFO]       App ID: {app.id}")
+        print(f"[INFO]  Description: {app.description or ''}")
+        for k in keys.get_by_app_id(app.id):
+            allowed = ",".join(k.events) if k.events else "(all)"
+            print(f"[INFO]   Access Key: {k.key} | {allowed}")
+        for c in channels.get_by_app_id(app.id):
+            print(f"[INFO]      Channel: {c.name} (id={c.id})")
+        return 0
+    if args.app_command == "delete":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            print(f"[ERROR] App {args.name} does not exist.")
+            return 1
+        for c in channels.get_by_app_id(app.id):
+            events.remove(app.id, c.id)
+            channels.delete(c.id)
+        events.remove(app.id)
+        for k in keys.get_by_app_id(app.id):
+            keys.delete(k.key)
+        apps.delete(app.id)
+        print(f"[INFO] App {args.name} deleted.")
+        return 0
+    if args.app_command == "data-delete":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            print(f"[ERROR] App {args.name} does not exist.")
+            return 1
+        if args.channel:
+            chan = next(
+                (c for c in channels.get_by_app_id(app.id) if c.name == args.channel),
+                None,
+            )
+            if chan is None:
+                print(f"[ERROR] Channel {args.channel} does not exist.")
+                return 1
+            events.remove(app.id, chan.id)
+            events.init(app.id, chan.id)
+        else:
+            events.remove(app.id)
+            events.init(app.id)
+        print(f"[INFO] Data of app {args.name} deleted.")
+        return 0
+    if args.app_command == "channel-new":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            print(f"[ERROR] App {args.name} does not exist.")
+            return 1
+        channel_id = channels.insert(Channel(0, args.channel, app.id))
+        if channel_id is None:
+            print(f"[ERROR] Invalid channel name: {args.channel}")
+            return 1
+        events.init(app.id, channel_id)
+        print(f"[INFO] Channel {args.channel} (id={channel_id}) created.")
+        return 0
+    if args.app_command == "channel-delete":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            print(f"[ERROR] App {args.name} does not exist.")
+            return 1
+        chan = next(
+            (c for c in channels.get_by_app_id(app.id) if c.name == args.channel),
+            None,
+        )
+        if chan is None:
+            print(f"[ERROR] Channel {args.channel} does not exist.")
+            return 1
+        events.remove(app.id, chan.id)
+        channels.delete(chan.id)
+        print(f"[INFO] Channel {args.channel} deleted.")
+        return 0
+    print(f"[ERROR] Unknown app command {args.app_command}")
+    return 1
+
+
+def _cmd_accesskey(args, storage: Storage) -> int:
+    """Parity: commands/AccessKey.scala:26-66."""
+    apps = storage.get_meta_data_apps()
+    keys = storage.get_meta_data_access_keys()
+    if args.ak_command == "new":
+        app = apps.get_by_name(args.app_name)
+        if app is None:
+            print(f"[ERROR] App {args.app_name} does not exist.")
+            return 1
+        key = keys.insert(
+            AccessKey(args.access_key or "", app.id, tuple(args.event or ()))
+        )
+        print(f"[INFO] Created new access key: {key}")
+        return 0
+    if args.ak_command == "list":
+        for k in keys.get_all():
+            if args.app_name:
+                app = apps.get_by_name(args.app_name)
+                if app is None or k.appid != app.id:
+                    continue
+            allowed = ",".join(k.events) if k.events else "(all)"
+            print(f"[INFO]   {k.key} | app={k.appid} | {allowed}")
+        return 0
+    if args.ak_command == "delete":
+        keys.delete(args.key)
+        print(f"[INFO] Deleted access key {args.key}")
+        return 0
+    print(f"[ERROR] Unknown accesskey command {args.ak_command}")
+    return 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pio",
+        description="predictionio_tpu: TPU-native machine-learning server framework",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    sub.add_parser("version", help="show version")
+    sub.add_parser("status", help="verify environment and storage")
+
+    p = sub.add_parser("eventserver", help="launch the event server")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7070)
+    p.add_argument("--stats", action="store_true")
+
+    p = sub.add_parser("app", help="app administration")
+    app_sub = p.add_subparsers(dest="app_command", required=True)
+    pn = app_sub.add_parser("new")
+    pn.add_argument("name")
+    pn.add_argument("--id", type=int)
+    pn.add_argument("--description")
+    pn.add_argument("--access-key", dest="access_key")
+    for name in ("list",):
+        app_sub.add_parser(name)
+    ps = app_sub.add_parser("show")
+    ps.add_argument("name")
+    pd = app_sub.add_parser("delete")
+    pd.add_argument("name")
+    pdd = app_sub.add_parser("data-delete")
+    pdd.add_argument("name")
+    pdd.add_argument("--channel")
+    pcn = app_sub.add_parser("channel-new")
+    pcn.add_argument("name")
+    pcn.add_argument("channel")
+    pcd = app_sub.add_parser("channel-delete")
+    pcd.add_argument("name")
+    pcd.add_argument("channel")
+
+    p = sub.add_parser("accesskey", help="access key administration")
+    ak_sub = p.add_subparsers(dest="ak_command", required=True)
+    an = ak_sub.add_parser("new")
+    an.add_argument("app_name")
+    an.add_argument("--access-key", dest="access_key")
+    an.add_argument("--event", action="append")
+    al = ak_sub.add_parser("list")
+    al.add_argument("app_name", nargs="?")
+    ad = ak_sub.add_parser("delete")
+    ad.add_argument("key")
+
+    parser.subparsers = sub  # handle for late-bound subcommand registration
+    return parser
+
+
+_COMMANDS = {
+    "version": _cmd_version,
+    "status": _cmd_status,
+    "eventserver": _cmd_eventserver,
+    "app": _cmd_app,
+    "accesskey": _cmd_accesskey,
+}
+
+
+def register_command(name: str, configure_parser, run) -> None:
+    """Extension point used by the workflow layer to add train/eval/deploy."""
+    _COMMANDS[name] = run
+    _EXTRA_PARSERS.append((name, configure_parser))
+
+
+_EXTRA_PARSERS: list = []
+
+
+def main(argv: list[str] | None = None) -> int:
+    # late-bound subcommands (train/deploy/eval) register on import
+    try:
+        import predictionio_tpu.workflow.cli_commands  # noqa: F401
+    except ImportError:
+        pass
+    parser = build_parser()
+    for name, configure in _EXTRA_PARSERS:
+        configure(parser.subparsers)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.print_help()
+        return 1
+    storage = Storage.default()
+    return _COMMANDS[args.command](args, storage)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,8 +56,10 @@ class DataMap(Mapping[str, JsonValue]):
     __slots__ = ("_fields",)
 
     def __init__(self, fields: Mapping[str, JsonValue] | None = None):
-        # Drop explicit JSON nulls at the edge: the reference treats a null
-        # field as absent for get/getOpt (DataMap.scala:96-129).
+        # Explicit JSON nulls are KEPT in the field map (key_set/len include
+        # them; $unset events carry them as the keys to remove) but the typed
+        # getters treat a null field as absent — same as the reference, where
+        # json4s JNull stays in the JObject (DataMap.scala:96-129).
         self._fields: dict[str, JsonValue] = dict(fields or {})
 
     # -- Mapping protocol -------------------------------------------------
@@ -189,9 +191,10 @@ class DataMap(Mapping[str, JsonValue]):
         return NotImplemented
 
     def __hash__(self) -> int:
-        return hash(frozenset(
-            (k, repr(v)) for k, v in self._fields.items()
-        ))
+        # Key-only hash: weak but contract-safe — any two maps that compare
+        # equal (including int==float values, or PropertyMap vs DataMap with
+        # equal fields) hash identically.
+        return hash(frozenset(self._fields))
 
     def __repr__(self) -> str:
         return f"DataMap({self._fields!r})"
@@ -220,6 +223,10 @@ class PropertyMap(DataMap):
         return PropertyMap(fields, self.first_updated, self.last_updated)
 
     def __eq__(self, other: object) -> bool:
+        # Same cross-type equality shape as the reference (PropertyMap.equals,
+        # PropertyMap.scala:58-66): PropertyMap==PropertyMap compares times
+        # too, PropertyMap==DataMap compares fields only. Like the reference
+        # this is knowingly non-transitive across the two types.
         if isinstance(other, PropertyMap):
             return (
                 self._fields == other._fields
@@ -230,8 +237,9 @@ class PropertyMap(DataMap):
             return self._fields == other._fields
         return NotImplemented
 
-    def __hash__(self) -> int:
-        return hash((super().__hash__(), self.first_updated, self.last_updated))
+    # Inherit DataMap's key-only hash so PropertyMap/DataMap pairs that
+    # compare equal hash equally (eq/hash contract).
+    __hash__ = DataMap.__hash__
 
     def __repr__(self) -> str:
         return (

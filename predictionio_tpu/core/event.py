@@ -44,6 +44,10 @@ class Event:
             t = getattr(self, name)
             if t.tzinfo is None:
                 object.__setattr__(self, name, t.replace(tzinfo=timezone.utc))
+        # Normalize tags to a tuple so Event stays hashable and round-trips
+        # identically through every backend.
+        if not isinstance(self.tags, tuple):
+            object.__setattr__(self, "tags", tuple(self.tags))
 
     def with_event_id(self, event_id: str) -> "Event":
         return dataclasses.replace(self, event_id=event_id)

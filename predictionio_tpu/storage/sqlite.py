@@ -15,9 +15,11 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import sqlite3
 import threading
 import uuid
+from contextlib import contextmanager
 from datetime import datetime
 from typing import Iterator
 
@@ -44,75 +46,79 @@ def event_table_name(app_id: int, channel_id: int | None) -> str:
 
 
 class _Connection:
-    """One sqlite connection per thread over a shared db file."""
+    """A bounded connection pool over one sqlite database.
+
+    Per-request threads (ThreadingHTTPServer spawns one per request) borrow
+    a pooled connection instead of opening their own, so connection count
+    is bounded regardless of thread churn. ``:memory:`` databases use a
+    single shared connection (a second connection would see a different,
+    empty database).
+    """
+
+    POOL_SIZE = 8
 
     def __init__(self, path: str):
         self.path = path
-        self._local = threading.local()
+        self._closed = False
         if path != ":memory:":
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        # :memory: must share one connection across threads
-        self._memory_conn: sqlite3.Connection | None = None
-        self._memory_lock = threading.RLock()
-        self._closed = False
-        self._all_conns: list[sqlite3.Connection] = []
-        self._all_conns_lock = threading.Lock()
-        if path == ":memory:":
-            self._memory_conn = sqlite3.connect(":memory:", check_same_thread=False)
+        self._pool: "queue.Queue[sqlite3.Connection]" = queue.Queue()
+        self._created = 0
+        self._created_lock = threading.Lock()
+        self._max = 1 if path == ":memory:" else self.POOL_SIZE
 
-    def get(self) -> tuple[sqlite3.Connection, threading.RLock | None]:
-        if self._closed:
-            raise sqlite3.ProgrammingError("storage connection is closed")
-        if self._memory_conn is not None:
-            return self._memory_conn, self._memory_lock
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            # check_same_thread=False so close() can reap it from another
-            # thread; each connection is still only *used* by its own thread.
-            conn = sqlite3.connect(self.path, timeout=30.0, check_same_thread=False)
+    def _new_conn(self) -> sqlite3.Connection:
+        # check_same_thread=False: connections move between borrowing
+        # threads, but only one thread uses a connection at a time.
+        conn = sqlite3.connect(self.path, timeout=30.0, check_same_thread=False)
+        if self.path != ":memory:":
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
-            self._local.conn = conn
-            with self._all_conns_lock:
-                self._all_conns.append(conn)
-        return conn, None
+        return conn
+
+    @contextmanager
+    def _borrow(self):
+        if self._closed:
+            raise sqlite3.ProgrammingError("storage connection is closed")
+        conn: sqlite3.Connection | None = None
+        try:
+            conn = self._pool.get_nowait()
+        except queue.Empty:
+            with self._created_lock:
+                below_cap = self._created < self._max
+                if below_cap:
+                    self._created += 1
+            if below_cap:
+                conn = self._new_conn()
+            else:
+                conn = self._pool.get(timeout=60)
+        try:
+            yield conn
+        finally:
+            if self._closed:
+                conn.close()
+            else:
+                self._pool.put(conn)
 
     def execute(self, sql: str, params: tuple = ()) -> list[tuple]:
-        conn, lock = self.get()
-        if lock:
-            with lock:
-                cur = conn.execute(sql, params)
-                rows = cur.fetchall()
-                conn.commit()
-                return rows
-        cur = conn.execute(sql, params)
-        rows = cur.fetchall()
-        conn.commit()
-        return rows
+        with self._borrow() as conn:
+            cur = conn.execute(sql, params)
+            rows = cur.fetchall()
+            conn.commit()
+            return rows
 
     def executemany(self, sql: str, seq: list[tuple]) -> None:
-        conn, lock = self.get()
-        if lock:
-            with lock:
-                conn.executemany(sql, seq)
-                conn.commit()
-            return
-        conn.executemany(sql, seq)
-        conn.commit()
+        with self._borrow() as conn:
+            conn.executemany(sql, seq)
+            conn.commit()
 
     def close(self) -> None:
         self._closed = True
-        if self._memory_conn is not None:
-            self._memory_conn.close()
-            self._memory_conn = None
-        with self._all_conns_lock:
-            for conn in self._all_conns:
-                try:
-                    conn.close()
-                except sqlite3.ProgrammingError:
-                    pass  # connection created by a thread that already exited
-            self._all_conns.clear()
-        self._local = threading.local()
+        while True:
+            try:
+                self._pool.get_nowait().close()
+            except queue.Empty:
+                break
 
 
 def _is_no_table(err: sqlite3.OperationalError) -> bool:
@@ -126,10 +132,12 @@ _EVENT_COLUMNS = (
 
 
 def _fmt_utc(t: datetime) -> str:
-    """Store times normalized to UTC so the TEXT column sorts by instant."""
+    """Storage time format: UTC, fixed-width microseconds — lexicographic
+    order equals instant order, and no precision is lost (the millisecond
+    wire format in json_codec is only for the REST API)."""
     from datetime import timezone
 
-    return format_datetime(t.astimezone(timezone.utc))
+    return t.astimezone(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
 
 
 def _event_to_row(event_id: str, e: Event) -> tuple:

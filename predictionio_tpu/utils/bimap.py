@@ -103,6 +103,7 @@ class EntityIdIxMap:
 
     def __init__(self, id_to_ix: BiMap[str, int]):
         self.id_to_ix = id_to_ix
+        self._dict = id_to_ix.to_dict()  # cached once: to_index is a hot path
 
     @staticmethod
     def from_ids(ids: Iterable[str]) -> "EntityIdIxMap":
@@ -126,7 +127,7 @@ class EntityIdIxMap:
 
     def to_index(self, entity_ids: Iterable[str], missing: int = -1) -> np.ndarray:
         """Vectorized batch id -> index; unknown ids map to ``missing``."""
-        d = self.id_to_ix.to_dict()
+        d = self._dict
         return np.fromiter(
             (d.get(e, missing) for e in entity_ids), dtype=np.int32
         )

@@ -106,7 +106,7 @@ def test_json_roundtrip():
 
 def test_json_defaults_and_validation():
     e = event_from_json({"event": "view", "entityType": "user", "entityId": "u9"})
-    assert e.properties.is_empty() and e.tags == []
+    assert e.properties.is_empty() and e.tags == ()
     with pytest.raises(EventValidationError):
         event_from_json({"event": "view", "entityType": "user"})  # no entityId
     with pytest.raises(EventValidationError):

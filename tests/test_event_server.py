@@ -1,0 +1,273 @@
+"""Event Server REST contract tests over real HTTP.
+
+Modeled on the reference's spray-testkit EventServiceSpec plus the Python
+integration scenario tests/pio_tests/scenarios/eventserver_test.py
+(malformed/batch/channel cases).
+"""
+
+import http.client
+import json
+
+import pytest
+
+from predictionio_tpu.api.event_server import EventServer, EventServerConfig
+from predictionio_tpu.api.plugins import EventServerPlugin, EventServerPluginContext, INPUT_BLOCKER
+from predictionio_tpu.storage.base import AccessKey, App, Channel
+from predictionio_tpu.storage.registry import Storage
+
+MEM_ENV = {
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+}
+
+
+@pytest.fixture
+def server():
+    storage = Storage(MEM_ENV)
+    app_id = storage.get_meta_data_apps().insert(App(0, "testapp"))
+    storage.get_meta_data_access_keys().insert(AccessKey("testkey", app_id, ()))
+    storage.get_meta_data_access_keys().insert(
+        AccessKey("whitelist-key", app_id, ("rate",))
+    )
+    storage.get_meta_data_channels().insert(Channel(0, "mychan", app_id))
+    storage.get_events().init(app_id)
+    srv = EventServer(storage, EventServerConfig(ip="127.0.0.1", port=0, stats=True))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def call(server, method, path, body=None, content_type="application/json"):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    payload = None
+    headers = {}
+    if body is not None:
+        payload = body if isinstance(body, (str, bytes)) else json.dumps(body)
+        headers["Content-Type"] = content_type
+    conn.request(method, path, body=payload, headers=headers)
+    resp = conn.getresponse()
+    data = json.loads(resp.read())
+    conn.close()
+    return resp.status, data
+
+
+EVENT = {"event": "rate", "entityType": "user", "entityId": "u1",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"rating": 5}}
+
+
+def test_alive(server):
+    assert call(server, "GET", "/") == (200, {"status": "alive"})
+
+
+def test_post_get_delete_event(server):
+    status, body = call(server, "POST", "/events.json?accessKey=testkey", EVENT)
+    assert status == 201 and "eventId" in body
+    eid = body["eventId"]
+    status, got = call(server, "GET", f"/events/{eid}.json?accessKey=testkey")
+    assert status == 200
+    assert got["event"] == "rate" and got["entityId"] == "u1"
+    assert got["properties"] == {"rating": 5}
+    assert call(server, "DELETE", f"/events/{eid}.json?accessKey=testkey") == (
+        200, {"message": "Found"})
+    assert call(server, "GET", f"/events/{eid}.json?accessKey=testkey")[0] == 404
+    assert call(server, "DELETE", f"/events/{eid}.json?accessKey=testkey")[0] == 404
+
+
+def test_auth_required_and_basic_header(server):
+    assert call(server, "POST", "/events.json", EVENT)[0] == 401
+    assert call(server, "POST", "/events.json?accessKey=wrong", EVENT)[0] == 401
+    # Basic auth: key as username
+    import base64
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    creds = base64.b64encode(b"testkey:").decode()
+    conn.request("POST", "/events.json", json.dumps(EVENT),
+                 {"Authorization": f"Basic {creds}",
+                  "Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 201
+    json.loads(resp.read())
+    conn.close()
+
+
+def test_malformed_event_rejected(server):
+    status, body = call(server, "POST", "/events.json?accessKey=testkey",
+                        {"event": "rate"})
+    assert status == 400
+    status, body = call(server, "POST", "/events.json?accessKey=testkey",
+                        "this is not json")
+    assert status == 400
+
+
+def test_event_whitelist(server):
+    assert call(server, "POST", "/events.json?accessKey=whitelist-key", EVENT)[0] == 201
+    status, body = call(server, "POST", "/events.json?accessKey=whitelist-key",
+                        {**EVENT, "event": "buy"})
+    assert status == 403
+    assert "not allowed" in body["message"]
+
+
+def test_channel_routing(server):
+    status, body = call(
+        server, "POST", "/events.json?accessKey=testkey&channel=mychan", EVENT)
+    assert status == 201
+    # event not visible on default channel
+    assert call(server, "GET", "/events.json?accessKey=testkey")[0] == 404
+    status, found = call(
+        server, "GET", "/events.json?accessKey=testkey&channel=mychan")
+    assert status == 200 and len(found) == 1
+    assert call(server, "POST",
+                "/events.json?accessKey=testkey&channel=nope", EVENT)[0] == 401
+
+
+def test_get_events_query(server):
+    for i in range(5):
+        call(server, "POST", "/events.json?accessKey=testkey",
+             {**EVENT, "entityId": f"u{i % 2}",
+              "eventTime": f"2020-01-0{i + 1}T00:00:00.000Z"})
+    call(server, "POST", "/events.json?accessKey=testkey",
+         {"event": "buy", "entityType": "user", "entityId": "u0",
+          "eventTime": "2020-01-06T00:00:00.000Z"})
+    status, found = call(server, "GET", "/events.json?accessKey=testkey")
+    assert status == 200 and len(found) == 6
+    _, found = call(server, "GET", "/events.json?accessKey=testkey&event=buy")
+    assert len(found) == 1
+    _, found = call(server, "GET",
+                    "/events.json?accessKey=testkey&entityType=user&entityId=u1")
+    assert len(found) == 2
+    _, found = call(server, "GET",
+                    "/events.json?accessKey=testkey&startTime=2020-01-03T00:00:00.000Z"
+                    "&untilTime=2020-01-05T00:00:00.000Z")
+    assert len(found) == 2
+    _, found = call(server, "GET", "/events.json?accessKey=testkey&limit=3")
+    assert len(found) == 3
+    # reversed requires entity
+    assert call(server, "GET",
+                "/events.json?accessKey=testkey&reversed=true")[0] == 400
+    _, found = call(server, "GET",
+                    "/events.json?accessKey=testkey&entityType=user&entityId=u0"
+                    "&reversed=true&limit=1")
+    assert found[0]["event"] == "buy"
+    # bad time format
+    assert call(server, "GET",
+                "/events.json?accessKey=testkey&startTime=garbage")[0] == 400
+
+
+def test_batch_events(server):
+    batch = [
+        EVENT,
+        {"event": "buy", "entityType": "user"},  # missing entityId -> 400
+        {**EVENT, "entityId": "u2"},
+    ]
+    status, results = call(server, "POST", "/batch/events.json?accessKey=testkey", batch)
+    assert status == 200
+    assert [r["status"] for r in results] == [201, 400, 201]
+    assert "eventId" in results[0] and "message" in results[1]
+    # order preserved; whitelist applies per event
+    status, results = call(
+        server, "POST", "/batch/events.json?accessKey=whitelist-key",
+        [{**EVENT, "event": "buy"}, EVENT])
+    assert [r["status"] for r in results] == [403, 201]
+    # >50 rejected outright
+    status, body = call(server, "POST", "/batch/events.json?accessKey=testkey",
+                        [EVENT] * 51)
+    assert status == 400
+    assert "50" in body["message"]
+
+
+def test_stats(server):
+    call(server, "POST", "/events.json?accessKey=testkey", EVENT)
+    call(server, "POST", "/events.json?accessKey=testkey",
+         {**EVENT, "event": "buy"})
+    status, stats = call(server, "GET", "/stats.json?accessKey=testkey")
+    assert status == 200
+    basic = stats["currentHour"]["basic"]
+    assert sum(kv["value"] for kv in basic) == 2
+    events_seen = {kv["key"]["event"] for kv in basic}
+    assert events_seen == {"rate", "buy"}
+    codes = stats["currentHour"]["statusCode"]
+    assert codes == [{"key": 201, "value": 2}]
+
+
+def test_stats_disabled():
+    storage = Storage(MEM_ENV)
+    app_id = storage.get_meta_data_apps().insert(App(0, "app2"))
+    storage.get_meta_data_access_keys().insert(AccessKey("k2", app_id, ()))
+    srv = EventServer(storage, EventServerConfig(ip="127.0.0.1", port=0, stats=False))
+    srv.start()
+    try:
+        status, body = call(srv, "GET", "/stats.json?accessKey=k2")
+        assert status == 404
+        assert "--stats" in body["message"]
+    finally:
+        srv.stop()
+
+
+def test_webhooks_segmentio(server):
+    payload = {
+        "version": "2", "type": "track", "userId": "u42", "event": "Signed Up",
+        "properties": {"plan": "Pro"}, "timestamp": "2020-02-23T22:28:55.111Z",
+    }
+    status, body = call(server, "POST", "/webhooks/segmentio.json?accessKey=testkey",
+                        payload)
+    assert status == 201
+    eid = body["eventId"]
+    _, got = call(server, "GET", f"/events/{eid}.json?accessKey=testkey")
+    assert got["event"] == "track" and got["entityId"] == "u42"
+    assert got["properties"]["properties"] == {"plan": "Pro"}
+    assert got["eventTime"].startswith("2020-02-23")
+    # existence check + unknown site
+    assert call(server, "GET", "/webhooks/segmentio.json?accessKey=testkey")[0] == 200
+    assert call(server, "GET", "/webhooks/nope.json?accessKey=testkey")[0] == 404
+    # malformed payload
+    status, body = call(server, "POST", "/webhooks/segmentio.json?accessKey=testkey",
+                        {"type": "track"})
+    assert status == 400
+
+
+def test_webhooks_mailchimp_form(server):
+    form = ("type=subscribe&fired_at=2020-03-26 21:35:57"
+            "&data[id]=8a25ff1d98&data[email]=api@mailchimp.com"
+            "&data[list_id]=a6b5da1054")
+    status, body = call(server, "POST", "/webhooks/mailchimp.form?accessKey=testkey",
+                        form, content_type="application/x-www-form-urlencoded")
+    assert status == 201
+    _, got = call(server, "GET",
+                  f"/events/{body['eventId']}.json?accessKey=testkey")
+    assert got["event"] == "subscribe"
+    assert got["entityId"] == "api@mailchimp.com"
+    assert got["properties"]["list_id"] == "a6b5da1054"
+
+
+def test_input_blocker_plugin():
+    class Blocker(EventServerPlugin):
+        plugin_name = "blocker"
+        plugin_type = INPUT_BLOCKER
+
+        def process(self, info, ctx):
+            if info.event.entity_id == "blocked":
+                raise ValueError("entity is blocked")
+
+    storage = Storage(MEM_ENV)
+    app_id = storage.get_meta_data_apps().insert(App(0, "app3"))
+    storage.get_meta_data_access_keys().insert(AccessKey("k3", app_id, ()))
+    ctx = EventServerPluginContext([Blocker()])
+    srv = EventServer(storage, EventServerConfig(ip="127.0.0.1", port=0),
+                      plugin_context=ctx)
+    srv.start()
+    try:
+        assert call(srv, "POST", "/events.json?accessKey=k3", EVENT)[0] == 201
+        status, body = call(srv, "POST", "/events.json?accessKey=k3",
+                            {**EVENT, "entityId": "blocked"})
+        assert status == 403 and "blocked" in body["message"]
+        # plugins.json lists it
+        _, plugins = call(srv, "GET", "/plugins.json")
+        assert "blocker" in plugins["plugins"]["inputblockers"]
+    finally:
+        srv.stop()
+
+
+def test_unknown_route(server):
+    assert call(server, "GET", "/nope.json")[0] == 404
