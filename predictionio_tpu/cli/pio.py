@@ -70,6 +70,9 @@ def _cmd_app(args, storage: Storage) -> int:
             return 1
         events.init(app_id)
         key = keys.insert(AccessKey(args.access_key or "", app_id, ()))
+        if key is None:
+            print(f"[ERROR] Access key {args.access_key} already exists.")
+            return 1
         print(f"[INFO] Created a new app:")
         print(f"[INFO]         Name: {args.name}")
         print(f"[INFO]           ID: {app_id}")
@@ -173,14 +176,16 @@ def _cmd_accesskey(args, storage: Storage) -> int:
         key = keys.insert(
             AccessKey(args.access_key or "", app.id, tuple(args.event or ()))
         )
+        if key is None:
+            print(f"[ERROR] Access key {args.access_key} already exists.")
+            return 1
         print(f"[INFO] Created new access key: {key}")
         return 0
     if args.ak_command == "list":
+        app = apps.get_by_name(args.app_name) if args.app_name else None
         for k in keys.get_all():
-            if args.app_name:
-                app = apps.get_by_name(args.app_name)
-                if app is None or k.appid != app.id:
-                    continue
+            if args.app_name and (app is None or k.appid != app.id):
+                continue
             allowed = ",".join(k.events) if k.events else "(all)"
             print(f"[INFO]   {k.key} | app={k.appid} | {allowed}")
         return 0

@@ -1,0 +1,48 @@
+"""DASE controller API.
+
+Reference: core/src/main/scala/.../controller/ and core/.../core/.
+"""
+
+from predictionio_tpu.controller.algorithm import (
+    HostModelAlgorithm,
+    LocalAlgorithm,
+    ShardedAlgorithm,
+)
+from predictionio_tpu.controller.base import (
+    Algorithm,
+    AverageServing,
+    DataSource,
+    Doer,
+    Evaluator,
+    FirstServing,
+    IdentityPreparator,
+    PersistentModelManifest,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+from predictionio_tpu.controller.engine import (
+    Engine,
+    EngineFactory,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    TrainResult,
+    resolve_engine_factory,
+)
+from predictionio_tpu.controller.params import (
+    EmptyParams,
+    EngineParams,
+    Params,
+    params_from_json,
+    params_to_json,
+)
+
+__all__ = [
+    "Algorithm", "AverageServing", "DataSource", "Doer", "Evaluator",
+    "FirstServing", "IdentityPreparator", "PersistentModelManifest",
+    "Preparator", "SanityCheck", "Serving",
+    "HostModelAlgorithm", "LocalAlgorithm", "ShardedAlgorithm",
+    "Engine", "EngineFactory", "StopAfterPrepareInterruption",
+    "StopAfterReadInterruption", "TrainResult", "resolve_engine_factory",
+    "EmptyParams", "EngineParams", "Params", "params_from_json", "params_to_json",
+]

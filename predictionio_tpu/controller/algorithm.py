@@ -1,0 +1,77 @@
+"""Algorithm mesh-placement taxonomy — the TPU re-expression of the
+reference's P / P2L / L algorithm classes.
+
+Parity mapping (SURVEY.md §2.6 "load-bearing abstraction"):
+
+- ``LocalAlgorithm``    ≙ LAlgorithm (LAlgorithm.scala:45-133): trains and
+  predicts entirely on host (NumPy); model is host memory.
+- ``HostModelAlgorithm`` ≙ P2LAlgorithm (P2LAlgorithm.scala:46-124):
+  training runs jitted over the device mesh, the finished model is pulled
+  to host (replicated) — serving needs no mesh.
+- ``ShardedAlgorithm``  ≙ PAlgorithm (PAlgorithm.scala:47-129): the model
+  *stays* as mesh-sharded jax.Arrays in HBM (e.g. ALS factor tables under
+  NamedSharding). Batch predict must be implemented sharded, and models
+  are persisted via sharded checkpoints or retrained on deploy — the same
+  constraint the reference had for RDD models, solved better here
+  (SURVEY.md §7 hard-parts: orbax sharded checkpoints avoid the forced
+  retrain).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import TYPE_CHECKING, Sequence
+
+from predictionio_tpu.controller.base import M, P, PD, Q, Algorithm
+
+if TYPE_CHECKING:
+    from predictionio_tpu.workflow.context import EngineContext
+
+
+class LocalAlgorithm(Algorithm[PD, M, Q, P], abc.ABC):
+    """Host-only algorithm; never touches the mesh."""
+
+    placement = "local"
+
+
+class HostModelAlgorithm(Algorithm[PD, M, Q, P], abc.ABC):
+    """Mesh-trained, host-held model.
+
+    ``train`` may use ``ctx.mesh`` freely; the returned model must be
+    host-transferable (the workflow calls ``gather_model`` after training,
+    mirroring P2LAlgorithm's implicit collect at P2LAlgorithm.scala:56-69).
+    """
+
+    placement = "host_model"
+
+    def gather_model(self, ctx: "EngineContext", model: M) -> M:
+        """Pull device arrays to host / replicate. Default: device_get any
+        jax arrays in the model pytree."""
+        import jax
+
+        return jax.device_get(model)
+
+
+class ShardedAlgorithm(Algorithm[PD, M, Q, P], abc.ABC):
+    """Model lives sharded on the mesh between training and serving.
+
+    Contract differences, mirroring PAlgorithm:
+    - ``batch_predict`` MUST be overridden with a sharded implementation
+      (PAlgorithm.batchPredict "must be implemented", PAlgorithm.scala:72).
+    - Models are not auto-pickled; implement ``make_persistent_model`` /
+      ``load_model`` (sharded checkpoint) or return None to retrain on
+      deploy (PAlgorithm.scala:89-125).
+    """
+
+    placement = "sharded"
+
+    def batch_predict(self, model: M, queries: Sequence[tuple[int, Q]]) -> Sequence[tuple[int, P]]:
+        raise NotImplementedError(
+            f"{type(self).__name__} is a ShardedAlgorithm and must override "
+            "batch_predict with a mesh-sharded implementation"
+        )
+
+    def make_persistent_model(self, ctx: "EngineContext", model: M):
+        """Default for sharded models: do not persist; retrain on deploy
+        (reference parity). Algorithms with orbax checkpoints override."""
+        return None

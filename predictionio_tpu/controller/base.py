@@ -1,0 +1,225 @@
+"""DASE component contracts: DataSource / Preparator / Algorithm / Serving
+/ Evaluator, plus Doer construction.
+
+Parity: core/src/main/scala/.../core/{BaseDataSource.scala:34-54,
+BasePreparator.scala:33-44, BaseAlgorithm.scala:58-126,
+BaseServing.scala:31-53, BaseEvaluator.scala:39-75, AbstractDoer.scala:35-69}
+and controller/{PDataSource,LServing,...}.scala.
+
+Type vocabulary (Engine.scala:83-89): TD training data, EI evaluation
+info, PD prepared data, Q query, P predicted result, A actual result,
+M model. Components are Generic over these so engines stay typed.
+
+TPU-first difference: every hook that received a SparkContext receives an
+``EngineContext`` (predictionio_tpu.workflow.context) carrying the JAX
+device mesh, RNG key, and workflow params — SURVEY.md §7's translation
+table row 1.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import inspect
+from typing import TYPE_CHECKING, Any, Generic, Sequence, TypeVar
+
+from predictionio_tpu.controller.params import EmptyParams, Params
+
+if TYPE_CHECKING:
+    from predictionio_tpu.workflow.context import EngineContext
+
+TD = TypeVar("TD")
+EI = TypeVar("EI")
+PD = TypeVar("PD")
+Q = TypeVar("Q")
+P = TypeVar("P")
+A = TypeVar("A")
+M = TypeVar("M")
+
+
+class Doer:
+    """Reflective component construction from params.
+
+    Parity: AbstractDoer/Doer (AbstractDoer.scala:35-69): construct with
+    (params) when the __init__ accepts it, else no-arg. Components keep
+    their params on ``self.params``.
+    """
+
+    @staticmethod
+    def create(cls: type, params: Any = None):
+        sig = inspect.signature(cls.__init__)
+        # count non-self positional params without defaults
+        accepts_params = len(sig.parameters) > 1
+        if accepts_params:
+            instance = cls(params if params is not None else EmptyParams())
+        else:
+            instance = cls()
+            instance.params = params if params is not None else EmptyParams()
+        return instance
+
+
+class BaseComponent:
+    """Common base: stores params, exposes the params class for JSON binding."""
+
+    #: dataclass bound to this component's engine.json "params" object
+    params_class: type = EmptyParams
+
+    def __init__(self, params: Any = None):
+        self.params = params if params is not None else EmptyParams()
+
+
+class DataSource(BaseComponent, Generic[TD, EI, Q, A], abc.ABC):
+    """Reads training and evaluation data from the Event Store.
+
+    Parity: BaseDataSource (BaseDataSource.scala:34-54) + PDataSource
+    (PDataSource.scala:36-72). The L/P split collapses: a single
+    DataSource returns host data structures; sharding onto the mesh is the
+    Preparator/Algorithm's job.
+    """
+
+    @abc.abstractmethod
+    def read_training(self, ctx: "EngineContext") -> TD:
+        """Parity: readTrainingBase/readTraining."""
+
+    def read_eval(self, ctx: "EngineContext") -> Sequence[tuple[TD, EI, Sequence[tuple[Q, A]]]]:
+        """k folds of (training data, eval info, (query, actual) pairs).
+        Parity: readEvalBase/readEval (BaseDataSource.scala:40-49)."""
+        return []
+
+
+class Preparator(BaseComponent, Generic[TD, PD], abc.ABC):
+    """Transforms training data into prepared (model-ready) data.
+
+    Parity: BasePreparator (BasePreparator.scala:33-44). In the TPU design
+    this is the ragged->static boundary: the natural place to pad/bucket
+    events into fixed-shape arrays and device_put them onto the mesh
+    (SURVEY.md §7 hard-parts note on recompilation control).
+    """
+
+    @abc.abstractmethod
+    def prepare(self, ctx: "EngineContext", td: TD) -> PD:
+        """Parity: prepareBase/prepare."""
+
+
+class IdentityPreparator(Preparator[TD, TD]):
+    """Passes training data through. Parity: IdentityPreparator
+    (IdentityPreparator.scala:34-92)."""
+
+    def prepare(self, ctx: "EngineContext", td: TD) -> TD:
+        return td
+
+
+class Algorithm(BaseComponent, Generic[PD, M, Q, P], abc.ABC):
+    """Trains a model and answers queries.
+
+    Parity: BaseAlgorithm (BaseAlgorithm.scala:58-126). The reference's
+    P/P2L/L locality taxonomy (SURVEY.md §2.6) is re-expressed in
+    controller/algorithm.py as Local/HostModel/Sharded mesh placements;
+    this base carries the shared contract.
+    """
+
+    @abc.abstractmethod
+    def train(self, ctx: "EngineContext", pd: PD) -> M:
+        """Parity: trainBase/train."""
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> P:
+        """Serving-time single query. Parity: predictBase/predict."""
+
+    def batch_predict(self, model: M, queries: Sequence[tuple[int, Q]]) -> Sequence[tuple[int, P]]:
+        """Evaluation-time bulk predict over (index, query) pairs.
+
+        Parity: batchPredictBase (BaseAlgorithm.scala:73-90). Default maps
+        ``predict``; mesh-sharded algorithms override with a vectorized
+        jitted path (the RDD-join analogue).
+        """
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+    # -- persistence hooks (BaseAlgorithm.makePersistentModel:111-126) ------
+    def make_persistent_model(self, ctx: "EngineContext", model: M) -> Any:
+        """Return what the train workflow should persist for ``model``:
+
+        - the model itself (default) -> pickled into the MODELDATA repo;
+        - a ``PersistentModelManifest`` -> the algorithm saved it via its
+          own ``save`` hook (orbax sharded checkpoint etc.);
+        - ``None`` -> nothing persisted; retrain on deploy (the reference's
+          "Unit model" semantics, PAlgorithm.scala:89-101).
+        """
+        return model
+
+    def load_model(self, ctx: "EngineContext", manifest: "PersistentModelManifest") -> M:
+        """Inverse of a manifest-producing make_persistent_model."""
+        raise NotImplementedError(
+            f"{type(self).__name__} stored a manifest but does not implement load_model"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class PersistentModelManifest:
+    """Stored in place of a model blob when the algorithm persists the
+    model itself. Parity: PersistentModelManifest
+    (workflow/PersistentModelManifest.scala)."""
+
+    class_name: str
+    location: str = ""
+
+
+class Serving(BaseComponent, Generic[Q, P], abc.ABC):
+    """Combines per-algorithm predictions into one response.
+
+    Parity: BaseServing (BaseServing.scala:31-53) / LServing
+    (LServing.scala:30-54).
+    """
+
+    def supplement(self, query: Q) -> Q:
+        """Pre-process query before algorithms see it (supplementBase)."""
+        return query
+
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        """Parity: serveBase/serve; receives the ORIGINAL query
+        (Engine.scala:810-812)."""
+
+
+class FirstServing(Serving[Q, P]):
+    """Serves the first algorithm's prediction. Parity: LFirstServing
+    (LFirstServing.scala:28-41)."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        return predictions[0]
+
+
+class AverageServing(Serving[Q, float]):
+    """Averages numeric predictions. Parity: LAverageServing
+    (LAverageServing.scala:28-43)."""
+
+    def serve(self, query: Q, predictions: Sequence[float]) -> float:
+        return sum(predictions) / len(predictions)
+
+
+class SanityCheck(abc.ABC):
+    """Data classes may implement this to be checked between pipeline
+    stages. Parity: SanityCheck (controller/SanityCheck.scala)."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None:
+        """Raise on inconsistent data."""
+
+
+class Evaluator(BaseComponent, Generic[EI, Q, P, A], abc.ABC):
+    """Folds evaluation results into a final score.
+
+    Parity: BaseEvaluator (BaseEvaluator.scala:39-75).
+    """
+
+    @abc.abstractmethod
+    def evaluate(
+        self,
+        ctx: "EngineContext",
+        engine_eval_data_set: Sequence[
+            tuple[Any, Sequence[tuple[EI, Sequence[tuple[Q, P, A]]]]]
+        ],
+        params: Any,
+    ) -> Any:
+        """engine_eval_data_set: per EngineParams, the per-fold
+        (EI, [(Q, P, A)]) results. Returns a BaseEvaluatorResult-like."""

@@ -1,0 +1,99 @@
+"""Parameter types for DASE components.
+
+Parity: core/src/main/scala/.../controller/{Params.scala:26-37,
+EngineParams.scala:33-148}. Params classes are plain dataclasses; the
+JSON in engine.json binds to them by field name (the single-codec
+replacement for the reference's json4s/Gson JsonExtractor duality).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence, Type, TypeVar
+
+P = TypeVar("P")
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    """Marker base for component parameter classes (Params.scala:26-32).
+    Subclasses are frozen dataclasses."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    """Parity: EmptyParams (Params.scala:35-37)."""
+
+
+def params_from_json(params_class: Type[P], obj: dict[str, Any] | None) -> P:
+    """Bind a JSON object to a Params dataclass by field name.
+
+    Unknown JSON keys are rejected (catching typos in engine.json — the
+    reference got this from json4s strict extraction); missing keys fall
+    back to dataclass defaults.
+    """
+    obj = obj or {}
+    if not dataclasses.is_dataclass(params_class):
+        raise TypeError(f"{params_class} must be a dataclass")
+    field_names = {f.name for f in dataclasses.fields(params_class)}
+    unknown = set(obj) - field_names
+    if unknown:
+        raise ValueError(
+            f"Unknown parameter(s) {sorted(unknown)} for {params_class.__name__} "
+            f"(accepted: {sorted(field_names)})"
+        )
+    kwargs = {}
+    for f in dataclasses.fields(params_class):
+        if f.name in obj:
+            v = obj[f.name]
+            # JSON arrays bind to tuple-typed fields as tuples
+            if isinstance(v, list):
+                ann = str(f.type)
+                if ann.startswith(("tuple", "Tuple", "typing.Tuple")) or "Sequence" in ann:
+                    v = tuple(v)
+            kwargs[f.name] = v
+    return params_class(**kwargs)
+
+
+def params_to_json(params: Any) -> dict[str, Any]:
+    if params is None:
+        return {}
+    if dataclasses.is_dataclass(params):
+        return dataclasses.asdict(params)
+    if isinstance(params, dict):
+        return dict(params)
+    raise TypeError(f"cannot serialize params of type {type(params)}")
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """The full parameter set of one engine variant: (name, params) per
+    component slot, algorithm list ordered.
+
+    Parity: EngineParams (EngineParams.scala:33-108).
+    """
+
+    data_source_params: tuple[str, Any] = ("", EmptyParams())
+    preparator_params: tuple[str, Any] = ("", EmptyParams())
+    algorithm_params_list: Sequence[tuple[str, Any]] = ()
+    serving_params: tuple[str, Any] = ("", EmptyParams())
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "algorithm_params_list", tuple(self.algorithm_params_list)
+        )
+
+    @staticmethod
+    def of(
+        data_source: Any = None,
+        preparator: Any = None,
+        algorithms: Sequence[tuple[str, Any]] = (),
+        serving: Any = None,
+    ) -> "EngineParams":
+        """Convenience constructor for single-name engines."""
+        return EngineParams(
+            data_source_params=("", data_source if data_source is not None else EmptyParams()),
+            preparator_params=("", preparator if preparator is not None else EmptyParams()),
+            algorithm_params_list=tuple(algorithms),
+            serving_params=("", serving if serving is not None else EmptyParams()),
+        )

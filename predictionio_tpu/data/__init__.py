@@ -1,0 +1,1 @@
+"""Engine-facing data access: EventStore facade and columnar batching."""

@@ -1,0 +1,126 @@
+"""EventStore — the engine-facing, name-based facade over the event DAOs.
+
+Parity: data/src/main/scala/.../data/store/{PEventStore.scala:35-121,
+LEventStore.scala:33-145, Common.scala}. One facade serves both roles:
+training-time bulk reads (PEventStore.find/aggregateProperties) and
+serving-time low-latency entity reads (LEventStore.findByEntity).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Iterator, Sequence
+
+from predictionio_tpu.core.datamap import PropertyMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import EventFilter
+from predictionio_tpu.storage.registry import Storage
+
+
+class AppNotFoundError(KeyError):
+    pass
+
+
+class EventStore:
+    def __init__(self, storage: Storage | None = None):
+        self.storage = storage or Storage.default()
+
+    def app_name_to_id(self, app_name: str, channel_name: str | None = None) -> tuple[int, int | None]:
+        """Parity: Common.appNameToId (store/Common.scala)."""
+        app = self.storage.get_meta_data_apps().get_by_name(app_name)
+        if app is None:
+            raise AppNotFoundError(f"App {app_name!r} does not exist.")
+        channel_id = None
+        if channel_name is not None:
+            channels = self.storage.get_meta_data_channels().get_by_app_id(app.id)
+            match = next((c for c in channels if c.name == channel_name), None)
+            if match is None:
+                raise AppNotFoundError(
+                    f"Channel {channel_name!r} does not exist in app {app_name!r}."
+                )
+            channel_id = match.id
+        return app.id, channel_id
+
+    def find(
+        self,
+        app_name: str,
+        channel_name: str | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None | type(...) = ...,
+        target_entity_id: str | None | type(...) = ...,
+        limit: int | None = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        """Training-time bulk read. Parity: PEventStore.find
+        (PEventStore.scala:59-97) / LEventStore.find (:117-145)."""
+        app_id, channel_id = self.app_name_to_id(app_name, channel_name)
+        return self.storage.get_events().find(
+            app_id,
+            channel_id,
+            EventFilter(
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+                limit=limit,
+                reversed=reversed,
+            ),
+        )
+
+    def aggregate_properties(
+        self,
+        app_name: str,
+        entity_type: str,
+        channel_name: str | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        required: Sequence[str] | None = None,
+    ) -> dict[str, PropertyMap]:
+        """Parity: PEventStore.aggregateProperties (PEventStore.scala:99-121)."""
+        app_id, channel_id = self.app_name_to_id(app_name, channel_name)
+        return self.storage.get_events().aggregate_properties(
+            app_id,
+            entity_type,
+            channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )
+
+    def find_by_entity(
+        self,
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None | type(...) = ...,
+        target_entity_id: str | None | type(...) = ...,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        limit: int | None = None,
+        latest: bool = True,
+    ) -> Iterator[Event]:
+        """Serving-time single-entity read. Parity: LEventStore.findByEntity
+        (LEventStore.scala:61-115)."""
+        app_id, channel_id = self.app_name_to_id(app_name, channel_name)
+        return self.storage.get_events().find_single_entity(
+            app_id,
+            entity_type,
+            entity_id,
+            channel_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            start_time=start_time,
+            until_time=until_time,
+            limit=limit,
+            latest=latest,
+        )

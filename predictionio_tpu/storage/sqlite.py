@@ -89,14 +89,30 @@ class _Connection:
                 if below_cap:
                     self._created += 1
             if below_cap:
-                conn = self._new_conn()
+                try:
+                    conn = self._new_conn()
+                except Exception:
+                    with self._created_lock:
+                        self._created -= 1  # free the slot for a retry
+                    raise
             else:
                 conn = self._pool.get(timeout=60)
+        returnable = True
         try:
             yield conn
+        except BaseException:
+            # never return a connection with a half-applied transaction
+            try:
+                conn.rollback()
+            except sqlite3.Error:
+                returnable = False
+            raise
         finally:
-            if self._closed:
+            if self._closed or not returnable:
                 conn.close()
+                if not returnable:
+                    with self._created_lock:
+                        self._created -= 1
             else:
                 self._pool.put(conn)
 

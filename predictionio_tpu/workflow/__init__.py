@@ -1,0 +1,9 @@
+"""Workflow layer: train/eval drivers, engine.json parsing, model
+persistence, deployment server.
+
+Reference: core/src/main/scala/.../workflow/.
+"""
+
+from predictionio_tpu.workflow.context import EngineContext, WorkflowParams
+
+__all__ = ["EngineContext", "WorkflowParams"]

@@ -1,0 +1,136 @@
+"""EngineContext — the compute-substrate handle threaded through DASE.
+
+The reference threads a SparkContext through every DASE hook
+(reference: core/.../workflow/WorkflowContext.scala:28-46 creates it; every
+Base* signature carries ``sc``). The TPU-native replacement carries:
+
+- the `jax.sharding.Mesh` over the chip topology (ICI collectives replace
+  Spark shuffle — SURVEY.md §2.6 TPU-equivalent note),
+- a PRNG key chain,
+- the storage registry (PEventStore role),
+- workflow params (batch label, sanity-check/stop-after flags —
+  WorkflowParams.scala:30-45).
+
+Mesh axes convention: ``("data", "model")`` — data parallelism over the
+first axis, model/embedding sharding over the second; algorithms reshape
+as needed via ``with_axes``. Multi-host: `jax.distributed.initialize` is
+invoked by the CLI launcher when PIO_NUM_HOSTS>1; in-process code only
+ever sees the global mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Any, Mapping, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkflowParams:
+    """Parity: WorkflowParams (WorkflowParams.scala:30-45); sparkEnv is
+    replaced by mesh_conf (axis spec)."""
+
+    batch: str = ""
+    verbose: int = 2
+    save_model: bool = True
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+    mesh_conf: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _factor_mesh(n: int) -> tuple[int, int]:
+    """Default 2D factorization of n devices: (data, model) with the model
+    axis the largest power-of-two <= sqrt(n) dividing n."""
+    best = 1
+    for m in range(1, int(math.isqrt(n)) + 1):
+        if n % m == 0:
+            best = m
+    return (n // best, best)
+
+
+class EngineContext:
+    """One per workflow run; cheap to construct lazily in tests."""
+
+    def __init__(
+        self,
+        workflow_params: WorkflowParams = WorkflowParams(),
+        storage: Any = None,
+        mesh: Any = None,
+        seed: int = 0,
+        devices: Sequence[Any] | None = None,
+    ):
+        self.workflow_params = workflow_params
+        self._storage = storage
+        self._mesh = mesh
+        self._seed = seed
+        self._devices = devices
+        self._rng_count = 0
+
+    # -- storage ------------------------------------------------------------
+    @property
+    def storage(self):
+        if self._storage is None:
+            from predictionio_tpu.storage.registry import Storage
+
+            self._storage = Storage.default()
+        return self._storage
+
+    # -- mesh ---------------------------------------------------------------
+    @property
+    def mesh(self):
+        """The device mesh, built on first use from mesh_conf:
+        {"axes": {"data": 4, "model": 2}} or auto-factored from the
+        available devices."""
+        if self._mesh is None:
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh
+
+            devices = list(self._devices) if self._devices else jax.devices()
+            axes_conf = self.workflow_params.mesh_conf.get("axes")
+            if axes_conf:
+                names = tuple(axes_conf.keys())
+                sizes = tuple(int(v) for v in axes_conf.values())
+            else:
+                names = ("data", "model")
+                sizes = _factor_mesh(len(devices))
+            total = math.prod(sizes)
+            if total > len(devices):
+                raise ValueError(
+                    f"mesh axes {dict(zip(names, sizes))} need {total} devices, "
+                    f"have {len(devices)}"
+                )
+            mesh_devices = np.asarray(devices[:total]).reshape(sizes)
+            self._mesh = Mesh(mesh_devices, names)
+            logger.info("created mesh %s over %d %s device(s)",
+                        dict(zip(names, sizes)), total, devices[0].platform)
+        return self._mesh
+
+    def with_axes(self, **axes: int) -> "EngineContext":
+        """A context whose mesh uses an explicit axis spec."""
+        wp = dataclasses.replace(
+            self.workflow_params, mesh_conf={**self.workflow_params.mesh_conf, "axes": axes}
+        )
+        return EngineContext(wp, self._storage, None, self._seed, self._devices)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.mesh.devices.shape)
+
+    # -- rng ----------------------------------------------------------------
+    def next_rng_key(self):
+        """A fresh PRNG key per call (fold_in chain from the seed)."""
+        import jax
+
+        self._rng_count += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), self._rng_count)
+
+    # -- event store facade (PEventStore role, data/.../store) --------------
+    def event_store(self) -> "EventStore":
+        from predictionio_tpu.data.store import EventStore
+
+        return EventStore(self.storage)

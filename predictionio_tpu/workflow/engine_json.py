@@ -1,0 +1,31 @@
+"""engine.json variant loading.
+
+Parity: CreateWorkflow's variant JSON reading (CreateWorkflow.scala:180-196)
+and WorkflowUtils.extractSparkConf (:317-336) — the ``sparkConf`` subtree
+becomes ``meshConf`` ({"axes": {"data": N, "model": M}} etc.).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+
+def load_variant(path: str = "engine.json") -> dict[str, Any]:
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found. An engine project needs an engine.json "
+            "(engineFactory + component params)."
+        )
+    with open(path) as f:
+        variant = json.load(f)
+    if "engineFactory" not in variant:
+        raise ValueError(f"{path} is missing required key 'engineFactory'")
+    return variant
+
+
+def mesh_conf_from_variant(variant: dict[str, Any]) -> dict[str, Any]:
+    """Accept either the native "meshConf" key or a legacy "sparkConf"
+    subtree (ignored with a note) for drop-in engine.json compatibility."""
+    return dict(variant.get("meshConf", {}))

@@ -1,0 +1,86 @@
+"""Model persistence: serializing per-algorithm models into the MODELDATA
+repository.
+
+Parity: CoreWorkflow.runTrain's Kryo-serialize-and-insert
+(reference: core/.../workflow/CoreWorkflow.scala:58-65) and the three
+persistence modes of BaseAlgorithm.makePersistentModel
+(core/.../core/BaseAlgorithm.scala:111-126; SURVEY.md §5 checkpoint/resume):
+
+1. automatic  — picklable host model -> pickled blob (Kryo equivalent);
+2. manifest   — PersistentModelManifest stored, algorithm owns the real
+   artifact (e.g. orbax sharded checkpoint);
+3. none       — None stored -> retrain on deploy.
+
+numpy/jax arrays inside models are converted to numpy before pickling so
+blobs are backend-portable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import pickle
+from typing import Any, Sequence
+
+from predictionio_tpu.controller.base import PersistentModelManifest
+from predictionio_tpu.storage.base import Model
+from predictionio_tpu.storage.registry import Storage
+
+_FORMAT_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class _Envelope:
+    """What actually lands in the Models repo: per-algo entries tagged by
+    persistence mode."""
+
+    version: int
+    entries: tuple[tuple[str, Any], ...]  # (mode, payload); mode: auto|manifest|none
+
+
+def _to_host(obj: Any) -> Any:
+    """Pull any jax arrays in a pytree to numpy for portable pickling."""
+    try:
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_get(x) if isinstance(x, jax.Array) else x, obj
+        )
+    except ImportError:  # pure-host install
+        return obj
+
+
+def serialize_models(persisted: Sequence[Any]) -> bytes:
+    entries: list[tuple[str, Any]] = []
+    for p in persisted:
+        if p is None:
+            entries.append(("none", None))
+        elif isinstance(p, PersistentModelManifest):
+            entries.append(("manifest", p))
+        else:
+            entries.append(("auto", _to_host(p)))
+    buf = io.BytesIO()
+    pickle.dump(_Envelope(_FORMAT_VERSION, tuple(entries)), buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def deserialize_models(blob: bytes) -> list[Any]:
+    """Returns the per-algo persisted list (model | manifest | None) for
+    Engine.prepare_deploy."""
+    env: _Envelope = pickle.loads(blob)
+    if env.version != _FORMAT_VERSION:
+        raise ValueError(f"unsupported model blob version {env.version}")
+    return [payload for _, payload in env.entries]
+
+
+def save_models(storage: Storage, instance_id: str, persisted: Sequence[Any]) -> None:
+    storage.get_model_data_models().insert(
+        Model(id=instance_id, models=serialize_models(persisted))
+    )
+
+
+def load_models(storage: Storage, instance_id: str) -> list[Any]:
+    model = storage.get_model_data_models().get(instance_id)
+    if model is None:
+        raise KeyError(f"no persisted models for engine instance {instance_id}")
+    return deserialize_models(model.models)

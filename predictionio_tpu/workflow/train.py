@@ -1,0 +1,122 @@
+"""The training workflow driver.
+
+Parity: core/src/main/scala/.../workflow/{CreateWorkflow.scala:136-277,
+CoreWorkflow.scala:39-101}: resolve the engine factory, bind engine.json
+variant params, record an INIT EngineInstance, run the train pipeline,
+persist models, mark COMPLETED (or leave non-COMPLETED on failure —
+SURVEY.md §5 failure-detection note).
+
+No spark-submit process boundary exists: training runs in-process on the
+JAX mesh. The CLI still offers subprocess isolation (`pio train` spawns a
+worker when --isolated) without changing this driver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import traceback
+from datetime import datetime, timezone
+from typing import Any, Mapping
+
+from predictionio_tpu.controller.engine import Engine, resolve_engine_factory
+from predictionio_tpu.controller.params import EngineParams, params_to_json
+from predictionio_tpu.storage.base import EngineInstance
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.workflow.context import EngineContext, WorkflowParams
+from predictionio_tpu.workflow.persistence import save_models
+
+logger = logging.getLogger(__name__)
+
+
+def _now() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def _params_json(name_params: tuple[str, Any]) -> str:
+    name, params = name_params
+    return json.dumps({"name": name, "params": params_to_json(params)})
+
+
+def _algo_params_json(algorithm_params_list) -> str:
+    return json.dumps(
+        [{"name": n, "params": params_to_json(p)} for n, p in algorithm_params_list]
+    )
+
+
+@dataclasses.dataclass
+class TrainOutcome:
+    instance_id: str
+    status: str
+    models: list[Any]
+
+
+def run_train(
+    engine: Engine | None = None,
+    engine_factory: str = "",
+    variant: Mapping[str, Any] | None = None,
+    engine_params: EngineParams | None = None,
+    workflow_params: WorkflowParams = WorkflowParams(),
+    storage: Storage | None = None,
+    ctx: EngineContext | None = None,
+) -> TrainOutcome:
+    """Train one engine variant and persist the results.
+
+    Either pass a constructed ``engine`` (tests, programmatic use) or an
+    ``engine_factory`` spec string (CLI path). ``variant`` is the parsed
+    engine.json; ``engine_params`` overrides it when given.
+    """
+    storage = storage or Storage.default()
+    variant = dict(variant or {})
+    if engine is None:
+        if not engine_factory:
+            engine_factory = variant.get("engineFactory", "")
+        if not engine_factory:
+            raise ValueError("run_train needs an engine or an engineFactory spec")
+        engine = resolve_engine_factory(engine_factory)()
+    if engine_params is None:
+        engine_params = engine.params_from_variant_json(variant)
+    ctx = ctx or EngineContext(workflow_params=workflow_params, storage=storage)
+
+    instances = storage.get_meta_data_engine_instances()
+    instance = EngineInstance(
+        id="",
+        status="INIT",
+        start_time=_now(),
+        completion_time=_now(),
+        engine_id=variant.get("id", "default"),
+        engine_version=variant.get("version", "1"),
+        engine_variant=variant.get("variantId", variant.get("id", "default")),
+        engine_factory=engine_factory or f"{type(engine).__module__}.{type(engine).__qualname__}",
+        batch=workflow_params.batch,
+        env={},
+        mesh_conf=dict(workflow_params.mesh_conf),
+        data_source_params=_params_json(engine_params.data_source_params),
+        preparator_params=_params_json(engine_params.preparator_params),
+        algorithms_params=_algo_params_json(engine_params.algorithm_params_list),
+        serving_params=_params_json(engine_params.serving_params),
+    )
+    instance_id = instances.insert(instance)
+    logger.info("engine instance %s: INIT", instance_id)
+
+    try:
+        result = engine.train(ctx, engine_params)
+        save_models(storage, instance_id, result.persisted)
+        completed = dataclasses.replace(
+            instances.get(instance_id),
+            status="COMPLETED",
+            completion_time=_now(),
+        )
+        instances.update(completed)
+        logger.info("engine instance %s: COMPLETED", instance_id)
+        return TrainOutcome(instance_id, "COMPLETED", result.models)
+    except Exception:
+        # training failures leave the instance non-COMPLETED
+        # (CoreWorkflow.scala:68-73 only updates on success)
+        failed = dataclasses.replace(
+            instances.get(instance_id), status="FAILED", completion_time=_now()
+        )
+        instances.update(failed)
+        logger.error("engine instance %s: FAILED\n%s", instance_id, traceback.format_exc())
+        raise

@@ -1,0 +1,148 @@
+"""A fake DASE engine that tags ids through the pipeline — the test double
+for controller/workflow semantics.
+
+Modeled on the reference's SampleEngine
+(reference: core/src/test/scala/.../controller/SampleEngine.scala:29-400):
+every stage appends its identity so tests can assert exactly which
+component, with which params, saw which data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    EngineParams,
+    IdentityPreparator,
+    LocalAlgorithm,
+    Params,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DSParams(Params):
+    id: int = 0
+    n_train: int = 4
+    n_folds: int = 0
+    fail: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgoParams(Params):
+    id: int = 0
+    mult: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingData(SanityCheck):
+    id: int
+    items: tuple = ()
+    bad: bool = False
+
+    def sanity_check(self) -> None:
+        if self.bad:
+            raise ValueError(f"training data {self.id} failed sanity check")
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedData:
+    source_id: int
+    prep_id: int
+    items: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    x: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Prediction:
+    value: int
+    tags: tuple = ()
+
+
+class SampleDataSource(DataSource):
+    params_class = DSParams
+
+    def read_training(self, ctx) -> TrainingData:
+        p = self.params
+        if p.fail:
+            raise RuntimeError("datasource configured to fail")
+        return TrainingData(id=p.id, items=tuple(range(p.n_train)))
+
+    def read_eval(self, ctx):
+        p = self.params
+        folds = []
+        for k in range(p.n_folds):
+            td = TrainingData(id=p.id + k, items=tuple(range(p.n_train)))
+            qa = [(Query(x=i), i * 10) for i in range(3)]
+            folds.append((td, {"fold": k}, qa))
+        return folds
+
+
+class SamplePreparator(Preparator):
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return PreparedData(source_id=td.id, prep_id=1, items=td.items)
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    algo_id: int
+    mult: int
+    source_id: int
+
+
+class SampleAlgorithm(LocalAlgorithm):
+    params_class = AlgoParams
+
+    def train(self, ctx, pd: PreparedData) -> Model:
+        return Model(algo_id=self.params.id, mult=self.params.mult, source_id=pd.source_id)
+
+    def predict(self, model: Model, query: Query) -> Prediction:
+        return Prediction(
+            value=query.x * model.mult,
+            tags=(f"algo{model.algo_id}",),
+        )
+
+
+class UnpersistedAlgorithm(SampleAlgorithm):
+    """Returns None from make_persistent_model -> retrain-on-deploy path."""
+
+    def make_persistent_model(self, ctx, model):
+        return None
+
+
+class SampleServing(Serving):
+    def serve(self, query: Query, predictions: Sequence[Prediction]) -> Prediction:
+        return Prediction(
+            value=sum(p.value for p in predictions),
+            tags=tuple(t for p in predictions for t in p.tags) + ("served",),
+        )
+
+
+def make_engine() -> Engine:
+    return Engine(
+        data_source_class_map=SampleDataSource,
+        preparator_class_map=SamplePreparator,
+        algorithm_class_map={"sample": SampleAlgorithm, "unpersisted": UnpersistedAlgorithm},
+        serving_class_map=SampleServing,
+    )
+
+
+def engine_factory() -> Engine:
+    """Resolvable via 'tests.sample_engine.engine_factory'."""
+    return make_engine()
+
+
+def default_params(n_algos: int = 2) -> EngineParams:
+    return EngineParams.of(
+        data_source=DSParams(id=7, n_train=5, n_folds=2),
+        algorithms=[("sample", AlgoParams(id=i, mult=i + 1)) for i in range(n_algos)],
+    )

@@ -1,0 +1,175 @@
+"""Engine/DASE pipeline semantics tests.
+
+Modeled on the reference's EngineTest/EngineWorkflowTest
+(core/src/test/scala/.../controller/EngineTest.scala, workflow/
+EngineWorkflowTrainTest etc.) driven by the SampleEngine fake.
+"""
+
+import dataclasses
+
+import pytest
+
+from predictionio_tpu.controller import (
+    EmptyParams,
+    EngineParams,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    params_from_json,
+)
+from predictionio_tpu.workflow.context import EngineContext, WorkflowParams
+
+from tests.sample_engine import (
+    AlgoParams,
+    DSParams,
+    Prediction,
+    Query,
+    SampleAlgorithm,
+    TrainingData,
+    default_params,
+    make_engine,
+)
+
+
+@pytest.fixture
+def ctx():
+    return EngineContext(workflow_params=WorkflowParams())
+
+
+def test_train_runs_pipeline(ctx):
+    engine = make_engine()
+    result = engine.train(ctx, default_params(n_algos=2))
+    assert len(result.models) == 2
+    assert result.models[0].source_id == 7  # datasource id flowed through prepare
+    assert result.models[0].mult == 1 and result.models[1].mult == 2
+    assert result.persisted == result.models  # auto persistence
+
+
+def test_train_multiple_same_algo_different_params(ctx):
+    engine = make_engine()
+    ep = EngineParams.of(
+        data_source=DSParams(id=1),
+        algorithms=[("sample", AlgoParams(id=0, mult=3)), ("sample", AlgoParams(id=1, mult=5))],
+    )
+    result = engine.train(ctx, ep)
+    assert [m.mult for m in result.models] == [3, 5]
+
+
+def test_sanity_check_fails_training(ctx):
+    engine = make_engine()
+
+    class BadDS(type(engine.make_components(default_params())[0])):
+        def read_training(self, ctx):
+            return TrainingData(id=0, bad=True)
+
+    engine.data_source_class_map[""] = BadDS
+    with pytest.raises(ValueError, match="sanity check"):
+        engine.train(ctx, default_params())
+
+
+def test_sanity_check_skipped(ctx):
+    engine = make_engine()
+
+    class BadDS(type(engine.make_components(default_params())[0])):
+        def read_training(self, ctx):
+            return TrainingData(id=0, bad=True)
+
+    engine.data_source_class_map[""] = BadDS
+    ctx2 = EngineContext(workflow_params=WorkflowParams(skip_sanity_check=True))
+    result = engine.train(ctx2, default_params())
+    assert len(result.models) == 2
+
+
+def test_stop_after_read_and_prepare():
+    engine = make_engine()
+    with pytest.raises(StopAfterReadInterruption):
+        engine.train(
+            EngineContext(WorkflowParams(stop_after_read=True)), default_params()
+        )
+    with pytest.raises(StopAfterPrepareInterruption):
+        engine.train(
+            EngineContext(WorkflowParams(stop_after_prepare=True)), default_params()
+        )
+
+
+def test_eval_aligns_multi_algo_predictions(ctx):
+    engine = make_engine()
+    results = engine.eval(ctx, default_params(n_algos=2))
+    assert len(results) == 2  # n_folds
+    ei, fold = results[0]
+    assert ei == {"fold": 0}
+    assert len(fold) == 3
+    for q, p, a in fold:
+        # serving sums algo predictions: x*1 + x*2
+        assert p.value == q.x * 3
+        assert p.tags == ("algo0", "algo1", "served")
+        assert a == q.x * 10
+
+
+def test_unknown_component_name(ctx):
+    engine = make_engine()
+    ep = EngineParams.of(algorithms=[("nope", EmptyParams())])
+    with pytest.raises(ValueError, match="nope"):
+        engine.train(ctx, ep)
+
+
+def test_params_from_json_binding():
+    p = params_from_json(DSParams, {"id": 3, "n_train": 10})
+    assert p == DSParams(id=3, n_train=10)
+    with pytest.raises(ValueError, match="typo_field"):
+        params_from_json(DSParams, {"typo_field": 1})
+    assert params_from_json(DSParams, None) == DSParams()
+
+
+def test_variant_json_to_engine_params(ctx):
+    engine = make_engine()
+    variant = {
+        "id": "sample-variant",
+        "engineFactory": "tests.sample_engine.engine_factory",
+        "datasource": {"params": {"id": 9, "n_train": 3}},
+        "algorithms": [
+            {"name": "sample", "params": {"id": 0, "mult": 4}},
+            {"name": "unpersisted", "params": {"id": 1}},
+        ],
+    }
+    ep = engine.params_from_variant_json(variant)
+    assert ep.data_source_params[1] == DSParams(id=9, n_train=3)
+    assert ep.algorithm_params_list[0] == ("sample", AlgoParams(id=0, mult=4))
+    result = engine.train(ctx, ep)
+    assert result.models[0].mult == 4
+    assert result.persisted[1] is None  # unpersisted algo
+
+
+def test_instance_params_roundtrip(ctx):
+    """EngineParams -> stored JSON blobs -> EngineParams (deploy path)."""
+    import json
+
+    from predictionio_tpu.controller.params import params_to_json
+
+    engine = make_engine()
+    ep = default_params()
+    ds_json = json.dumps(
+        {"name": ep.data_source_params[0], "params": params_to_json(ep.data_source_params[1])}
+    )
+    prep_json = json.dumps({"name": "", "params": {}})
+    algos_json = json.dumps(
+        [{"name": n, "params": params_to_json(p)} for n, p in ep.algorithm_params_list]
+    )
+    serving_json = json.dumps({"name": "", "params": {}})
+    ep2 = engine.params_from_instance_json(ds_json, prep_json, algos_json, serving_json)
+    assert ep2.data_source_params == ep.data_source_params
+    assert ep2.algorithm_params_list == ep.algorithm_params_list
+
+
+def test_prepare_deploy_with_retrain(ctx):
+    engine = make_engine()
+    ep = EngineParams.of(
+        data_source=DSParams(id=2),
+        algorithms=[("sample", AlgoParams(id=0, mult=2)), ("unpersisted", AlgoParams(id=1, mult=9))],
+    )
+    result = engine.train(ctx, ep)
+    assert result.persisted[0] is not None and result.persisted[1] is None
+    models = engine.prepare_deploy(ctx, ep, result.persisted)
+    assert models[0].mult == 2
+    assert models[1].mult == 9  # retrained on deploy
+    p = SampleAlgorithm(AlgoParams(id=1, mult=9)).predict(models[1], Query(x=3))
+    assert p == Prediction(value=27, tags=("algo1",))

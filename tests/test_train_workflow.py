@@ -1,0 +1,93 @@
+"""Training workflow driver tests: EngineInstance lifecycle + model
+persistence (reference behavior: CoreWorkflow.scala:39-101)."""
+
+import pytest
+
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.workflow.context import WorkflowParams
+from predictionio_tpu.workflow.persistence import load_models
+from predictionio_tpu.workflow.train import run_train
+
+from tests.sample_engine import DSParams, default_params, make_engine
+
+MEM_ENV = {
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+}
+
+
+@pytest.fixture
+def storage():
+    return Storage(MEM_ENV)
+
+
+def test_run_train_completes_and_persists(storage):
+    outcome = run_train(
+        engine=make_engine(),
+        engine_params=default_params(),
+        variant={"id": "test-engine"},
+        storage=storage,
+    )
+    assert outcome.status == "COMPLETED"
+    inst = storage.get_meta_data_engine_instances().get(outcome.instance_id)
+    assert inst.status == "COMPLETED"
+    assert inst.engine_id == "test-engine"
+    assert "n_train" in inst.data_source_params
+    persisted = load_models(storage, outcome.instance_id)
+    assert len(persisted) == 2
+    assert persisted[0].mult == 1
+
+    latest = storage.get_meta_data_engine_instances().get_latest_completed(
+        "test-engine", "1", "test-engine"
+    )
+    assert latest.id == outcome.instance_id
+
+
+def test_run_train_failure_marks_failed(storage):
+    import dataclasses
+
+    ep = dataclasses.replace(
+        default_params(), data_source_params=("", DSParams(fail=True))
+    )
+    with pytest.raises(RuntimeError, match="configured to fail"):
+        run_train(
+            engine=make_engine(), engine_params=ep,
+            variant={"id": "failing"}, storage=storage,
+        )
+    instances = storage.get_meta_data_engine_instances().get_all()
+    assert len(instances) == 1
+    assert instances[0].status == "FAILED"
+    assert (
+        storage.get_meta_data_engine_instances().get_latest_completed(
+            "failing", "1", "failing"
+        )
+        is None
+    )
+
+
+def test_run_train_via_factory_and_variant(storage):
+    variant = {
+        "id": "variant-engine",
+        "engineFactory": "tests.sample_engine.engine_factory",
+        "datasource": {"params": {"id": 3, "n_train": 6}},
+        "algorithms": [{"name": "sample", "params": {"mult": 7}}],
+    }
+    outcome = run_train(variant=variant, storage=storage)
+    assert outcome.status == "COMPLETED"
+    assert outcome.models[0].mult == 7
+    assert outcome.models[0].source_id == 3
+    inst = storage.get_meta_data_engine_instances().get(outcome.instance_id)
+    assert inst.engine_factory == "tests.sample_engine.engine_factory"
+
+
+def test_save_model_false(storage):
+    outcome = run_train(
+        engine=make_engine(),
+        engine_params=default_params(),
+        workflow_params=WorkflowParams(save_model=False),
+        storage=storage,
+    )
+    persisted = load_models(storage, outcome.instance_id)
+    assert persisted == [None, None]
