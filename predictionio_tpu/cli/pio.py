@@ -64,6 +64,9 @@ def _cmd_app(args, storage: Storage) -> int:
     channels = storage.get_meta_data_channels()
     events = storage.get_events()
     if args.app_command == "new":
+        if args.access_key and keys.get(args.access_key) is not None:
+            print(f"[ERROR] Access key {args.access_key} already exists.")
+            return 1
         app_id = apps.insert(App(args.id or 0, args.name, args.description))
         if app_id is None:
             print(f"[ERROR] App {args.name} already exists.")

@@ -45,11 +45,11 @@ class HostModelAlgorithm(Algorithm[PD, M, Q, P], abc.ABC):
     placement = "host_model"
 
     def gather_model(self, ctx: "EngineContext", model: M) -> M:
-        """Pull device arrays to host / replicate. Default: device_get any
-        jax arrays in the model pytree."""
-        import jax
+        """Pull device arrays to host numpy, including inside plain
+        dataclass models (which jax treats as opaque pytree leaves)."""
+        from predictionio_tpu.workflow.persistence import _to_host
 
-        return jax.device_get(model)
+        return _to_host(model)
 
 
 class ShardedAlgorithm(Algorithm[PD, M, Q, P], abc.ABC):

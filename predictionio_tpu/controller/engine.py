@@ -165,9 +165,22 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         retrain_needed = any(p is None for p in persisted)
         retrained: TrainResult | None = None
         if retrain_needed:
-            # "Unit model -> retrain on deploy" (Engine.scala:211-229)
+            # "Unit model -> retrain on deploy" (Engine.scala:211-229).
+            # save_model=False: deploy-time retrain must not redo (or
+            # overwrite) persistence work.
+            import dataclasses as _dc
+
+            from predictionio_tpu.workflow.context import EngineContext
+
             logger.info("some models were not persisted; retraining for deploy")
-            retrained = self.train(ctx, engine_params)
+            no_save_ctx = EngineContext(
+                workflow_params=_dc.replace(ctx.workflow_params, save_model=False),
+                storage=ctx._storage,
+                mesh=ctx._mesh,
+                seed=ctx._seed,
+                devices=ctx._devices,
+            )
+            retrained = self.train(no_save_ctx, engine_params)
         for i, (algo, blob) in enumerate(zip(algorithms, persisted)):
             if blob is None:
                 models.append(retrained.models[i])
@@ -224,7 +237,16 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         def slot(key: str, class_map: Mapping[str, type]) -> tuple[str, Any]:
             spec = variant.get(key)
             if spec is None:
-                name = "" if "" in class_map else next(iter(sorted(class_map)), "")
+                # omitted slot: unambiguous only for single-component maps
+                if "" in class_map:
+                    name = ""
+                elif len(class_map) == 1:
+                    name = next(iter(class_map))
+                else:
+                    raise ValueError(
+                        f"engine.json omits {key!r} but the engine has multiple "
+                        f"{key} components {sorted(class_map)}; specify one by name"
+                    )
                 cls = class_map.get(name)
                 default = params_from_json(cls.params_class, None) if cls else None
                 return (name, default)
@@ -248,12 +270,18 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
             cls = self.algorithm_class_map[name]
             algorithms.append((name, params_from_json(cls.params_class, spec.get("params"))))
         if not algorithms:
-            name = "" if "" in self.algorithm_class_map else next(
-                iter(sorted(self.algorithm_class_map)), ""
-            )
-            cls = self.algorithm_class_map.get(name)
-            if cls is not None:
-                algorithms = [(name, params_from_json(cls.params_class, None))]
+            if "" in self.algorithm_class_map:
+                name = ""
+            elif len(self.algorithm_class_map) == 1:
+                name = next(iter(self.algorithm_class_map))
+            else:
+                raise ValueError(
+                    "engine.json omits 'algorithms' but the engine has multiple "
+                    f"algorithm components {sorted(self.algorithm_class_map)}; "
+                    "specify at least one by name"
+                )
+            cls = self.algorithm_class_map[name]
+            algorithms = [(name, params_from_json(cls.params_class, None))]
 
         return EngineParams(
             data_source_params=slot("datasource", self.data_source_class_map),

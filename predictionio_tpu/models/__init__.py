@@ -1,0 +1,10 @@
+"""Model families: the numeric cores behind the engine templates.
+
+These replace the external Spark-MLlib calls in the reference's templates
+(e.g. mllib.recommendation.ALS at tests/pio_tests/engines/
+recommendation-engine/src/main/scala/ALSAlgorithm.scala:79-85 and
+mllib.classification.NaiveBayes at examples/scala-parallel-classification/
+.../NaiveBayesAlgorithm.scala:33-43) with in-tree JAX implementations
+designed for the MXU: one-hot matmuls, batched Cholesky solves, top-k over
+score matmuls.
+"""

@@ -1,0 +1,3 @@
+"""Numeric kernels: the in-tree replacement for the reference's external
+MLlib dependency (SURVEY.md §2 "Native components: NONE" note — the TPU
+build implements the compute kernels as in-tree JAX/XLA code)."""

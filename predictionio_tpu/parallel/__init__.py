@@ -1,0 +1,22 @@
+"""Mesh/sharding utilities — the distribution substrate.
+
+Replaces the role Spark played in the reference (SURVEY.md §2.6): data
+parallelism via arrays sharded over the ``data`` mesh axis, model/embedding
+sharding over the ``model`` axis, XLA collectives instead of shuffles.
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    data_sharding,
+    model_sharding,
+    pad_to_multiple,
+    replicated,
+    shard_put,
+)
+
+__all__ = [
+    "data_sharding",
+    "model_sharding",
+    "pad_to_multiple",
+    "replicated",
+    "shard_put",
+]

@@ -1,0 +1,72 @@
+"""Sharding helpers over a 2D ("data", "model") mesh.
+
+Conventions (see workflow/context.EngineContext): batch-like dimensions
+shard over ``data``; embedding-table rows shard over ``model``. Ragged
+host data is padded to a multiple of the axis size before device_put so
+shapes stay static under jit (SURVEY.md §7 hard-parts: recompilation
+control lives at this boundary).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1, axis: int = 0) -> NamedSharding:
+    """Shard dimension ``axis`` over the "data" mesh axis."""
+    spec = [None] * ndim
+    spec[axis] = "data"
+    return NamedSharding(mesh, P(*spec))
+
+
+def model_sharding(mesh: Mesh, ndim: int = 2, axis: int = 0) -> NamedSharding:
+    """Shard dimension ``axis`` over the "model" mesh axis (embedding rows)."""
+    spec = [None] * ndim
+    spec[axis] = "model"
+    return NamedSharding(mesh, P(*spec))
+
+
+def pad_to_multiple(
+    array: np.ndarray, multiple: int, axis: int = 0, fill: Any = 0
+) -> tuple[np.ndarray, int]:
+    """Pad ``array`` along ``axis`` to the next multiple; returns
+    (padded, original_length)."""
+    n = array.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple if n else multiple
+    if target == n:
+        return array, n
+    pad_width = [(0, 0)] * array.ndim
+    pad_width[axis] = (0, target - n)
+    return np.pad(array, pad_width, constant_values=fill), n
+
+
+def shard_put(
+    array: np.ndarray, mesh: Mesh, axis: int = 0, mesh_axis: str = "data"
+) -> jax.Array:
+    """device_put a host array sharded along one mesh axis (the
+    TableInputFormat/JdbcRDD -> executor-partition analogue)."""
+    spec = [None] * array.ndim
+    spec[axis] = mesh_axis
+    return jax.device_put(array, NamedSharding(mesh, P(*spec)))
+
+
+def shard_batch(
+    arrays: Sequence[np.ndarray], mesh: Mesh, fill: Any = 0
+) -> tuple[list[jax.Array], int]:
+    """Pad a set of equal-length host arrays to the data-axis multiple and
+    shard them; returns (device arrays, original length)."""
+    axis_size = mesh.shape["data"]
+    out = []
+    n = arrays[0].shape[0]
+    for a in arrays:
+        padded, _ = pad_to_multiple(a, axis_size, fill=fill)
+        out.append(shard_put(padded, mesh))
+    return out, n

@@ -1,0 +1,4 @@
+"""Official engine templates — the four template families of the reference
+(SURVEY.md §2.8): classification (NaiveBayes), recommendation (ALS),
+similarproduct (ALS item similarity), ecommercerecommendation (ALS with
+business rules)."""

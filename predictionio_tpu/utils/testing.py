@@ -1,0 +1,33 @@
+"""Host-platform helpers for virtual-mesh testing.
+
+This box's sitecustomize registers a TPU backend and programmatically
+sets jax_platforms, which beats JAX_PLATFORMS env config; tests and
+dry-runs that need an n-device virtual CPU mesh must force the platform
+back after import.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int) -> None:
+    """Make jax see ``n`` virtual CPU devices, even if a TPU platform was
+    pre-registered. Must run before any jax computation in this process
+    (safe to call after `import jax`)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()

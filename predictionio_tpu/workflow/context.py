@@ -110,6 +110,21 @@ class EngineContext:
                         dict(zip(names, sizes)), total, devices[0].platform)
         return self._mesh
 
+    @property
+    def mesh_if_parallel(self):
+        """The mesh when it spans >1 device, else None — single-chip runs
+        should take the plain jit path (same math, no partitioner
+        overhead; algorithms pass this to their kernels)."""
+        import jax
+
+        devices = list(self._devices) if self._devices else jax.devices()
+        if len(devices) <= 1:
+            return None
+        mesh = self.mesh
+        if math.prod(mesh.devices.shape) <= 1:  # explicit 1-device axis spec
+            return None
+        return mesh
+
     def with_axes(self, **axes: int) -> "EngineContext":
         """A context whose mesh uses an explicit axis spec."""
         wp = dataclasses.replace(

@@ -26,6 +26,14 @@ def load_variant(path: str = "engine.json") -> dict[str, Any]:
 
 
 def mesh_conf_from_variant(variant: dict[str, Any]) -> dict[str, Any]:
-    """Accept either the native "meshConf" key or a legacy "sparkConf"
-    subtree (ignored with a note) for drop-in engine.json compatibility."""
+    """Accept the native "meshConf" key; a legacy "sparkConf" subtree from
+    a ported reference engine.json is ignored with a logged note."""
+    import logging
+
+    if "sparkConf" in variant and "meshConf" not in variant:
+        logging.getLogger(__name__).warning(
+            "engine.json has a 'sparkConf' subtree, which this framework does "
+            "not use; configure the device mesh via 'meshConf' "
+            "(e.g. {\"axes\": {\"data\": 4, \"model\": 2}})"
+        )
     return dict(variant.get("meshConf", {}))

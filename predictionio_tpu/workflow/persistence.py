@@ -39,15 +39,34 @@ class _Envelope:
 
 
 def _to_host(obj: Any) -> Any:
-    """Pull any jax arrays in a pytree to numpy for portable pickling."""
+    """Pull any jax arrays to numpy for portable pickling.
+
+    Walks generic containers AND plain dataclasses — dataclass models are
+    the framework convention but are pytree *leaves* to jax, so
+    tree_map/device_get alone would skip the arrays inside them.
+    """
     try:
         import jax
-
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_get(x) if isinstance(x, jax.Array) else x, obj
-        )
+        import numpy as _np
     except ImportError:  # pure-host install
         return obj
+
+    def walk(x: Any) -> Any:
+        if isinstance(x, jax.Array):
+            return _np.asarray(jax.device_get(x))
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            changes = {
+                f.name: walk(getattr(x, f.name)) for f in dataclasses.fields(x)
+            }
+            return dataclasses.replace(x, **changes)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            out = [walk(v) for v in x]
+            return type(x)(out) if not isinstance(x, tuple) else tuple(out)
+        return x
+
+    return walk(obj)
 
 
 def serialize_models(persisted: Sequence[Any]) -> bytes:

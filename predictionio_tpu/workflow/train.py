@@ -20,7 +20,12 @@ import traceback
 from datetime import datetime, timezone
 from typing import Any, Mapping
 
-from predictionio_tpu.controller.engine import Engine, resolve_engine_factory
+from predictionio_tpu.controller.engine import (
+    Engine,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    resolve_engine_factory,
+)
 from predictionio_tpu.controller.params import EngineParams, params_to_json
 from predictionio_tpu.storage.base import EngineInstance
 from predictionio_tpu.storage.registry import Storage
@@ -101,7 +106,17 @@ def run_train(
     logger.info("engine instance %s: INIT", instance_id)
 
     try:
-        result = engine.train(ctx, engine_params)
+        try:
+            result = engine.train(ctx, engine_params)
+        except (StopAfterReadInterruption, StopAfterPrepareInterruption) as stop:
+            # deliberate debug early-exit, not a failure
+            # (reference: CreateWorkflow catches these cleanly)
+            interrupted = dataclasses.replace(
+                instances.get(instance_id), status="INTERRUPTED", completion_time=_now()
+            )
+            instances.update(interrupted)
+            logger.info("engine instance %s: INTERRUPTED (%s)", instance_id, stop)
+            return TrainOutcome(instance_id, "INTERRUPTED", [])
         save_models(storage, instance_id, result.persisted)
         completed = dataclasses.replace(
             instances.get(instance_id),

@@ -1,0 +1,125 @@
+"""Classification template end-to-end: events -> train -> deploy -> query.
+
+The template-level analogue of the reference's quickstart integration test
+(tests/pio_tests/scenarios/quickstart_test.py) for the classification
+family (examples/scala-parallel-classification)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.templates.classification import Query, engine_factory
+from predictionio_tpu.workflow.context import EngineContext
+from predictionio_tpu.workflow.persistence import load_models
+from predictionio_tpu.workflow.train import run_train
+
+MEM_ENV = {
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+}
+
+
+@pytest.fixture
+def storage_with_events():
+    storage = Storage(MEM_ENV)
+    app_id = storage.get_meta_data_apps().insert(App(0, "ClassApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(7)
+    # multinomial NB discriminates on feature *proportions*: give the two
+    # classes opposite attr profiles
+    for i in range(60):
+        label = "premium" if i % 2 == 0 else "free"
+        profile = (9.0, 3.0, 0.5) if label == "premium" else (0.5, 3.0, 9.0)
+        attrs = rng.poisson(profile)
+        events.insert(
+            Event(
+                event="$set",
+                entity_type="user",
+                entity_id=f"u{i}",
+                properties=DataMap(
+                    {
+                        "attr0": float(attrs[0]),
+                        "attr1": float(attrs[1]),
+                        "attr2": float(attrs[2]),
+                        "plan": label,
+                    }
+                ),
+            ),
+            app_id,
+        )
+    return storage
+
+
+VARIANT = {
+    "id": "classification",
+    "engineFactory": "predictionio_tpu.templates.classification.engine_factory",
+    "datasource": {
+        "params": {"app_name": "ClassApp", "attrs": ["attr0", "attr1", "attr2"],
+                    "label": "plan"}
+    },
+    "algorithms": [{"name": "naive", "params": {"smoothing": 1.0, "use_mesh": True}}],
+}
+
+
+def test_train_deploy_query(storage_with_events):
+    storage = storage_with_events
+    outcome = run_train(variant=VARIANT, storage=storage)
+    assert outcome.status == "COMPLETED"
+
+    # deploy path: reload from storage, answer queries
+    engine = engine_factory()
+    inst = storage.get_meta_data_engine_instances().get(outcome.instance_id)
+    ep = engine.params_from_instance_json(
+        inst.data_source_params, inst.preparator_params,
+        inst.algorithms_params, inst.serving_params,
+    )
+    ctx = EngineContext(storage=storage)
+    models = engine.prepare_deploy(ctx, ep, load_models(storage, outcome.instance_id))
+    _, _, algos, serving = engine.make_components(ep)
+
+    q_premium = serving.supplement(Query(attrs=(9.0, 3.0, 0.0)))
+    q_free = serving.supplement(Query(attrs=(0.0, 3.0, 9.0)))
+    p1 = serving.serve(q_premium, [a.predict(m, q_premium) for a, m in zip(algos, models)])
+    p2 = serving.serve(q_free, [a.predict(m, q_free) for a, m in zip(algos, models)])
+    assert p1.label == "premium"
+    assert p2.label == "free"
+    assert set(p1.scores) == {"premium", "free"}
+
+
+def test_eval_readout(storage_with_events):
+    storage = storage_with_events
+    engine = engine_factory()
+    variant = {
+        **VARIANT,
+        "datasource": {
+            "params": {**VARIANT["datasource"]["params"], "eval_k": 3}
+        },
+    }
+    ep = engine.params_from_variant_json(variant)
+    ctx = EngineContext(storage=storage)
+    results = engine.eval(ctx, ep)
+    assert len(results) == 3
+    correct = total = 0
+    for ei, fold in results:
+        for q, p, a in fold:
+            total += 1
+            correct += int(p.label == a)
+    assert total == 60
+    assert correct / total > 0.85  # separable classes
+
+
+def test_empty_app_fails_sanity(storage_with_events):
+    storage = storage_with_events
+    storage.get_meta_data_apps().insert(App(0, "EmptyApp"))
+    variant = {
+        **VARIANT,
+        "datasource": {"params": {**VARIANT["datasource"]["params"], "app_name": "EmptyApp"}},
+    }
+    with pytest.raises(ValueError, match="empty"):
+        run_train(variant=variant, storage=storage)

@@ -1,0 +1,93 @@
+"""NaiveBayes kernel tests: correctness vs a pure-numpy oracle, and
+mesh-sharded == single-device (the distributed-equivalence property that
+replaces trusting Spark's aggregate)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models import naive_bayes
+
+
+def numpy_multinomial_nb(features, labels, num_classes, smoothing):
+    n, f = features.shape
+    log_prior = np.zeros(num_classes)
+    log_theta = np.zeros((num_classes, f))
+    for c in range(num_classes):
+        rows = features[labels == c]
+        log_prior[c] = np.log(len(rows) / n)
+        sums = rows.sum(axis=0)
+        log_theta[c] = np.log((sums + smoothing) / (sums.sum() + smoothing * f))
+    return log_prior, log_theta
+
+
+@pytest.fixture
+def dataset():
+    rng = np.random.default_rng(0)
+    n, f, c = 200, 6, 3
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    centers = rng.uniform(1, 10, size=(c, f))
+    features = rng.poisson(centers[labels]).astype(np.float32)
+    return features, labels, c
+
+
+def test_multinomial_matches_numpy_oracle(dataset):
+    features, labels, c = dataset
+    model = naive_bayes.train_multinomial(features, labels, c, smoothing=1.0)
+    log_prior, log_theta = numpy_multinomial_nb(features, labels, c, 1.0)
+    np.testing.assert_allclose(np.asarray(model.log_prior), log_prior, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(model.log_theta), log_theta, rtol=1e-4)
+
+
+def test_multinomial_mesh_equals_single_device(dataset, mesh8):
+    features, labels, c = dataset
+    single = naive_bayes.train_multinomial(features, labels, c)
+    sharded = naive_bayes.train_multinomial(features, labels, c, mesh=mesh8)
+    np.testing.assert_allclose(
+        np.asarray(single.log_theta), np.asarray(sharded.log_theta), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.log_prior), np.asarray(sharded.log_prior), rtol=1e-5
+    )
+
+
+def test_multinomial_mesh_with_ragged_length(mesh8):
+    """n not divisible by the data axis: padding must not change counts."""
+    rng = np.random.default_rng(1)
+    n = 37  # not a multiple of 8
+    features = rng.poisson(3, size=(n, 4)).astype(np.float32)
+    labels = rng.integers(0, 2, size=n).astype(np.int32)
+    single = naive_bayes.train_multinomial(features, labels, 2)
+    sharded = naive_bayes.train_multinomial(features, labels, 2, mesh=mesh8)
+    np.testing.assert_allclose(
+        np.asarray(single.log_theta), np.asarray(sharded.log_theta), rtol=1e-5
+    )
+
+
+def test_multinomial_predictions_recover_structure(dataset):
+    features, labels, c = dataset
+    model = naive_bayes.train_multinomial(features, labels, c)
+    preds = naive_bayes.predict_multinomial(model, features)
+    assert (preds == labels).mean() > 0.8  # poisson clusters are separable
+
+
+def test_categorical_counts_and_unseen():
+    # feature 0: value==label exactly; feature 1: constant (uninformative)
+    features = np.array([[0, 1], [1, 1], [0, 1], [1, 1]], dtype=np.int32)
+    labels = np.array([0, 1, 0, 1], dtype=np.int32)
+    model = naive_bayes.train_categorical(features, labels, num_classes=2, num_values=3)
+    preds = naive_bayes.predict_categorical(model, features)
+    np.testing.assert_array_equal(preds, labels)
+    # unseen value (-1) falls back to default score, still predicts via prior
+    p = naive_bayes.predict_categorical(model, np.array([[-1, -1]], dtype=np.int32))
+    assert p.shape == (1,)
+
+
+def test_categorical_mesh_equals_single(mesh8):
+    rng = np.random.default_rng(2)
+    features = rng.integers(0, 5, size=(50, 3)).astype(np.int32)
+    labels = rng.integers(0, 4, size=50).astype(np.int32)
+    single = naive_bayes.train_categorical(features, labels, 4, 5)
+    sharded = naive_bayes.train_categorical(features, labels, 4, 5, mesh=mesh8)
+    np.testing.assert_allclose(
+        np.asarray(single.log_likelihood), np.asarray(sharded.log_likelihood), rtol=1e-5
+    )

@@ -91,3 +91,59 @@ def test_save_model_false(storage):
     )
     persisted = load_models(storage, outcome.instance_id)
     assert persisted == [None, None]
+
+
+def test_stop_after_read_marks_interrupted(storage):
+    from predictionio_tpu.workflow.context import WorkflowParams
+
+    outcome = run_train(
+        engine=make_engine(),
+        engine_params=default_params(),
+        workflow_params=WorkflowParams(stop_after_read=True),
+        storage=storage,
+    )
+    assert outcome.status == "INTERRUPTED"
+    inst = storage.get_meta_data_engine_instances().get(outcome.instance_id)
+    assert inst.status == "INTERRUPTED"
+
+
+import dataclasses as _dc
+
+
+@_dc.dataclass
+class JaxModel:
+    weights: object
+    nested: dict
+
+
+class JaxAlgo:
+    """Defined at module level so pickle can resolve the model class."""
+
+    def __new__(cls):
+        from predictionio_tpu.controller import HostModelAlgorithm
+
+        class _Algo(HostModelAlgorithm):
+            def train(self, ctx, pd):
+                import jax.numpy as jnp
+
+                return JaxModel(weights=jnp.ones((3,)), nested={"b": jnp.zeros((2,))})
+
+            def predict(self, model, query):
+                return float(model.weights.sum())
+
+        return _Algo
+
+
+def test_dataclass_model_with_jax_arrays_persists_portably(storage):
+    """HostModelAlgorithm models are dataclasses holding jax arrays; the
+    persisted blob must contain numpy, not device arrays."""
+    import numpy as np
+
+    from predictionio_tpu.controller import Engine, FirstServing, IdentityPreparator
+    from tests.sample_engine import SampleDataSource
+
+    engine = Engine(SampleDataSource, IdentityPreparator, JaxAlgo(), FirstServing)
+    outcome = run_train(engine=engine, variant={"id": "jax-model"}, storage=storage)
+    persisted = load_models(storage, outcome.instance_id)
+    assert isinstance(persisted[0].weights, np.ndarray)
+    assert isinstance(persisted[0].nested["b"], np.ndarray)
