@@ -168,19 +168,10 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
             # "Unit model -> retrain on deploy" (Engine.scala:211-229).
             # save_model=False: deploy-time retrain must not redo (or
             # overwrite) persistence work.
-            import dataclasses as _dc
-
-            from predictionio_tpu.workflow.context import EngineContext
-
             logger.info("some models were not persisted; retraining for deploy")
-            no_save_ctx = EngineContext(
-                workflow_params=_dc.replace(ctx.workflow_params, save_model=False),
-                storage=ctx._storage,
-                mesh=ctx._mesh,
-                seed=ctx._seed,
-                devices=ctx._devices,
+            retrained = self.train(
+                ctx.with_workflow_params(save_model=False), engine_params
             )
-            retrained = self.train(no_save_ctx, engine_params)
         for i, (algo, blob) in enumerate(zip(algorithms, persisted)):
             if blob is None:
                 models.append(retrained.models[i])

@@ -1,0 +1,180 @@
+"""ALS model object shared by the recommendation-family templates.
+
+Holds the trained factor tables plus the entity-id ↔ dense-index maps and
+per-user seen-item lists needed at serving time. Parity: the `ALSModel`
+case classes of the reference templates (reference: tests/pio_tests/
+engines/recommendation-engine/src/main/scala/ALSAlgorithm.scala:30-38 and
+examples/scala-parallel-similarproduct/.../ALSAlgorithm.scala) which
+bundle userFeatures/productFeatures RDDs with the BiMaps.
+
+Serving-time design: factors stay resident as jax.Arrays between
+requests (no per-query transfer) and queries are answered by the jitted
+fixed-shape kernels in ops/topk — the "models resident in HBM, no
+per-query recompile" requirement of SURVEY.md §7 step 7.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.ops import topk as topk_ops
+from predictionio_tpu.utils.bimap import BiMap, EntityIdIxMap
+
+# serving-time pad length for seen-item lists: one compiled kernel shape
+_SEEN_PAD = 512
+
+
+@dataclasses.dataclass
+class ALSModel:
+    """Factors + id maps + seen lists; device-resident while serving."""
+
+    rank: int
+    user_factors: jax.Array            # (U, K)
+    item_factors: jax.Array            # (I, K)
+    user_ids: EntityIdIxMap
+    item_ids: EntityIdIxMap
+    seen_by_user: Mapping[int, np.ndarray]  # user ix -> seen item ix array
+
+    # ---- single-query serving ------------------------------------------
+    def recommend(
+        self,
+        user_id: str,
+        num: int,
+        allow: np.ndarray | None = None,
+        exclude_seen: bool = True,
+    ) -> list[tuple[str, float]]:
+        """Top-``num`` unseen items for one user; [] for unknown users
+        (the reference template's behavior for users absent from training)."""
+        uix = self.user_ids.get(user_id)
+        if uix is None:
+            return []
+        seen = (
+            self.seen_by_user.get(uix, np.empty(0, dtype=np.int32))
+            if exclude_seen
+            else np.empty(0, dtype=np.int32)
+        )
+        seen = seen[:_SEEN_PAD]
+        cols = np.zeros((1, _SEEN_PAD), dtype=np.int32)
+        mask = np.zeros((1, _SEEN_PAD), dtype=np.float32)
+        cols[0, : len(seen)] = seen
+        mask[0, : len(seen)] = 1.0
+        allow_v = (
+            jnp.asarray(allow, dtype=jnp.float32)
+            if allow is not None
+            else jnp.ones((self.item_factors.shape[0],), dtype=jnp.float32)
+        )
+        k = min(_serving_k(num), self.item_factors.shape[0])
+        vals, idxs = topk_ops.recommend_topk(
+            self.user_factors[jnp.asarray([uix])],
+            self.item_factors,
+            jnp.asarray(cols),
+            jnp.asarray(mask),
+            allow_v,
+            k,
+        )
+        return self._gather_results(vals[0], idxs[0], num)
+
+    def similar(
+        self,
+        item_id_list: Sequence[str],
+        num: int,
+        allow: np.ndarray | None = None,
+    ) -> list[tuple[str, float]]:
+        """Top-``num`` items most similar (cosine) to the query items —
+        the similarproduct template's query contract; unknown items are
+        skipped, all-unknown queries return []."""
+        ixs = [self.item_ids.get(i) for i in item_id_list]
+        ixs = [i for i in ixs if i is not None]
+        if not ixs:
+            return []
+        qvec = jnp.mean(self.item_factors[jnp.asarray(ixs)], axis=0, keepdims=True)
+        pad = _SEEN_PAD
+        cols = np.zeros((1, pad), dtype=np.int32)
+        mask = np.zeros((1, pad), dtype=np.float32)
+        cols[0, : len(ixs)] = np.asarray(ixs[:pad], dtype=np.int32)
+        mask[0, : len(ixs)] = 1.0
+        allow_v = (
+            jnp.asarray(allow, dtype=jnp.float32)
+            if allow is not None
+            else jnp.ones((self.item_factors.shape[0],), dtype=jnp.float32)
+        )
+        k = min(_serving_k(num), self.item_factors.shape[0])
+        vals, idxs = topk_ops.similar_topk(
+            qvec, self.item_factors, jnp.asarray(cols), jnp.asarray(mask),
+            allow_v, k,
+        )
+        return self._gather_results(vals[0], idxs[0], num)
+
+    def predict_rating(self, user_id: str, item_id: str) -> float | None:
+        uix = self.user_ids.get(user_id)
+        iix = self.item_ids.get(item_id)
+        if uix is None or iix is None:
+            return None
+        return float(
+            jnp.dot(self.user_factors[uix], self.item_factors[iix])
+        )
+
+    def _gather_results(
+        self, vals: jax.Array, idxs: jax.Array, num: int
+    ) -> list[tuple[str, float]]:
+        vals = np.asarray(vals)
+        idxs = np.asarray(idxs)
+        inv = self.item_ids.inverse
+        out = []
+        for v, i in zip(vals[:num], idxs[:num]):
+            if not np.isfinite(v):
+                break  # masked slots sort last; stop at the first -inf
+            out.append((inv[int(i)], float(v)))
+        return out
+
+    # ---- persistence ----------------------------------------------------
+    def save(self, directory: str) -> None:
+        """np.savez factors + JSON id maps — the orbax-style checkpoint
+        for this model family (single-host layout)."""
+        os.makedirs(directory, exist_ok=True)
+        np.savez(
+            os.path.join(directory, "factors.npz"),
+            user=np.asarray(self.user_factors),
+            item=np.asarray(self.item_factors),
+        )
+        meta = {
+            "rank": self.rank,
+            "user_ids": self.user_ids.id_to_ix.to_dict(),
+            "item_ids": self.item_ids.id_to_ix.to_dict(),
+            "seen": {str(k): np.asarray(v).tolist() for k, v in self.seen_by_user.items()},
+        }
+        with open(os.path.join(directory, "model.json"), "w") as f:
+            json.dump(meta, f)
+
+    @staticmethod
+    def load(directory: str) -> "ALSModel":
+        data = np.load(os.path.join(directory, "factors.npz"))
+        with open(os.path.join(directory, "model.json")) as f:
+            meta = json.load(f)
+        return ALSModel(
+            rank=int(meta["rank"]),
+            user_factors=jnp.asarray(data["user"]),
+            item_factors=jnp.asarray(data["item"]),
+            user_ids=EntityIdIxMap(BiMap({k: int(v) for k, v in meta["user_ids"].items()})),
+            item_ids=EntityIdIxMap(BiMap({k: int(v) for k, v in meta["item_ids"].items()})),
+            seen_by_user={
+                int(k): np.asarray(v, dtype=np.int32)
+                for k, v in meta["seen"].items()
+            },
+        )
+
+
+def _serving_k(k: int) -> int:
+    """Round k up to a small fixed menu so serving never retraces on a new
+    ``num`` (SURVEY.md §7 hard-parts: fixed top-k buckets)."""
+    for cap in (10, 20, 50, 100, 500):
+        if k <= cap:
+            return cap
+    return k

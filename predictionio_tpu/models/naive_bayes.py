@@ -33,9 +33,6 @@ class MultinomialNBModel:
     log_prior: jax.Array
     log_theta: jax.Array
 
-    def tree_flatten(self):
-        return (self.log_prior, self.log_theta), None
-
 
 @partial(jax.jit, static_argnames=("num_classes",))
 def _multinomial_counts(features, labels, sample_mask, num_classes: int):
@@ -50,12 +47,45 @@ def _multinomial_counts(features, labels, sample_mask, num_classes: int):
 @partial(jax.jit, static_argnames=())
 def _multinomial_finalize(class_counts, feature_sums, smoothing):
     num_features = feature_sums.shape[1]
-    log_prior = jnp.log(class_counts) - jnp.log(jnp.sum(class_counts))
+    num_classes = class_counts.shape[0]
+    # MLlib parity: smoothed priors log(n_c + λ) - log(N + C·λ), so a
+    # class absent from a split gets a finite prior
+    log_prior = jnp.log(class_counts + smoothing) - jnp.log(
+        jnp.sum(class_counts) + smoothing * num_classes
+    )
     smoothed = feature_sums + smoothing
     log_theta = jnp.log(smoothed) - jnp.log(
         jnp.sum(feature_sums, axis=1, keepdims=True) + smoothing * num_features
     )
     return log_prior, log_theta
+
+
+# sharded jit wrappers cached per mesh: jit caches compiled executables on
+# the wrapper object, so rebuilding the wrapper per call would retrace and
+# recompile every training call (30-120s each on the remote TPU path)
+_SHARDED_FN_CACHE: dict = {}
+
+
+def _sharded_fn(mesh: Mesh, kind: str):
+    key = (mesh, kind)
+    if key not in _SHARDED_FN_CACHE:
+        fn, statics = {
+            "multinomial": (_multinomial_counts.__wrapped__, ("num_classes",)),
+            "categorical": (
+                _categorical_counts.__wrapped__, ("num_classes", "num_values")
+            ),
+        }[kind]
+        _SHARDED_FN_CACHE[key] = jax.jit(
+            fn,
+            static_argnames=statics,
+            in_shardings=(
+                data_sharding(mesh, 2),
+                data_sharding(mesh, 1),
+                data_sharding(mesh, 1),
+            ),
+            out_shardings=replicated(mesh),
+        )
+    return _SHARDED_FN_CACHE[key]
 
 
 def train_multinomial(
@@ -77,16 +107,7 @@ def train_multinomial(
         mask_host = np.ones(len(labels), dtype=np.float32)
         arrays, _ = shard_batch([features, labels, mask_host], mesh)
         f, l, mask = arrays
-        counts_fn = jax.jit(
-            _multinomial_counts.__wrapped__,
-            static_argnames=("num_classes",),
-            in_shardings=(
-                data_sharding(mesh, 2),
-                data_sharding(mesh, 1),
-                data_sharding(mesh, 1),
-            ),
-            out_shardings=replicated(mesh),
-        )
+        counts_fn = _sharded_fn(mesh, "multinomial")
         class_counts, feature_sums = counts_fn(f, l, mask, num_classes)
     else:
         # accept device-resident jax arrays without a host round-trip
@@ -155,16 +176,7 @@ def train_categorical(
     if mesh is not None:
         arrays, _ = shard_batch([features, labels, mask_host], mesh)
         f, l, mask = arrays
-        counts_fn = jax.jit(
-            _categorical_counts.__wrapped__,
-            static_argnames=("num_classes", "num_values"),
-            in_shardings=(
-                data_sharding(mesh, 2),
-                data_sharding(mesh, 1),
-                data_sharding(mesh, 1),
-            ),
-            out_shardings=replicated(mesh),
-        )
+        counts_fn = _sharded_fn(mesh, "categorical")
         class_counts, counts = counts_fn(f, l, mask, num_classes, num_values)
     else:
         class_counts, counts = _categorical_counts(

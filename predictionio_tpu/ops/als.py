@@ -1,0 +1,345 @@
+"""Alternating Least Squares on the MXU — the framework's north-star kernel.
+
+Replaces: org.apache.spark.mllib.recommendation.ALS as invoked by the
+reference's recommendation templates (reference: tests/pio_tests/engines/
+recommendation-engine/src/main/scala/ALSAlgorithm.scala:79-85 and
+examples/scala-parallel-{recommendation,similarproduct,
+ecommercerecommendation}). Supports explicit ratings (ALS-WR weighted-λ
+regularization) and implicit feedback (Hu-Koren-Volinsky confidence
+weighting), like MLlib's `ALS.train` / `ALS.trainImplicit`.
+
+TPU-first design (NOT a translation of MLlib's block solver):
+
+- **Bucketed dense layout.** Ratings are grouped per row (user for the
+  user half-step, item for the item half-step) and padded to power-of-two
+  lengths, rows of similar degree sharing a bucket. Each bucket is a dense
+  ``(rows, pad_len)`` slab, so the normal-equation build
+  ``A_u = Σ v_i v_iᵀ`` is one batched matmul ``einsum('blk,blm->bkm')``
+  that tiles straight onto the MXU — no scatter/segment ops, which are
+  slow on TPU. Padding waste is bounded by the bucket growth factor.
+- **Static shapes.** Bucket shapes are the only compile keys; iteration
+  count, λ, α are runtime values. lax.scan over fixed-size slabs bounds
+  HBM usage regardless of dataset size.
+- **Batched Cholesky.** Per-row K×K systems are solved with
+  ``jnp.linalg.cholesky`` + two batched triangular solves (vmapped by
+  construction), keeping the solve on-device.
+- **Mesh sharding.** Slab row dimensions carry a NamedSharding over the
+  "data" mesh axis while factor tables stay replicated; XLA inserts the
+  all-gathers/psums on ICI — the analogue of MLlib's block shuffles,
+  without the shuffle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Host-side layout: COO ratings -> padded per-row buckets
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RatingsCOO:
+    """Host ratings triple; rows/cols are dense indices (see utils.bimap)."""
+
+    rows: np.ndarray  # int32 (R,)
+    cols: np.ndarray  # int32 (R,)
+    vals: np.ndarray  # float32 (R,)
+    num_rows: int
+    num_cols: int
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    def transpose(self) -> "RatingsCOO":
+        return RatingsCOO(self.cols, self.rows, self.vals, self.num_cols, self.num_rows)
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """All rows whose degree pads to ``pad_len``: dense (n, pad_len) slabs."""
+
+    row_ids: np.ndarray  # int32 (n,) original row indices
+    cols: np.ndarray     # int32 (n, pad_len)
+    vals: np.ndarray     # float32 (n, pad_len)
+    mask: np.ndarray     # float32 (n, pad_len) 1=real, 0=pad
+
+    @property
+    def pad_len(self) -> int:
+        return int(self.cols.shape[1])
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketedRatings:
+    buckets: tuple[Bucket, ...]
+    num_rows: int
+    num_cols: int
+    nnz: int
+
+
+def bucket_rows(
+    coo: RatingsCOO, min_len: int = 8, growth: int = 2, max_len: int | None = None
+) -> BucketedRatings:
+    """Group ratings by row into padded power-of-``growth`` buckets.
+
+    ``max_len`` caps a row's kept ratings (highest-value kept) — the
+    recompile-control knob for pathological heavy rows.
+    """
+    order = np.argsort(coo.rows, kind="stable")
+    rows = coo.rows[order]
+    cols = coo.cols[order]
+    vals = coo.vals[order]
+    uniq, start, counts = np.unique(rows, return_index=True, return_counts=True)
+
+    if max_len is not None:
+        capped = np.minimum(counts, max_len)
+    else:
+        capped = counts
+    # bucket length per unique row: min_len * growth^k >= count
+    lens = np.maximum(capped, min_len)
+    exps = np.ceil(np.log(lens / min_len) / np.log(growth) - 1e-12).astype(np.int64)
+    pad_lens = (min_len * growth ** np.maximum(exps, 0)).astype(np.int64)
+
+    buckets = []
+    for pl in np.unique(pad_lens):
+        sel = np.nonzero(pad_lens == pl)[0]
+        n = len(sel)
+        b_cols = np.zeros((n, pl), dtype=np.int32)
+        b_vals = np.zeros((n, pl), dtype=np.float32)
+        b_mask = np.zeros((n, pl), dtype=np.float32)
+        for j, ui in enumerate(sel):
+            s, c = start[ui], capped[ui]
+            if c < counts[ui]:  # keep the top-valued ratings of a capped row
+                seg = np.argsort(vals[s : s + counts[ui]])[::-1][:c] + s
+            else:
+                seg = slice(s, s + c)
+            b_cols[j, :c] = cols[seg]
+            b_vals[j, :c] = vals[seg]
+            b_mask[j, :c] = 1.0
+        buckets.append(
+            Bucket(uniq[sel].astype(np.int32), b_cols, b_vals, b_mask)
+        )
+    return BucketedRatings(tuple(buckets), coo.num_rows, coo.num_cols, coo.nnz)
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
+
+_HI = jax.lax.Precision.HIGHEST  # normal equations need true f32 accumulation
+
+
+def _cho_solve_batched(A: jax.Array, b: jax.Array) -> jax.Array:
+    """Solve SPD systems A x = b for (..., K, K) / (..., K)."""
+    chol = jnp.linalg.cholesky(A)
+    y = jax.lax.linalg.triangular_solve(
+        chol, b[..., None], left_side=True, lower=True
+    )
+    x = jax.lax.linalg.triangular_solve(
+        chol, y, left_side=True, lower=True, transpose_a=True
+    )
+    return x[..., 0]
+
+
+@partial(jax.jit, static_argnames=("implicit",), donate_argnums=())
+def _solve_slabs(
+    V: jax.Array,      # (num_cols, K) opposite factors, replicated
+    cols: jax.Array,   # (S, B, L) int32
+    vals: jax.Array,   # (S, B, L) f32
+    mask: jax.Array,   # (S, B, L) f32
+    lam: jax.Array,    # scalar f32
+    alpha: jax.Array,  # scalar f32 (implicit only)
+    gram: jax.Array,   # (K, K) VᵀV (implicit only; zeros otherwise)
+    implicit: bool,
+) -> jax.Array:
+    """Per-slab batched normal-equation solve; scan bounds peak memory."""
+    K = V.shape[1]
+    eye = jnp.eye(K, dtype=V.dtype)
+
+    def body(_, xs):
+        c, v, m = xs                    # (B, L)
+        F = V[c]                        # (B, L, K) gather from replicated table
+        if implicit:
+            # Hu-Koren: confidence c_ui = 1 + α r; A = VᵀV + Σ (c-1) v vᵀ + λI
+            w = alpha * v * m           # (c - 1) on observed entries
+            A = jnp.einsum("bl,blk,blm->bkm", w, F, F, precision=_HI)
+            A = A + gram + lam * eye
+            b = jnp.einsum("bl,blk->bk", m + w, F, precision=_HI)
+        else:
+            # ALS-WR: A = Σ v vᵀ + λ n_u I ; b = Σ r v
+            Fm = F * m[..., None]
+            A = jnp.einsum("blk,blm->bkm", Fm, F, precision=_HI)
+            n_u = jnp.sum(m, axis=1)
+            A = A + (lam * n_u)[:, None, None] * eye
+            b = jnp.einsum("bl,blk->bk", v * m, F, precision=_HI)
+        # rows with zero ratings (padding rows): A = λ'I -> x = 0
+        deg = jnp.sum(m, axis=1)
+        A = jnp.where(deg[:, None, None] > 0, A, eye)
+        x = _cho_solve_batched(A, b)
+        x = jnp.where(deg[:, None] > 0, x, 0.0)
+        return None, x
+
+    _, X = jax.lax.scan(body, None, (cols, vals, mask))
+    return X  # (S, B, K)
+
+
+@jax.jit
+def _gramian(V: jax.Array) -> jax.Array:
+    return jnp.einsum("ik,im->km", V, V, precision=_HI)
+
+
+def _slab_shape(
+    n: int, pad_len: int, rank: int, data_axis: int, max_slab_elems: int
+) -> tuple[int, int]:
+    """Pick (num_slabs, slab_rows): slab_rows a multiple of the data-axis
+    size with slab_rows*pad_len*rank <= max_slab_elems."""
+    per_row = pad_len * rank
+    b = max(1, max_slab_elems // per_row)
+    b = max(data_axis, (b // data_axis) * data_axis)
+    b = min(b, ((n + data_axis - 1) // data_axis) * data_axis)
+    s = (n + b - 1) // b
+    return s, b
+
+
+def solve_half(
+    V: jax.Array,
+    bucketed: BucketedRatings,
+    rank: int,
+    lam: float,
+    implicit: bool = False,
+    alpha: float = 40.0,
+    mesh: Mesh | None = None,
+    max_slab_elems: int = 1 << 24,
+) -> jax.Array:
+    """One ALS half-step: solve all row factors given opposite factors V.
+
+    Returns a (num_rows, K) factor table (replicated under ``mesh``);
+    rows with no ratings get zero factors, matching MLlib which simply
+    omits them from the factor RDD.
+    """
+    data_axis = int(mesh.shape["data"]) if mesh is not None else 1
+    lam_a = jnp.float32(lam)
+    alpha_a = jnp.float32(alpha)
+    gram = _gramian(V) if implicit else jnp.zeros((rank, rank), dtype=V.dtype)
+
+    out = jnp.zeros((bucketed.num_rows, rank), dtype=V.dtype)
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        V = jax.device_put(V, rep)
+        out = jax.device_put(out, rep)
+
+    for bucket in bucketed.buckets:
+        n = bucket.row_ids.shape[0]
+        s, b = _slab_shape(n, bucket.pad_len, rank, data_axis, max_slab_elems)
+        total = s * b
+
+        def pad3(a, fill=0):
+            p = np.full((total, a.shape[1]), fill, dtype=a.dtype)
+            p[:n] = a
+            return p.reshape(s, b, a.shape[1])
+
+        cols = pad3(bucket.cols)
+        vals = pad3(bucket.vals)
+        mask = pad3(bucket.mask)
+        if mesh is not None:
+            slab_sh = NamedSharding(mesh, P(None, "data", None))
+            cols, vals, mask = (
+                jax.device_put(x, slab_sh) for x in (cols, vals, mask)
+            )
+        X = _solve_slabs(V, cols, vals, mask, lam_a, alpha_a, gram, implicit)
+        X = X.reshape(total, rank)[:n]
+        out = out.at[jnp.asarray(bucket.row_ids)].set(X)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Training driver
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ALSFactors:
+    user: jax.Array  # (num_users, K)
+    item: jax.Array  # (num_items, K)
+
+
+def als_train(
+    ratings: RatingsCOO,
+    rank: int,
+    iterations: int = 10,
+    lam: float = 0.01,
+    implicit: bool = False,
+    alpha: float = 40.0,
+    seed: int = 0,
+    mesh: Mesh | None = None,
+    min_bucket: int = 8,
+    bucket_growth: int = 2,
+    max_row_len: int | None = None,
+    max_slab_elems: int = 1 << 24,
+) -> ALSFactors:
+    """Full alternating-least-squares training.
+
+    Parity target: `ALS.train(ratings, rank, iterations, lambda)` /
+    `ALS.trainImplicit(..., alpha)` semantics from the reference templates
+    (ALSAlgorithm.scala:79-85); same hyperparameter meanings.
+    """
+    by_user = bucket_rows(ratings, min_bucket, bucket_growth, max_row_len)
+    by_item = bucket_rows(ratings.transpose(), min_bucket, bucket_growth, max_row_len)
+    logger.info(
+        "ALS: %d ratings, %d users (%d buckets), %d items (%d buckets), rank %d",
+        ratings.nnz, ratings.num_rows, len(by_user.buckets),
+        ratings.num_cols, len(by_item.buckets), rank,
+    )
+
+    # MLlib-style init: scaled gaussian item factors, users solved first
+    key = jax.random.PRNGKey(seed)
+    item = jax.random.normal(key, (ratings.num_cols, rank), dtype=jnp.float32)
+    item = item / jnp.sqrt(jnp.float32(rank))
+
+    user = None
+    for it in range(iterations):
+        user = solve_half(item, by_user, rank, lam, implicit, alpha, mesh,
+                          max_slab_elems)
+        item = solve_half(user, by_item, rank, lam, implicit, alpha, mesh,
+                          max_slab_elems)
+    return ALSFactors(user=user, item=item)
+
+
+# ---------------------------------------------------------------------------
+# Prediction helpers
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def predict_ratings(user_f: jax.Array, item_f: jax.Array,
+                    users: jax.Array, items: jax.Array) -> jax.Array:
+    """Pointwise predicted ratings for (user, item) pairs."""
+    return jnp.einsum("nk,nk->n", user_f[users], item_f[items])
+
+
+def rmse(factors: ALSFactors, ratings: RatingsCOO, chunk: int = 1 << 20) -> float:
+    """Root-mean-square error over the rating set, chunked to bound memory."""
+    total = 0.0
+    n = ratings.nnz
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        pred = predict_ratings(
+            factors.user, factors.item,
+            jnp.asarray(ratings.rows[s:e]), jnp.asarray(ratings.cols[s:e]),
+        )
+        err = np.asarray(pred) - ratings.vals[s:e]
+        total += float(np.sum(err * err))
+    return math.sqrt(total / max(n, 1))

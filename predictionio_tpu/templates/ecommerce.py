@@ -1,0 +1,327 @@
+"""E-commerce recommendation template: implicit ALS + business rules.
+
+Parity: examples/scala-parallel-ecommercerecommendation/ — DataSource
+reads view/buy events plus item $set properties; ECommAlgorithm trains
+implicit ALS; queries {user, num, categories?, whiteList?, blackList?}
+are answered with business-rule filtering: seen items, query black/white
+lists, category membership, and "unavailableItems" read live from a
+constraint entity at query time (ECommAlgorithm.scala's
+`predictKnownUser` / filter chain). Unknown users fall back to ranking
+by items similar to their recent views (`predictSimilar` path).
+
+TPU design: every filter becomes a 0/1 eligibility vector multiplied
+into the jitted score+top_k kernel — the rule chain costs one fused
+elementwise op instead of per-item RDD filters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    SanityCheck,
+    ShardedAlgorithm,
+)
+from predictionio_tpu.controller.base import PersistentModelManifest
+from predictionio_tpu.models.als import ALSModel
+from predictionio_tpu.ops.als import RatingsCOO, als_train
+from predictionio_tpu.templates.recommendation import ALSPreparator, TrainingData
+from predictionio_tpu.utils.bimap import EntityIdIxMap
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str = ""
+    num: int = 10
+    categories: tuple | None = None
+    white_list: tuple | None = None
+    black_list: tuple | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommTrainingData(SanityCheck):
+    users: np.ndarray
+    items: np.ndarray
+    weights: np.ndarray
+    categories: dict  # item id -> tuple of categories
+
+    def sanity_check(self) -> None:
+        if len(self.users) == 0:
+            raise ValueError("no view/buy events; ingest events first")
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    view_events: tuple = ("view",)
+    buy_events: tuple = ("buy",)
+    buy_weight: float = 4.0  # buys count more than views in the confidence
+    entity_type: str = "user"
+    target_entity_type: str = "item"
+    item_entity_type: str = "item"
+
+
+class ECommDataSource(DataSource):
+    """Parity: ecommercerecommendation DataSource.scala (viewEvents,
+    buyEvents, items with categories)."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> ECommTrainingData:
+        p = self.params
+        users, items, weights = [], [], []
+        store = ctx.event_store()
+        for names, weight in ((p.view_events, 1.0), (p.buy_events, p.buy_weight)):
+            for ev in store.find(
+                p.app_name,
+                entity_type=p.entity_type,
+                event_names=list(names),
+                target_entity_type=p.target_entity_type,
+            ):
+                if ev.target_entity_id is None:
+                    continue
+                users.append(ev.entity_id)
+                items.append(ev.target_entity_id)
+                weights.append(weight)
+        categories: dict[str, tuple] = {}
+        for item_id, pm in store.aggregate_properties(
+            p.app_name, p.item_entity_type
+        ).items():
+            cats = pm.get_opt("categories")
+            if cats:
+                categories[item_id] = tuple(cats)
+        return ECommTrainingData(
+            users=np.asarray(users, dtype=object),
+            items=np.asarray(items, dtype=object),
+            weights=np.asarray(weights, dtype=np.float32),
+            categories=categories,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommPreparedData:
+    coo: RatingsCOO
+    user_ids: EntityIdIxMap
+    item_ids: EntityIdIxMap
+    seen_by_user: dict
+    categories: dict
+
+
+class ECommPreparator(ALSPreparator):
+    def prepare(self, ctx, td: ECommTrainingData) -> ECommPreparedData:
+        base = super().prepare(
+            ctx,
+            TrainingData(users=td.users, items=td.items, ratings=td.weights),
+        )
+        return ECommPreparedData(
+            coo=base.coo,
+            user_ids=base.user_ids,
+            item_ids=base.item_ids,
+            seen_by_user=base.seen_by_user,
+            categories=td.categories,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    """Parity: ECommAlgorithmParams (appName/unseenOnly/seenEvents/
+    similarEvents/rank/numIterations/lambda/alpha/seed)."""
+
+    app_name: str = ""
+    unseen_only: bool = True
+    similar_events: tuple = ("view",)
+    unavailable_constraint_entity: str = "constraint"
+    unavailable_constraint_id: str = "unavailableItems"
+    recent_events_num: int = 10
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+    use_mesh: bool = True
+
+
+@dataclasses.dataclass
+class ECommModel:
+    als: ALSModel
+    categories: dict
+
+
+class ECommAlgorithm(ShardedAlgorithm):
+    """Implicit ALS + live business-rule filtering.
+
+    Parity: ECommAlgorithm.scala — train:ALS.trainImplicit;
+    predict: known user -> filtered personal top-k, unknown user ->
+    similar-to-recent-views top-k; unavailable items re-read per query.
+    """
+
+    params_class = ECommAlgorithmParams
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self._ctx = None
+
+    def train(self, ctx, pd: ECommPreparedData) -> ECommModel:
+        p = self.params
+        self._ctx = ctx
+        mesh = ctx.mesh_if_parallel if p.use_mesh else None
+        factors = als_train(
+            pd.coo,
+            rank=p.rank,
+            iterations=p.num_iterations,
+            lam=p.lambda_,
+            implicit=True,
+            alpha=p.alpha,
+            seed=p.seed,
+            mesh=mesh,
+        )
+        als = ALSModel(
+            rank=p.rank,
+            user_factors=factors.user,
+            item_factors=factors.item,
+            user_ids=pd.user_ids,
+            item_ids=pd.item_ids,
+            seen_by_user=pd.seen_by_user,
+        )
+        return ECommModel(als=als, categories=pd.categories)
+
+    # -- query-time helpers -------------------------------------------------
+    def _unavailable_items(self) -> set[str]:
+        """Live read of the unavailableItems constraint ($set on a
+        constraint entity — ECommAlgorithm.scala's
+        LEventStore.findByEntity("constraint", "unavailableItems"))."""
+        p = self.params
+        if self._ctx is None or not p.app_name:
+            return set()
+        try:
+            events = list(
+                self._ctx.event_store().find_by_entity(
+                    p.app_name,
+                    p.unavailable_constraint_entity,
+                    p.unavailable_constraint_id,
+                    event_names=["$set"],
+                    limit=1,
+                    latest=True,
+                )
+            )
+        except Exception:
+            return set()
+        if not events:
+            return set()
+        items = events[0].properties.get_opt("items")
+        return set(items) if items else set()
+
+    def _recent_items(self, user: str) -> list[str]:
+        """The user's recent viewed items (for the unknown-user fallback).
+        Parity: ECommAlgorithm's recentEvents query."""
+        p = self.params
+        if self._ctx is None or not p.app_name:
+            return []
+        try:
+            events = self._ctx.event_store().find_by_entity(
+                p.app_name,
+                "user",
+                user,
+                event_names=list(p.similar_events),
+                limit=p.recent_events_num,
+                latest=True,
+            )
+            return [e.target_entity_id for e in events if e.target_entity_id]
+        except Exception:
+            return []
+
+    def _allow_vector(self, model: ECommModel, query: Query) -> np.ndarray:
+        item_ids = model.als.item_ids
+        n = len(item_ids)
+        allow = np.ones(n, dtype=np.float32)
+        if query.categories is not None:
+            wanted = set(query.categories)
+            cat_ok = np.zeros(n, dtype=np.float32)
+            for item_id, cats in model.categories.items():
+                ix = item_ids.get(item_id)
+                if ix is not None and wanted & set(cats):
+                    cat_ok[ix] = 1.0
+            allow *= cat_ok
+        if query.white_list is not None:
+            wl = np.zeros(n, dtype=np.float32)
+            for item_id in query.white_list:
+                ix = item_ids.get(item_id)
+                if ix is not None:
+                    wl[ix] = 1.0
+            allow *= wl
+        for item_id in query.black_list or ():
+            ix = item_ids.get(item_id)
+            if ix is not None:
+                allow[ix] = 0.0
+        for item_id in self._unavailable_items():
+            ix = item_ids.get(item_id)
+            if ix is not None:
+                allow[ix] = 0.0
+        return allow
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        allow = self._allow_vector(model, query)
+        if query.user in model.als.user_ids:
+            recs = model.als.recommend(
+                query.user, query.num, allow=allow,
+                exclude_seen=self.params.unseen_only,
+            )
+        else:
+            recent = self._recent_items(query.user)
+            recs = model.als.similar(recent, query.num, allow=allow) if recent else []
+        return PredictedResult(
+            item_scores=tuple(ItemScore(item=i, score=s) for i, s in recs)
+        )
+
+    def make_persistent_model(self, ctx, model: ECommModel):
+        import json
+        import os
+        import tempfile
+
+        base = os.environ.get(
+            "PIO_MODEL_DIR", os.path.join(tempfile.gettempdir(), "pio_models")
+        )
+        location = os.path.join(base, f"ecomm_{id(model):x}")
+        model.als.save(location)
+        with open(os.path.join(location, "categories.json"), "w") as f:
+            json.dump({k: list(v) for k, v in model.categories.items()}, f)
+        return PersistentModelManifest(
+            class_name=f"{type(self).__module__}.{type(self).__name__}",
+            location=location,
+        )
+
+    def load_model(self, ctx, manifest: PersistentModelManifest) -> ECommModel:
+        import json
+        import os
+
+        self._ctx = ctx
+        als = ALSModel.load(manifest.location)
+        with open(os.path.join(manifest.location, "categories.json")) as f:
+            categories = {k: tuple(v) for k, v in json.load(f).items()}
+        return ECommModel(als=als, categories=categories)
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class_map=ECommDataSource,
+        preparator_class_map=ECommPreparator,
+        algorithm_class_map={"ecomm": ECommAlgorithm, "": ECommAlgorithm},
+        serving_class_map=FirstServing,
+    )

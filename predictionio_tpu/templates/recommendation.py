@@ -1,0 +1,346 @@
+"""Recommendation engine template: ALS over rate/buy events.
+
+Parity: examples/scala-parallel-recommendation/ and the canonical copy at
+tests/pio_tests/engines/recommendation-engine/ — DataSource reads "rate"
+and "buy" events (DataSource.scala:38-105; buy counts as rating 4.0),
+ALSAlgorithm trains MLlib ALS over BiMap-indexed ratings
+(ALSAlgorithm.scala:40-120), queries are {user, num} answered with
+ranked item scores, and readEval provides k-fold splits for Precision@K
+evaluation (Evaluation.scala).
+
+TPU design: the Preparator is the ragged→static boundary (builds dense
+indices + padded rating buckets); the algorithm is a ShardedAlgorithm
+whose factor tables are computed by ops/als on the mesh and stay
+device-resident for serving; top-k ranking is one jitted matmul+top_k
+(ops/topk) instead of per-user RDD sorts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+    ShardedAlgorithm,
+)
+from predictionio_tpu.controller.base import PersistentModelManifest
+from predictionio_tpu.models.als import ALSModel
+from predictionio_tpu.ops import topk as topk_ops
+from predictionio_tpu.ops.als import RatingsCOO, als_train
+from predictionio_tpu.utils.bimap import EntityIdIxMap
+
+
+# ---------------------------------------------------------------------------
+# Data types (Query/PredictedResult parity with the reference template JSON)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingData(SanityCheck):
+    """Raw (user, item, rating) triples as host object arrays."""
+
+    users: np.ndarray
+    items: np.ndarray
+    ratings: np.ndarray
+
+    def sanity_check(self) -> None:
+        if len(self.users) == 0:
+            raise ValueError(
+                "ratings are empty; ingest rate/buy events first "
+                "(reference DataSource.scala sanity: train with events)"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedData:
+    """Dense-index ratings + id maps + per-user seen items: everything the
+    mesh kernels need, all static-shaped."""
+
+    coo: RatingsCOO
+    user_ids: EntityIdIxMap
+    item_ids: EntityIdIxMap
+    seen_by_user: dict[int, np.ndarray]
+
+
+# ---------------------------------------------------------------------------
+# DataSource
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: tuple = ("rate", "buy")
+    buy_rating: float = 4.0  # reference: buy event treated as rating 4
+    entity_type: str = "user"
+    target_entity_type: str = "item"
+    eval_k: int = 0
+    eval_query_num: int = 10
+    seed: int = 3
+
+
+class RecommendationDataSource(DataSource):
+    """Reads rate/buy events into rating triples.
+
+    Parity: recommendation-engine DataSource.scala:38-105 (getRatings:
+    rate -> rating value, buy -> fixed 4.0; latest event wins per pair is
+    NOT applied — the reference keeps all, MLlib averages duplicates;
+    here duplicates are kept and the ALS solve sees each occurrence).
+    """
+
+    params_class = DataSourceParams
+
+    def _ratings(self, ctx) -> TrainingData:
+        p = self.params
+        users, items, ratings = [], [], []
+        for ev in ctx.event_store().find(
+            p.app_name,
+            entity_type=p.entity_type,
+            event_names=list(p.event_names),
+            target_entity_type=p.target_entity_type,
+        ):
+            if ev.target_entity_id is None:
+                continue
+            if ev.event == "rate":
+                try:
+                    rating = float(ev.properties.get("rating"))
+                except (KeyError, TypeError, ValueError):
+                    continue
+            else:  # buy and other implicit signals
+                rating = p.buy_rating
+            users.append(ev.entity_id)
+            items.append(ev.target_entity_id)
+            ratings.append(rating)
+        return TrainingData(
+            users=np.asarray(users, dtype=object),
+            items=np.asarray(items, dtype=object),
+            ratings=np.asarray(ratings, dtype=np.float32),
+        )
+
+    def read_training(self, ctx) -> TrainingData:
+        return self._ratings(ctx)
+
+    def read_eval(self, ctx):
+        """k-fold split of ratings; per-fold queries are the test-fold
+        users, actuals their test-fold items. Parity: DataSource.readEval
+        (DataSource.scala:82-105, zipWithUniqueId % kFold)."""
+        p = self.params
+        full = self._ratings(ctx)
+        n = len(full.users)
+        rng = np.random.default_rng(p.seed)
+        fold_of = rng.integers(0, p.eval_k, size=n)
+        folds = []
+        for k in range(p.eval_k):
+            test = fold_of == k
+            td = TrainingData(
+                users=full.users[~test],
+                items=full.items[~test],
+                ratings=full.ratings[~test],
+            )
+            by_user: dict[str, list[str]] = {}
+            for u, i in zip(full.users[test], full.items[test]):
+                by_user.setdefault(u, []).append(i)
+            qa = [
+                (Query(user=u, num=p.eval_query_num), tuple(items))
+                for u, items in sorted(by_user.items())
+            ]
+            folds.append((td, {"fold": k}, qa))
+        return folds
+
+
+# ---------------------------------------------------------------------------
+# Preparator
+# ---------------------------------------------------------------------------
+
+
+class ALSPreparator(Preparator):
+    """String ids -> dense indices + COO ratings (the BiMap step the
+    reference did inside ALSAlgorithm.train, ALSAlgorithm.scala:46-63,
+    moved to the Preparator where the ragged→static conversion belongs)."""
+
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        user_ids = EntityIdIxMap.from_ids(td.users)
+        item_ids = EntityIdIxMap.from_ids(td.items)
+        rows = user_ids.to_index(td.users)
+        cols = item_ids.to_index(td.items)
+        seen: dict[int, set[int]] = {}
+        for r, c in zip(rows, cols):
+            seen.setdefault(int(r), set()).add(int(c))
+        return PreparedData(
+            coo=RatingsCOO(
+                rows=rows,
+                cols=cols,
+                vals=np.asarray(td.ratings, dtype=np.float32),
+                num_rows=len(user_ids),
+                num_cols=len(item_ids),
+            ),
+            user_ids=user_ids,
+            item_ids=item_ids,
+            seen_by_user={
+                u: np.asarray(sorted(s), dtype=np.int32) for u, s in seen.items()
+            },
+        )
+
+
+# ---------------------------------------------------------------------------
+# Algorithm
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    """Parity: ALSAlgorithmParams (ALSAlgorithm.scala:30-38): rank,
+    numIterations, lambda, seed."""
+
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    seed: int = 3
+    implicit_prefs: bool = False
+    alpha: float = 1.0
+    use_mesh: bool = True
+    exclude_seen: bool = True
+
+
+class ALSAlgorithm(ShardedAlgorithm):
+    """ALS matrix factorization on the device mesh.
+
+    Parity: ALSAlgorithm (ALSAlgorithm.scala:40-120) — MLlib `ALS.train`
+    becomes ops/als.als_train; `model.recommendProducts` becomes the
+    jitted masked top-k.
+    """
+
+    params_class = ALSAlgorithmParams
+
+    def train(self, ctx, pd: PreparedData) -> ALSModel:
+        p = self.params
+        mesh = ctx.mesh_if_parallel if p.use_mesh else None
+        factors = als_train(
+            pd.coo,
+            rank=p.rank,
+            iterations=p.num_iterations,
+            lam=p.lambda_,
+            implicit=p.implicit_prefs,
+            alpha=p.alpha,
+            seed=p.seed,
+            mesh=mesh,
+        )
+        return ALSModel(
+            rank=p.rank,
+            user_factors=factors.user,
+            item_factors=factors.item,
+            user_ids=pd.user_ids,
+            item_ids=pd.item_ids,
+            seen_by_user=pd.seen_by_user,
+        )
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        recs = model.recommend(
+            query.user, query.num, exclude_seen=self.params.exclude_seen
+        )
+        return PredictedResult(
+            item_scores=tuple(ItemScore(item=i, score=s) for i, s in recs)
+        )
+
+    def batch_predict(self, model: ALSModel, queries):
+        """All queries scored in one matmul + top_k — the RDD-join
+        analogue (ALSAlgorithm batchPredict path)."""
+        import jax.numpy as jnp
+
+        if not queries:
+            return []
+        known = [
+            (qi, model.user_ids[q.user], q.num)
+            for qi, q in queries
+            if q.user in model.user_ids
+        ]
+        out = [(qi, PredictedResult()) for qi, q in queries
+               if q.user not in model.user_ids]
+        if not known:
+            return out
+        uixs = np.asarray([u for _, u, _ in known], dtype=np.int32)
+        max_num = max(n for _, _, n in known)
+        pad = 512
+        cols = np.zeros((len(known), pad), dtype=np.int32)
+        mask = np.zeros((len(known), pad), dtype=np.float32)
+        if self.params.exclude_seen:
+            for j, (_, u, _) in enumerate(known):
+                s = model.seen_by_user.get(int(u), np.empty(0, dtype=np.int32))[:pad]
+                cols[j, : len(s)] = s
+                mask[j, : len(s)] = 1.0
+        allow = jnp.ones((model.item_factors.shape[0],), dtype=jnp.float32)
+        k = min(max_num, model.item_factors.shape[0])
+        vals, idxs = topk_ops.recommend_topk(
+            model.user_factors[jnp.asarray(uixs)],
+            model.item_factors,
+            jnp.asarray(cols),
+            jnp.asarray(mask),
+            allow,
+            k,
+        )
+        vals = np.asarray(vals)
+        idxs = np.asarray(idxs)
+        inv = model.item_ids.inverse
+        for j, (qi, _, num) in enumerate(known):
+            scores = []
+            for v, i in zip(vals[j][:num], idxs[j][:num]):
+                if not np.isfinite(v):
+                    break
+                scores.append(ItemScore(item=inv[int(i)], score=float(v)))
+            out.append((qi, PredictedResult(item_scores=tuple(scores))))
+        return out
+
+    # -- persistence: orbax-style directory checkpoint + manifest ----------
+    def make_persistent_model(self, ctx, model: ALSModel):
+        """Unlike the reference's PAlgorithm (forced retrain-on-deploy for
+        RDD models, PAlgorithm.scala:89-101), sharded factors persist via
+        a directory checkpoint + manifest (SURVEY.md §7 hard-parts)."""
+        import os
+        import tempfile
+
+        base = os.environ.get(
+            "PIO_MODEL_DIR", os.path.join(tempfile.gettempdir(), "pio_models")
+        )
+        location = os.path.join(base, f"als_{id(model):x}")
+        model.save(location)
+        return PersistentModelManifest(
+            class_name=f"{type(self).__module__}.{type(self).__name__}",
+            location=location,
+        )
+
+    def load_model(self, ctx, manifest: PersistentModelManifest) -> ALSModel:
+        return ALSModel.load(manifest.location)
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class_map=RecommendationDataSource,
+        preparator_class_map=ALSPreparator,
+        algorithm_class_map={"als": ALSAlgorithm, "": ALSAlgorithm},
+        serving_class_map=FirstServing,
+    )

@@ -1,0 +1,267 @@
+"""Similar-product engine template: ALS item factors + cosine similarity.
+
+Parity: examples/scala-parallel-similarproduct/ — DataSource reads users,
+items and "view" events (DataSource.scala), ALSAlgorithm trains implicit
+ALS and answers {items, num, categories?, whiteList?, blackList?} queries
+with the items most cosine-similar to the query set
+(ALSAlgorithm.scala `similar` / productFeatures cosine ranking).
+
+TPU design: similarity ranking is one jitted normalized matmul + top_k
+over the device-resident item-factor table (ops/topk.similar_topk) —
+no pairwise RDD cartesian.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    SanityCheck,
+    ShardedAlgorithm,
+)
+from predictionio_tpu.controller.base import PersistentModelManifest
+from predictionio_tpu.models.als import ALSModel
+from predictionio_tpu.ops.als import RatingsCOO, als_train
+from predictionio_tpu.templates.recommendation import ALSPreparator, TrainingData
+from predictionio_tpu.utils.bimap import EntityIdIxMap
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    """Parity: similarproduct Query.scala: items, num, categories,
+    whiteList, blackList."""
+
+    items: tuple = ()
+    num: int = 10
+    categories: tuple | None = None
+    white_list: tuple | None = None
+    black_list: tuple | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class SimilarTrainingData(SanityCheck):
+    """View triples + per-item category sets."""
+
+    users: np.ndarray
+    items: np.ndarray
+    ratings: np.ndarray
+    categories: dict  # item id -> tuple of category strings
+
+    def sanity_check(self) -> None:
+        if len(self.users) == 0:
+            raise ValueError("no view events; ingest user-view-item events first")
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: tuple = ("view",)
+    entity_type: str = "user"
+    target_entity_type: str = "item"
+    item_entity_type: str = "item"
+
+
+class SimilarProductDataSource(DataSource):
+    """Reads view events + item $set properties (categories).
+
+    Parity: similarproduct DataSource.scala (viewEvents + items with
+    "categories" property).
+    """
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> SimilarTrainingData:
+        p = self.params
+        users, items, ratings = [], [], []
+        for ev in ctx.event_store().find(
+            p.app_name,
+            entity_type=p.entity_type,
+            event_names=list(p.event_names),
+            target_entity_type=p.target_entity_type,
+        ):
+            if ev.target_entity_id is None:
+                continue
+            users.append(ev.entity_id)
+            items.append(ev.target_entity_id)
+            ratings.append(1.0)
+        categories: dict[str, tuple] = {}
+        props = ctx.event_store().aggregate_properties(
+            p.app_name, p.item_entity_type
+        )
+        for item_id, pm in props.items():
+            cats = pm.get_opt("categories")
+            if cats:
+                categories[item_id] = tuple(cats)
+        return SimilarTrainingData(
+            users=np.asarray(users, dtype=object),
+            items=np.asarray(items, dtype=object),
+            ratings=np.asarray(ratings, dtype=np.float32),
+            categories=categories,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SimilarPreparedData:
+    coo: RatingsCOO
+    user_ids: EntityIdIxMap
+    item_ids: EntityIdIxMap
+    seen_by_user: dict
+    categories: dict
+
+
+class SimilarProductPreparator(ALSPreparator):
+    """ALSPreparator + category carry-through."""
+
+    def prepare(self, ctx, td: SimilarTrainingData) -> SimilarPreparedData:
+        base = super().prepare(
+            ctx,
+            TrainingData(users=td.users, items=td.items, ratings=td.ratings),
+        )
+        return SimilarPreparedData(
+            coo=base.coo,
+            user_ids=base.user_ids,
+            item_ids=base.item_ids,
+            seen_by_user=base.seen_by_user,
+            categories=td.categories,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+    use_mesh: bool = True
+
+
+@dataclasses.dataclass
+class SimilarModel:
+    """ALSModel + item categories for query-time filtering."""
+
+    als: ALSModel
+    categories: dict  # item id -> tuple of categories
+
+
+class SimilarALSAlgorithm(ShardedAlgorithm):
+    """Implicit ALS; cosine top-k at query time.
+
+    Parity: similarproduct ALSAlgorithm.scala (ALS.trainImplicit ->
+    productFeatures cosine similarity with whiteList/blackList/categories
+    filters).
+    """
+
+    params_class = ALSAlgorithmParams
+
+    def train(self, ctx, pd: SimilarPreparedData) -> SimilarModel:
+        p = self.params
+        mesh = ctx.mesh_if_parallel if p.use_mesh else None
+        factors = als_train(
+            pd.coo,
+            rank=p.rank,
+            iterations=p.num_iterations,
+            lam=p.lambda_,
+            implicit=True,
+            alpha=p.alpha,
+            seed=p.seed,
+            mesh=mesh,
+        )
+        als = ALSModel(
+            rank=p.rank,
+            user_factors=factors.user,
+            item_factors=factors.item,
+            user_ids=pd.user_ids,
+            item_ids=pd.item_ids,
+            seen_by_user=pd.seen_by_user,
+        )
+        return SimilarModel(als=als, categories=pd.categories)
+
+    def _allow_vector(self, model: SimilarModel, query: Query) -> np.ndarray | None:
+        """Business-rule eligibility as a dense 0/1 vector (fused into the
+        scoring kernel, ops/topk)."""
+        item_ids = model.als.item_ids
+        n = len(item_ids)
+        if query.categories is None and query.white_list is None and query.black_list is None:
+            return None
+        allow = np.ones(n, dtype=np.float32)
+        if query.categories is not None:
+            wanted = set(query.categories)
+            cat_ok = np.zeros(n, dtype=np.float32)
+            for item_id, cats in model.categories.items():
+                ix = item_ids.get(item_id)
+                if ix is not None and wanted & set(cats):
+                    cat_ok[ix] = 1.0
+            allow *= cat_ok
+        if query.white_list is not None:
+            wl = np.zeros(n, dtype=np.float32)
+            for item_id in query.white_list:
+                ix = item_ids.get(item_id)
+                if ix is not None:
+                    wl[ix] = 1.0
+            allow *= wl
+        if query.black_list is not None:
+            for item_id in query.black_list:
+                ix = item_ids.get(item_id)
+                if ix is not None:
+                    allow[ix] = 0.0
+        return allow
+
+    def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
+        allow = self._allow_vector(model, query)
+        sims = model.als.similar(list(query.items), query.num, allow=allow)
+        return PredictedResult(
+            item_scores=tuple(ItemScore(item=i, score=s) for i, s in sims)
+        )
+
+    def make_persistent_model(self, ctx, model: SimilarModel):
+        import json
+        import os
+        import tempfile
+
+        base = os.environ.get(
+            "PIO_MODEL_DIR", os.path.join(tempfile.gettempdir(), "pio_models")
+        )
+        location = os.path.join(base, f"simals_{id(model):x}")
+        model.als.save(location)
+        with open(os.path.join(location, "categories.json"), "w") as f:
+            json.dump({k: list(v) for k, v in model.categories.items()}, f)
+        return PersistentModelManifest(
+            class_name=f"{type(self).__module__}.{type(self).__name__}",
+            location=location,
+        )
+
+    def load_model(self, ctx, manifest: PersistentModelManifest) -> SimilarModel:
+        import json
+        import os
+
+        als = ALSModel.load(manifest.location)
+        with open(os.path.join(manifest.location, "categories.json")) as f:
+            categories = {k: tuple(v) for k, v in json.load(f).items()}
+        return SimilarModel(als=als, categories=categories)
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class_map=SimilarProductDataSource,
+        preparator_class_map=SimilarProductPreparator,
+        algorithm_class_map={"als": SimilarALSAlgorithm, "": SimilarALSAlgorithm},
+        serving_class_map=FirstServing,
+    )

@@ -132,6 +132,13 @@ class EngineContext:
         )
         return EngineContext(wp, self._storage, None, self._seed, self._devices)
 
+    def with_workflow_params(self, **changes: Any) -> "EngineContext":
+        """A context sharing this one's storage/mesh/rng config but with
+        updated WorkflowParams fields (the sanctioned way to derive a
+        context — keeps internals private to this class)."""
+        wp = dataclasses.replace(self.workflow_params, **changes)
+        return EngineContext(wp, self._storage, self._mesh, self._seed, self._devices)
+
     @property
     def num_devices(self) -> int:
         return math.prod(self.mesh.devices.shape)

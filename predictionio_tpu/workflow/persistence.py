@@ -61,9 +61,14 @@ def _to_host(obj: Any) -> Any:
             return dataclasses.replace(x, **changes)
         if isinstance(x, dict):
             return {k: walk(v) for k, v in x.items()}
-        if isinstance(x, (list, tuple)):
+        if isinstance(x, tuple):
             out = [walk(v) for v in x]
-            return type(x)(out) if not isinstance(x, tuple) else tuple(out)
+            # preserve NamedTuple subclasses (common jax model pattern)
+            if hasattr(x, "_fields"):
+                return type(x)(*out)
+            return tuple(out)
+        if isinstance(x, list):
+            return [walk(v) for v in x]
         return x
 
     return walk(obj)

@@ -9,26 +9,11 @@ conftest import time.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-# This box's sitecustomize registers a TPU backend and overrides
-# jax_platforms programmatically (jax.config.update("jax_platforms",
-# "axon,cpu")), which beats env vars — force it back to CPU for tests.
-import jax  # noqa: E402
+from predictionio_tpu.utils.testing import force_cpu_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-from jax._src import xla_bridge as _xb  # noqa: E402
-
-if _xb.backends_are_initialized():
-    from jax.extend.backend import clear_backends
-
-    clear_backends()
+force_cpu_devices(8)
 
 import pytest  # noqa: E402
 

@@ -14,7 +14,10 @@ def numpy_multinomial_nb(features, labels, num_classes, smoothing):
     log_theta = np.zeros((num_classes, f))
     for c in range(num_classes):
         rows = features[labels == c]
-        log_prior[c] = np.log(len(rows) / n)
+        # MLlib NaiveBayes prior: log(n_c + λ) - log(N + C·λ)
+        log_prior[c] = np.log(len(rows) + smoothing) - np.log(
+            n + smoothing * num_classes
+        )
         sums = rows.sum(axis=0)
         log_theta[c] = np.log((sums + smoothing) / (sums.sum() + smoothing * f))
     return log_prior, log_theta

@@ -1,0 +1,301 @@
+"""ALS template families end-to-end: events -> train -> deploy -> query.
+
+Covers recommendation, similarproduct, and ecommerce templates — the
+template-level analogue of the reference's quickstart integration test
+(tests/pio_tests/scenarios/quickstart_test.py) run against the in-memory
+backend."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.workflow.context import EngineContext
+from predictionio_tpu.workflow.persistence import load_models
+from predictionio_tpu.workflow.train import run_train
+
+MEM_ENV = {
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+}
+
+N_USERS = 24
+N_ITEMS = 16
+
+
+def _event(event, user, item, props=None):
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=user,
+        target_entity_type="item",
+        target_entity_id=item,
+        properties=DataMap(props or {}),
+    )
+
+
+@pytest.fixture
+def storage():
+    """Two taste clusters: even users like even items, odd users odd items."""
+    storage = Storage(MEM_ENV)
+    app_id = storage.get_meta_data_apps().insert(App(0, "RecApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(0)
+    for u in range(N_USERS):
+        for i in range(N_ITEMS):
+            if i % 2 == u % 2 and rng.random() < 0.8:
+                events.insert(
+                    _event("rate", f"u{u}", f"i{i}", {"rating": 5.0}), app_id
+                )
+            elif rng.random() < 0.1:
+                events.insert(
+                    _event("rate", f"u{u}", f"i{i}", {"rating": 1.0}), app_id
+                )
+        if u % 3 == 0:
+            events.insert(_event("buy", f"u{u}", f"i{(u % 2) + 2}"), app_id)
+        # view events for similarproduct/ecommerce
+        for i in range(N_ITEMS):
+            if i % 2 == u % 2 and rng.random() < 0.7:
+                events.insert(_event("view", f"u{u}", f"i{i}"), app_id)
+    # item categories: low items "alpha", high items "beta"
+    for i in range(N_ITEMS):
+        events.insert(
+            Event(
+                event="$set",
+                entity_type="item",
+                entity_id=f"i{i}",
+                properties=DataMap(
+                    {"categories": ["alpha" if i < N_ITEMS // 2 else "beta"]}
+                ),
+            ),
+            app_id,
+        )
+    return storage
+
+
+REC_VARIANT = {
+    "id": "rec",
+    "engineFactory": "predictionio_tpu.templates.recommendation.engine_factory",
+    "datasource": {"params": {"app_name": "RecApp"}},
+    "algorithms": [
+        {"name": "als",
+         "params": {"rank": 8, "num_iterations": 8, "lambda_": 0.05, "seed": 1}}
+    ],
+}
+
+
+class TestRecommendation:
+    def test_train_deploy_query(self, storage, monkeypatch, tmp_path):
+        from predictionio_tpu.templates.recommendation import Query, engine_factory
+
+        monkeypatch.setenv("PIO_MODEL_DIR", str(tmp_path))
+        outcome = run_train(variant=REC_VARIANT, storage=storage)
+        assert outcome.status == "COMPLETED"
+
+        engine = engine_factory()
+        inst = storage.get_meta_data_engine_instances().get(outcome.instance_id)
+        ep = engine.params_from_instance_json(
+            inst.data_source_params, inst.preparator_params,
+            inst.algorithms_params, inst.serving_params,
+        )
+        ctx = EngineContext(storage=storage)
+        models = engine.prepare_deploy(
+            ctx, ep, load_models(storage, outcome.instance_id)
+        )
+        _, _, algos, serving = engine.make_components(ep)
+
+        q = Query(user="u0", num=5)
+        result = serving.serve(q, [a.predict(m, q) for a, m in zip(algos, models)])
+        assert 0 < len(result.item_scores) <= 5
+        # u0 likes even items: the top recommendation should be even
+        top = result.item_scores[0].item
+        assert int(top[1:]) % 2 == 0
+        # unknown user -> empty result (reference behavior)
+        q2 = Query(user="stranger", num=5)
+        r2 = serving.serve(q2, [a.predict(m, q2) for a, m in zip(algos, models)])
+        assert r2.item_scores == ()
+
+    def test_eval_precision(self, storage):
+        from predictionio_tpu.templates.recommendation import engine_factory
+
+        engine = engine_factory()
+        variant = {
+            **REC_VARIANT,
+            "datasource": {"params": {"app_name": "RecApp", "eval_k": 2}},
+        }
+        ep = engine.params_from_variant_json(variant)
+        results = engine.eval(EngineContext(storage=storage), ep)
+        assert len(results) == 2
+        for ei, fold in results:
+            assert len(fold) > 0
+            for q, p, a in fold:
+                assert isinstance(a, tuple)
+
+    def test_batch_predict_matches_predict(self, storage):
+        from predictionio_tpu.templates.recommendation import (
+            ALSAlgorithm, ALSPreparator, Query, RecommendationDataSource,
+        )
+
+        ctx = EngineContext(storage=storage)
+        ds = RecommendationDataSource.__new__(RecommendationDataSource)
+        from predictionio_tpu.templates.recommendation import DataSourceParams
+
+        ds.params = DataSourceParams(app_name="RecApp")
+        td = ds.read_training(ctx)
+        pd = ALSPreparator().prepare(ctx, td)
+        algo = ALSAlgorithm.__new__(ALSAlgorithm)
+        from predictionio_tpu.templates.recommendation import ALSAlgorithmParams
+
+        algo.params = ALSAlgorithmParams(rank=6, num_iterations=6, seed=2)
+        model = algo.train(ctx, pd)
+        queries = [(0, Query(user="u1", num=4)), (1, Query(user="nope", num=4)),
+                   (2, Query(user="u2", num=4))]
+        batch = dict(algo.batch_predict(model, queries))
+        assert batch[1].item_scores == ()
+        single = algo.predict(model, Query(user="u1", num=4))
+        assert [s.item for s in batch[0].item_scores] == [
+            s.item for s in single.item_scores
+        ]
+
+
+class TestSimilarProduct:
+    VARIANT = {
+        "id": "sim",
+        "engineFactory": "predictionio_tpu.templates.similarproduct.engine_factory",
+        "datasource": {"params": {"app_name": "RecApp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 8, "num_iterations": 10, "alpha": 5.0, "seed": 1}}
+        ],
+    }
+
+    def test_train_and_query(self, storage, monkeypatch, tmp_path):
+        from predictionio_tpu.templates.similarproduct import Query, engine_factory
+
+        monkeypatch.setenv("PIO_MODEL_DIR", str(tmp_path))
+        outcome = run_train(variant=self.VARIANT, storage=storage)
+        assert outcome.status == "COMPLETED"
+
+        engine = engine_factory()
+        inst = storage.get_meta_data_engine_instances().get(outcome.instance_id)
+        ep = engine.params_from_instance_json(
+            inst.data_source_params, inst.preparator_params,
+            inst.algorithms_params, inst.serving_params,
+        )
+        ctx = EngineContext(storage=storage)
+        models = engine.prepare_deploy(
+            ctx, ep, load_models(storage, outcome.instance_id)
+        )
+        _, _, algos, _ = engine.make_components(ep)
+        algo, model = algos[0], models[0]
+
+        # items co-viewed by the same user group should rank as similar:
+        # i0 (even group) -> top similars should be even items
+        result = algo.predict(model, Query(items=("i0",), num=4))
+        assert len(result.item_scores) == 4
+        evens = [s for s in result.item_scores if int(s.item[1:]) % 2 == 0]
+        assert len(evens) >= 3
+        assert all(s.item != "i0" for s in result.item_scores)
+
+    def test_category_and_list_filters(self, storage):
+        from predictionio_tpu.templates.similarproduct import (
+            Query, engine_factory,
+        )
+
+        engine = engine_factory()
+        ep = engine.params_from_variant_json(self.VARIANT)
+        ctx = EngineContext(storage=storage)
+        tr = engine.train(ctx, ep)
+        _, _, algos, _ = engine.make_components(ep)
+        algo, model = algos[0], tr.models[0]
+        from predictionio_tpu.templates.similarproduct import Query
+
+        r = algo.predict(model, Query(items=("i0",), num=6, categories=("alpha",)))
+        assert all(int(s.item[1:]) < N_ITEMS // 2 for s in r.item_scores)
+        r2 = algo.predict(
+            model, Query(items=("i0",), num=6, white_list=("i2", "i4"))
+        )
+        assert {s.item for s in r2.item_scores} <= {"i2", "i4"}
+        r3 = algo.predict(
+            model, Query(items=("i0",), num=6, black_list=("i2",))
+        )
+        assert all(s.item != "i2" for s in r3.item_scores)
+
+
+class TestECommerce:
+    VARIANT = {
+        "id": "ecomm",
+        "engineFactory": "predictionio_tpu.templates.ecommerce.engine_factory",
+        "datasource": {"params": {"app_name": "RecApp"}},
+        "algorithms": [
+            {"name": "ecomm",
+             "params": {"app_name": "RecApp", "rank": 8, "num_iterations": 10,
+                         "alpha": 5.0, "seed": 1}}
+        ],
+    }
+
+    def _trained(self, storage):
+        from predictionio_tpu.templates.ecommerce import engine_factory
+
+        engine = engine_factory()
+        ep = engine.params_from_variant_json(self.VARIANT)
+        ctx = EngineContext(storage=storage)
+        tr = engine.train(ctx, ep)
+        _, _, algos, _ = engine.make_components(ep)
+        # algo used for predict must be the same instance that trained
+        # (it caches ctx for live event reads); re-train on fresh algo
+        algo = algos[0]
+        model = algo.train(ctx, engine.make_components(ep)[1].prepare(
+            ctx, engine.make_components(ep)[0].read_training(ctx)))
+        return algo, model
+
+    def test_known_user_filters(self, storage):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        algo, model = self._trained(storage)
+        r = algo.predict(model, Query(user="u0", num=5))
+        assert 0 < len(r.item_scores) <= 5
+        # category filter
+        r2 = algo.predict(model, Query(user="u0", num=5, categories=("beta",)))
+        assert all(int(s.item[1:]) >= N_ITEMS // 2 for s in r2.item_scores)
+
+    def test_unavailable_items_filtered_live(self, storage):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        algo, model = self._trained(storage)
+        r1 = algo.predict(model, Query(user="u0", num=3))
+        top = r1.item_scores[0].item
+        # mark the top item unavailable via a live constraint $set
+        app = storage.get_meta_data_apps().get_by_name("RecApp")
+        storage.get_events().insert(
+            Event(
+                event="$set",
+                entity_type="constraint",
+                entity_id="unavailableItems",
+                properties=DataMap({"items": [top]}),
+            ),
+            app.id,
+        )
+        r2 = algo.predict(model, Query(user="u0", num=3))
+        assert all(s.item != top for s in r2.item_scores)
+
+    def test_unknown_user_recent_views_fallback(self, storage):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        algo, model = self._trained(storage)
+        app = storage.get_meta_data_apps().get_by_name("RecApp")
+        # a brand-new user views two even items -> similar-items fallback
+        for item in ("i0", "i2"):
+            storage.get_events().insert(_event("view", "newbie", item), app.id)
+        r = algo.predict(model, Query(user="newbie", num=4))
+        assert len(r.item_scores) > 0
+        evens = [s for s in r.item_scores if int(s.item[1:]) % 2 == 0]
+        assert len(evens) >= len(r.item_scores) - 1
+        # no history at all -> empty
+        r2 = algo.predict(model, Query(user="ghost", num=4))
+        assert r2.item_scores == ()
