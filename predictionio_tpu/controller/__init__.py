@@ -29,6 +29,26 @@ from predictionio_tpu.controller.engine import (
     TrainResult,
     resolve_engine_factory,
 )
+from predictionio_tpu.controller.evaluation import (
+    BaseEvaluator,
+    BaseEvaluatorResult,
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+    MetricScores,
+)
+from predictionio_tpu.controller.fast_eval import FastEvalEngine
+from predictionio_tpu.controller.metrics import (
+    AverageMetric,
+    Metric,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    QPAMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
 from predictionio_tpu.controller.params import (
     EmptyParams,
     EngineParams,
@@ -45,4 +65,9 @@ __all__ = [
     "Engine", "EngineFactory", "StopAfterPrepareInterruption",
     "StopAfterReadInterruption", "TrainResult", "resolve_engine_factory",
     "EmptyParams", "EngineParams", "Params", "params_from_json", "params_to_json",
+    "Metric", "QPAMetric", "AverageMetric", "OptionAverageMetric",
+    "StdevMetric", "OptionStdevMetric", "SumMetric", "ZeroMetric",
+    "BaseEvaluator", "BaseEvaluatorResult", "Evaluation",
+    "EngineParamsGenerator", "MetricEvaluator", "MetricEvaluatorResult",
+    "MetricScores", "FastEvalEngine",
 ]

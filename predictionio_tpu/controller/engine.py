@@ -336,18 +336,9 @@ def resolve_engine_factory(spec: str) -> Callable[[], Engine]:
     Parity: WorkflowUtils.getEngine (WorkflowUtils.scala:53-90), which
     tried object-then-class reflection; here importlib + attribute lookup.
     """
-    import importlib
+    from predictionio_tpu.utils.reflection import resolve_attr
 
-    if ":" in spec:
-        module_name, attr = spec.split(":", 1)
-    else:
-        module_name, _, attr = spec.rpartition(".")
-        if not module_name:
-            raise ValueError(f"invalid engineFactory {spec!r}")
-    module = importlib.import_module(module_name)
-    obj = module
-    for part in attr.split("."):
-        obj = getattr(obj, part)
+    obj = resolve_attr(spec)
     if isinstance(obj, Engine):
         return lambda: obj
     if isinstance(obj, type) and issubclass(obj, EngineFactory):

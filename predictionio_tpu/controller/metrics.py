@@ -1,0 +1,139 @@
+"""The Metric family — per-query scoring folded into scalar results.
+
+Parity: core/src/main/scala/.../controller/Metric.scala:39-269. A Metric
+scores an evaluation data set (the output of ``Engine.eval``: per-fold
+``(EI, [(Q, P, A)])``) into a comparable result, usually a float.
+
+The reference reduced RDD[score] with Spark's StatCounter
+(Metric.scala:60-67); here the per-query scores for one metric are
+gathered into a NumPy vector and reduced on host. The expensive part of
+evaluation — batch prediction — already ran on the mesh inside
+``Engine.eval``; metric reduction is a scalar fold over a few thousand
+floats, which belongs on host (a device round-trip per metric would cost
+more than the reduction).
+"""
+
+from __future__ import annotations
+
+import abc
+import math
+from typing import Any, Generic, Sequence, TypeVar
+
+import numpy as np
+
+from predictionio_tpu.controller.base import A, EI, P, Q
+
+R = TypeVar("R")
+
+#: An evaluation data set: per-fold evaluation info + (query, prediction,
+#: actual) triples — what Engine.eval returns for one EngineParams.
+EvalDataSet = Sequence[tuple[EI, Sequence[tuple[Q, P, A]]]]
+
+
+class Metric(Generic[EI, Q, P, A, R], abc.ABC):
+    """Parity: Metric (Metric.scala:39-57)."""
+
+    @abc.abstractmethod
+    def calculate(self, eval_data_set: EvalDataSet) -> R:
+        """Score the whole evaluation data set."""
+
+    def compare(self, r0: R, r1: R) -> int:
+        """Default ordering: larger is better (Metric.scala:48-56).
+        NaN (an empty grid point's Average/Stdev score) always loses, so
+        it can never be selected as best."""
+        r0_nan = isinstance(r0, float) and math.isnan(r0)
+        r1_nan = isinstance(r1, float) and math.isnan(r1)
+        if r0_nan or r1_nan:
+            return 0 if r0_nan == r1_nan else (-1 if r0_nan else 1)
+        if r0 == r1:
+            return 0
+        return -1 if r0 < r1 else 1
+
+    @property
+    def header(self) -> str:
+        """Column label in evaluator reports (Metric.scala:44)."""
+        return type(self).__name__
+
+
+def _scores(metric: "QPAMetric", eval_data_set: EvalDataSet) -> np.ndarray:
+    """All per-query scores across folds as one float vector — the
+    host-side analogue of the reference's RDD union (Metric.scala:62-67)."""
+    vals = [
+        metric.calculate_qpa(q, p, a)
+        for _, qpa in eval_data_set
+        for q, p, a in qpa
+    ]
+    return np.asarray(vals, dtype=np.float64)
+
+
+def _option_scores(metric: "QPAMetric", eval_data_set: EvalDataSet) -> np.ndarray:
+    """Scores with None dropped (Option semantics, Metric.scala:124-149)."""
+    vals = [
+        s
+        for _, qpa in eval_data_set
+        for q, p, a in qpa
+        if (s := metric.calculate_qpa(q, p, a)) is not None
+    ]
+    return np.asarray(vals, dtype=np.float64)
+
+
+class QPAMetric(Metric[EI, Q, P, A, float], abc.ABC):
+    """A metric defined per (query, prediction, actual) triple.
+    Parity: QPAMetric (Metric.scala:259-269)."""
+
+    @abc.abstractmethod
+    def calculate_qpa(self, q: Q, p: P, a: A) -> float | None:
+        """Score one query. May return None for Option* subclasses."""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        raise NotImplementedError
+
+
+class AverageMetric(QPAMetric[EI, Q, P, A]):
+    """Mean of per-query scores. Parity: AverageMetric (Metric.scala:99-122)."""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        s = _scores(self, eval_data_set)
+        return float(s.mean()) if s.size else math.nan
+
+
+class OptionAverageMetric(QPAMetric[EI, Q, P, A]):
+    """Mean of non-None scores. Parity: OptionAverageMetric
+    (Metric.scala:124-149)."""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        s = _option_scores(self, eval_data_set)
+        return float(s.mean()) if s.size else math.nan
+
+
+class StdevMetric(QPAMetric[EI, Q, P, A]):
+    """Population stdev of scores. Parity: StdevMetric (Metric.scala:151-177);
+    Spark StatCounter.stdev is the population form."""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        s = _scores(self, eval_data_set)
+        return float(s.std()) if s.size else math.nan
+
+
+class OptionStdevMetric(QPAMetric[EI, Q, P, A]):
+    """Population stdev of non-None scores. Parity: Metric.scala:179-203."""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        s = _option_scores(self, eval_data_set)
+        return float(s.std()) if s.size else math.nan
+
+
+class SumMetric(QPAMetric[EI, Q, P, A]):
+    """Sum of scores. Parity: SumMetric (Metric.scala:205-232)."""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        s = _scores(self, eval_data_set)
+        return float(s.sum())
+
+
+class ZeroMetric(Metric[EI, Q, P, A, float]):
+    """Always 0 — placeholder for required metric slots.
+    Parity: ZeroMetric (Metric.scala:234-246)."""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        return 0.0

@@ -31,3 +31,17 @@ def force_cpu_devices(n: int) -> None:
         from jax.extend.backend import clear_backends
 
         clear_backends()
+
+
+def memory_storage():
+    """A fresh all-in-memory Storage (the three repositories on the MEM
+    source) — the standard test storage, analogous to the reference's
+    `Storage.getLEvents(test=true)` test wiring."""
+    from predictionio_tpu.storage.registry import Storage
+
+    return Storage({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })

@@ -1,0 +1,116 @@
+"""The evaluation workflow driver.
+
+Parity: core/src/main/scala/.../workflow/{CreateWorkflow.scala:143-160 +
+253-274 (eval branch), CoreWorkflow.scala:103-163 (runEvaluation),
+EvaluationWorkflow.scala:32-43, Workflow.scala:82-138}: resolve the
+Evaluation + EngineParamsGenerator, record an INIT EvaluationInstance,
+run ``engine.batch_eval`` over the grid, score with the evaluator, and
+persist the result renders (one-liner / HTML / JSON) on the instance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from datetime import datetime, timezone
+from typing import Any
+
+from predictionio_tpu.controller.evaluation import (
+    BaseEvaluatorResult,
+    EngineParamsGenerator,
+    Evaluation,
+)
+from predictionio_tpu.storage.base import EvaluationInstance
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.utils.reflection import resolve_attr
+from predictionio_tpu.workflow.context import EngineContext, WorkflowParams
+
+logger = logging.getLogger(__name__)
+
+
+def _now() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def resolve_object(spec: str) -> Any:
+    """Resolve "pkg.module.Obj" / "pkg.module:Obj" to an instance.
+    Classes are instantiated with no args. Parity:
+    WorkflowUtils.getEvaluation/getEngineParamsGenerator
+    (WorkflowUtils.scala:72-103)."""
+    obj = resolve_attr(spec)
+    if isinstance(obj, type):
+        obj = obj()
+    return obj
+
+
+@dataclasses.dataclass
+class EvalOutcome:
+    instance_id: str
+    status: str
+    result: BaseEvaluatorResult
+
+
+def run_evaluation(
+    evaluation: Evaluation | str,
+    engine_params_generator: EngineParamsGenerator | str,
+    workflow_params: WorkflowParams = WorkflowParams(),
+    storage: Storage | None = None,
+    ctx: EngineContext | None = None,
+) -> EvalOutcome:
+    """Evaluate an engine over a params grid and persist the results.
+
+    ``evaluation`` / ``engine_params_generator`` may be instances
+    (programmatic use) or spec strings (CLI path).
+    """
+    if isinstance(evaluation, str):
+        evaluation = resolve_object(evaluation)
+    if isinstance(engine_params_generator, str):
+        engine_params_generator = resolve_object(engine_params_generator)
+    if not isinstance(evaluation, Evaluation):
+        raise TypeError(f"{evaluation!r} is not an Evaluation")
+
+    storage = storage or Storage.default()
+    ctx = ctx or EngineContext(workflow_params=workflow_params, storage=storage)
+    instances = storage.get_meta_data_evaluation_instances()
+    instance = EvaluationInstance(
+        id="",
+        status="INIT",
+        start_time=_now(),
+        completion_time=_now(),
+        evaluation_class=f"{type(evaluation).__module__}.{type(evaluation).__qualname__}",
+        engine_params_generator_class=(
+            f"{type(engine_params_generator).__module__}."
+            f"{type(engine_params_generator).__qualname__}"
+        ),
+        batch=workflow_params.batch,
+        env={},
+        mesh_conf=dict(workflow_params.mesh_conf),
+    )
+    instance_id = instances.insert(instance)
+    logger.info("evaluation instance %s: INIT", instance_id)
+
+    engine = evaluation.engine
+    evaluator = evaluation.evaluator
+    params_list = engine_params_generator.engine_params_list
+
+    # EvaluationWorkflow.runEvaluation (EvaluationWorkflow.scala:34-42)
+    engine_eval_data_set = engine.batch_eval(ctx, params_list)
+    result = evaluator.evaluate(ctx, evaluation, engine_eval_data_set)
+
+    # CoreWorkflow.runEvaluation persistence (CoreWorkflow.scala:137-155);
+    # noSave results leave the instance row at INIT, like the reference.
+    if result.no_save:
+        logger.info("evaluation instance %s: results not saved (noSave)", instance_id)
+        return EvalOutcome(instance_id, "NOSAVE", result)
+    completed = dataclasses.replace(
+        instances.get(instance_id),
+        status="EVALCOMPLETED",
+        completion_time=_now(),
+        evaluator_results=result.to_one_liner(),
+        evaluator_results_html=result.to_html(),
+        evaluator_results_json=result.to_json(),
+    )
+    instances.update(completed)
+    logger.info("evaluation instance %s: EVALCOMPLETED — %s",
+                instance_id, result.to_one_liner())
+    return EvalOutcome(instance_id, "EVALCOMPLETED", result)

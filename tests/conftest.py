@@ -18,6 +18,14 @@ force_cpu_devices(8)
 import pytest  # noqa: E402
 
 
+@pytest.fixture
+def storage():
+    """A fresh in-memory Storage (all three repositories on MEM)."""
+    from predictionio_tpu.utils.testing import memory_storage
+
+    return memory_storage()
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     """An 8-device 2D mesh (4 data x 2 model), the standard test topology."""

@@ -10,23 +10,13 @@ import pytest
 from predictionio_tpu.core.datamap import DataMap
 from predictionio_tpu.core.event import Event
 from predictionio_tpu.storage.base import App
-from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.templates.classification import Query, engine_factory
 from predictionio_tpu.workflow.context import EngineContext
 from predictionio_tpu.workflow.persistence import load_models
 from predictionio_tpu.workflow.train import run_train
 
-MEM_ENV = {
-    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
-    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
-    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
-    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
-}
-
-
 @pytest.fixture
-def storage_with_events():
-    storage = Storage(MEM_ENV)
+def storage_with_events(storage):
     app_id = storage.get_meta_data_apps().insert(App(0, "ClassApp"))
     events = storage.get_events()
     events.init(app_id)

@@ -1,0 +1,282 @@
+"""Metric family + MetricEvaluator + evaluation workflow + FastEvalEngine.
+
+Modeled on the reference's MetricTest.scala, MetricEvaluatorTest.scala,
+EvaluationWorkflowTest.scala, and FastEvalEngineTest.scala.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    FastEvalEngine,
+    MetricEvaluator,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.workflow.context import EngineContext
+from predictionio_tpu.workflow.evaluation import run_evaluation
+
+from tests.sample_engine import (
+    AlgoParams,
+    DSParams,
+    SampleAlgorithm,
+    SampleDataSource,
+    SamplePreparator,
+    SampleServing,
+    make_engine,
+)
+
+
+# ---------------------------------------------------------------------------
+# Metric family over literal eval data sets (MetricTest.scala style)
+# ---------------------------------------------------------------------------
+
+class ValueMetric(AverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(a)
+
+
+class OptValueMetric(OptionAverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return None if a is None else float(a)
+
+
+class OptStdevValueMetric(OptionStdevMetric):
+    def calculate_qpa(self, q, p, a):
+        return None if a is None else float(a)
+
+
+class StdevValueMetric(StdevMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(a)
+
+
+class SumValueMetric(SumMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(a)
+
+
+def _ds(*fold_actuals):
+    """Build an eval data set from per-fold actual-value lists."""
+    return [
+        (f"ei{k}", [(f"q{i}", f"p{i}", a) for i, a in enumerate(actuals)])
+        for k, actuals in enumerate(fold_actuals)
+    ]
+
+
+class TestMetrics:
+    def test_average_across_folds(self):
+        assert ValueMetric().calculate(_ds([1, 2, 3], [4])) == pytest.approx(2.5)
+
+    def test_average_empty_is_nan(self):
+        assert math.isnan(ValueMetric().calculate(_ds([])))
+
+    def test_option_average_drops_none(self):
+        assert OptValueMetric().calculate(_ds([1, None, 3], [None])) == pytest.approx(2.0)
+
+    def test_stdev_is_population(self):
+        # population stdev of [2, 4] = 1.0 (Spark StatCounter semantics)
+        assert StdevValueMetric().calculate(_ds([2, 4])) == pytest.approx(1.0)
+
+    def test_option_stdev_drops_none(self):
+        assert OptStdevValueMetric().calculate(_ds([2, None, 4])) == pytest.approx(1.0)
+
+    def test_sum(self):
+        assert SumValueMetric().calculate(_ds([1, 2], [3])) == pytest.approx(6.0)
+
+    def test_zero(self):
+        assert ZeroMetric().calculate(_ds([1, 2])) == 0.0
+
+    def test_default_compare_larger_wins(self):
+        m = ValueMetric()
+        assert m.compare(2.0, 1.0) > 0
+        assert m.compare(1.0, 2.0) < 0
+        assert m.compare(1.0, 1.0) == 0
+
+    def test_compare_nan_always_loses(self):
+        m = ValueMetric()
+        assert m.compare(math.nan, 0.1) < 0
+        assert m.compare(0.1, math.nan) > 0
+        assert m.compare(math.nan, math.nan) == 0
+
+    def test_nan_grid_point_never_best(self):
+        engine = make_engine()
+        ctx = EngineContext()
+        # grid point 0 has zero eval queries -> NaN average; point 1 is real
+        grid = [
+            EngineParams.of(
+                data_source=DSParams(id=1, n_train=4, n_folds=0),
+                algorithms=[("sample", AlgoParams(id=0, mult=5))],
+            ),
+            _grid([1])[0],
+        ]
+        evaluator = MetricEvaluator(PredictionValueMetric())
+        data_set = engine.batch_eval(ctx, grid)
+        result = evaluator.evaluate(ctx, SampleEvaluation(engine), data_set)
+        assert result.best_idx == 1
+
+
+# ---------------------------------------------------------------------------
+# MetricEvaluator + workflow (MetricEvaluatorTest / EvaluationWorkflowTest)
+# ---------------------------------------------------------------------------
+
+class PredictionValueMetric(AverageMetric):
+    """Scores the served prediction value — depends on algo params."""
+
+    def calculate_qpa(self, q, p, a):
+        return float(p.value)
+
+
+def _grid(mults):
+    return [
+        EngineParams.of(
+            data_source=DSParams(id=1, n_train=4, n_folds=2),
+            algorithms=[("sample", AlgoParams(id=0, mult=m))],
+        )
+        for m in mults
+    ]
+
+
+class SampleEvaluation(Evaluation):
+    def __init__(self, engine, output_path=None):
+        super().__init__()
+        self.engine_evaluator = (
+            engine,
+            MetricEvaluator(PredictionValueMetric(), [SumValueMetric()],
+                            output_path=output_path),
+        )
+
+
+class TestMetricEvaluator:
+    def test_best_tracking_and_result(self, tmp_path):
+        engine = make_engine()
+        ctx = EngineContext()
+        out = tmp_path / "best.json"
+        evaluation = SampleEvaluation(engine, output_path=str(out))
+        data_set = engine.batch_eval(ctx, _grid([1, 3, 2]))
+        result = evaluation.evaluator.evaluate(ctx, evaluation, data_set)
+
+        assert result.best_idx == 1  # mult=3 maximises prediction value
+        assert result.metric_header == "PredictionValueMetric"
+        assert result.other_metric_headers == ["SumValueMetric"]
+        assert len(result.engine_params_scores) == 3
+        assert result.best_score.score == pytest.approx(3.0)  # mean(q.x*3), x in 0..2
+
+        # best.json is a loadable engine-params variant
+        best = json.loads(out.read_text())
+        assert best["algorithmParamsList"][0]["params"]["mult"] == 3
+        assert best["evaluation"] == "SampleEvaluation"
+
+        # renders
+        assert "3.0" in result.to_one_liner()
+        parsed = json.loads(result.to_json())
+        assert parsed["bestIdx"] == 1
+        assert "<table" in result.to_html()
+
+    def test_run_evaluation_persists_instance(self, storage):
+        engine = make_engine()
+        evaluation = SampleEvaluation(engine)
+        gen = EngineParamsGenerator(_grid([1, 2]))
+        outcome = run_evaluation(evaluation, gen, storage=storage)
+
+        assert outcome.status == "EVALCOMPLETED"
+        inst = storage.get_meta_data_evaluation_instances().get(outcome.instance_id)
+        assert inst.status == "EVALCOMPLETED"
+        assert "SampleEvaluation" in inst.evaluation_class
+        assert inst.evaluator_results  # one-liner
+        assert json.loads(inst.evaluator_results_json)["bestIdx"] == 1
+
+
+# ---------------------------------------------------------------------------
+# FastEvalEngine prefix memoization (FastEvalEngineTest.scala style)
+# ---------------------------------------------------------------------------
+
+class CountingDataSource(SampleDataSource):
+    reads = 0
+
+    def read_eval(self, ctx):
+        type(self).reads += 1
+        return super().read_eval(ctx)
+
+
+class CountingPreparator(SamplePreparator):
+    prepares = 0
+
+    def prepare(self, ctx, td):
+        type(self).prepares += 1
+        return super().prepare(ctx, td)
+
+
+class CountingAlgorithm(SampleAlgorithm):
+    trains = 0
+
+    def train(self, ctx, pd):
+        type(self).trains += 1
+        return super().train(ctx, pd)
+
+
+def _reset_counts():
+    CountingDataSource.reads = 0
+    CountingPreparator.prepares = 0
+    CountingAlgorithm.trains = 0
+
+
+def _fast_engine():
+    return FastEvalEngine(
+        data_source_class_map=CountingDataSource,
+        preparator_class_map=CountingPreparator,
+        algorithm_class_map={"sample": CountingAlgorithm},
+        serving_class_map=SampleServing,
+    )
+
+
+class TestFastEvalEngine:
+    def test_shared_prefixes_are_computed_once(self):
+        _reset_counts()
+        engine = _fast_engine()
+        ctx = EngineContext()
+        n_folds = 2
+        # 3 grid points sharing the datasource+preparator prefix,
+        # 2 distinct algorithm params
+        grid = _grid([1, 2, 1])
+        results = engine.batch_eval(ctx, grid)
+
+        assert len(results) == 3
+        assert CountingDataSource.reads == 1
+        assert CountingPreparator.prepares == n_folds  # once per fold, one prefix
+        assert CountingAlgorithm.trains == 2 * n_folds  # mult=1 and mult=2 only
+
+        # results match the plain Engine exactly
+        plain = Engine(
+            CountingDataSource, CountingPreparator,
+            {"sample": CountingAlgorithm}, SampleServing,
+        ).batch_eval(ctx, grid)
+        for (ep_f, folds_f), (ep_p, folds_p) in zip(results, plain):
+            assert ep_f == ep_p
+            assert folds_f == folds_p
+
+    def test_distinct_datasource_params_not_shared(self):
+        _reset_counts()
+        engine = _fast_engine()
+        ctx = EngineContext()
+        grid = [
+            EngineParams.of(
+                data_source=DSParams(id=i, n_train=4, n_folds=1),
+                algorithms=[("sample", AlgoParams(id=0, mult=1))],
+            )
+            for i in (1, 2)
+        ]
+        engine.batch_eval(ctx, grid)
+        assert CountingDataSource.reads == 2

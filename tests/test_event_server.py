@@ -13,19 +13,12 @@ import pytest
 from predictionio_tpu.api.event_server import EventServer, EventServerConfig
 from predictionio_tpu.api.plugins import EventServerPlugin, EventServerPluginContext, INPUT_BLOCKER
 from predictionio_tpu.storage.base import AccessKey, App, Channel
-from predictionio_tpu.storage.registry import Storage
-
-MEM_ENV = {
-    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
-    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
-    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
-    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
-}
+from predictionio_tpu.utils.testing import memory_storage
 
 
 @pytest.fixture
 def server():
-    storage = Storage(MEM_ENV)
+    storage = memory_storage()
     app_id = storage.get_meta_data_apps().insert(App(0, "testapp"))
     storage.get_meta_data_access_keys().insert(AccessKey("testkey", app_id, ()))
     storage.get_meta_data_access_keys().insert(
@@ -192,7 +185,7 @@ def test_stats(server):
 
 
 def test_stats_disabled():
-    storage = Storage(MEM_ENV)
+    storage = memory_storage()
     app_id = storage.get_meta_data_apps().insert(App(0, "app2"))
     storage.get_meta_data_access_keys().insert(AccessKey("k2", app_id, ()))
     srv = EventServer(storage, EventServerConfig(ip="127.0.0.1", port=0, stats=False))
@@ -250,7 +243,7 @@ def test_input_blocker_plugin():
             if info.event.entity_id == "blocked":
                 raise ValueError("entity is blocked")
 
-    storage = Storage(MEM_ENV)
+    storage = memory_storage()
     app_id = storage.get_meta_data_apps().insert(App(0, "app3"))
     storage.get_meta_data_access_keys().insert(AccessKey("k3", app_id, ()))
     ctx = EventServerPluginContext([Blocker()])

@@ -11,17 +11,9 @@ import pytest
 from predictionio_tpu.core.datamap import DataMap
 from predictionio_tpu.core.event import Event
 from predictionio_tpu.storage.base import App
-from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.workflow.context import EngineContext
 from predictionio_tpu.workflow.persistence import load_models
 from predictionio_tpu.workflow.train import run_train
-
-MEM_ENV = {
-    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
-    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
-    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
-    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
-}
 
 N_USERS = 24
 N_ITEMS = 16
@@ -39,9 +31,8 @@ def _event(event, user, item, props=None):
 
 
 @pytest.fixture
-def storage():
+def storage(storage):
     """Two taste clusters: even users like even items, odd users odd items."""
-    storage = Storage(MEM_ENV)
     app_id = storage.get_meta_data_apps().insert(App(0, "RecApp"))
     events = storage.get_events()
     events.init(app_id)

@@ -3,25 +3,11 @@ persistence (reference behavior: CoreWorkflow.scala:39-101)."""
 
 import pytest
 
-from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.workflow.context import WorkflowParams
 from predictionio_tpu.workflow.persistence import load_models
 from predictionio_tpu.workflow.train import run_train
 
 from tests.sample_engine import DSParams, default_params, make_engine
-
-MEM_ENV = {
-    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
-    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
-    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
-    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
-}
-
-
-@pytest.fixture
-def storage():
-    return Storage(MEM_ENV)
-
 
 def test_run_train_completes_and_persists(storage):
     outcome = run_train(
