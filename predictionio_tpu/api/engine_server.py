@@ -1,0 +1,456 @@
+"""The Engine Server: prediction serving on :8000.
+
+Route and behavior parity with the reference deploy server
+(reference: core/src/main/scala/.../workflow/CreateServer.scala):
+
+- ``GET /``              status document (:442-469 — twirl HTML page;
+                         here JSON, plus HTML when Accept asks for it)
+- ``POST /queries.json`` the query path (:470-621): bind query JSON →
+                         ``serving.supplement`` → sequential per-algorithm
+                         ``predict`` → ``serving.serve`` → optional
+                         feedback events → output-blocker plugins →
+                         latency bookkeeping
+- ``GET|POST /reload``   hot-swap to the latest completed instance
+                         (:316-342; key-authenticated)
+- ``POST /stop``         shutdown (:633-646; key-authenticated)
+- ``GET /plugins.json``  plugin listing (:648-671)
+
+The reference's MasterActor/ServerActor pair collapses to
+``EngineServer`` (HTTP lifecycle, bind retry ×3 — :347-357) over
+``EngineService`` (transport-free request logic). The feedback loop
+(:514-576) POSTs ``predict`` events to the event server from a
+fire-and-forget thread, tagging responses with a ``prId``.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import json
+import logging
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Mapping
+from urllib.parse import parse_qs, urlparse
+
+from predictionio_tpu.core.wire import from_wire, to_wire
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.workflow.context import EngineContext
+from predictionio_tpu.workflow.deploy import (
+    DeployedEngine,
+    ServerConfig,
+    load_deployed_engine,
+)
+
+logger = logging.getLogger(__name__)
+
+OUTPUT_BLOCKER = "outputblocker"
+OUTPUT_SNIFFER = "outputsniffer"
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryInfo:
+    """What engine-server plugins observe per query
+    (EngineServerPlugin.scala:33-41)."""
+    query: Any
+    prediction: Any
+    engine_instance_id: str
+
+
+class EngineServerPlugin(abc.ABC):
+    """Parity: EngineServerPlugin (workflow/EngineServerPlugin.scala:22-41).
+    Output blockers run synchronously and may transform (or reject, by
+    raising) the prediction; sniffers observe asynchronously."""
+
+    plugin_name: str = "plugin"
+    plugin_description: str = ""
+    plugin_type: str = OUTPUT_SNIFFER
+
+    @abc.abstractmethod
+    def process(self, info: QueryInfo, context: "EngineServerPluginContext") -> Any:
+        """Blockers return the (possibly transformed) prediction."""
+
+
+class EngineServerPluginContext:
+    """Parity: EngineServerPluginContext.scala:39-91 +
+    EngineServerPluginsActor (async sniffer fan-out as a worker thread)."""
+
+    def __init__(self, plugins: list[EngineServerPlugin] | None = None):
+        plugins = list(plugins or [])
+        self.output_blockers = {
+            p.plugin_name: p for p in plugins if p.plugin_type == OUTPUT_BLOCKER
+        }
+        self.output_sniffers = {
+            p.plugin_name: p for p in plugins if p.plugin_type == OUTPUT_SNIFFER
+        }
+        # one daemon worker drains sniffer notifications off the serving
+        # hot path (the EngineServerPluginsActor role)
+        self._queue: "queue.Queue[QueryInfo | None]" = queue.Queue()
+        self._worker: threading.Thread | None = None
+        if self.output_sniffers:
+            self._worker = threading.Thread(
+                target=self._drain, name="pio-output-sniffers", daemon=True
+            )
+            self._worker.start()
+
+    def run_blockers(self, info: QueryInfo) -> Any:
+        """Fold the prediction through all blockers
+        (CreateServer.scala:578-581). Exceptions propagate and reject the
+        query (the caller maps them to an HTTP error)."""
+        prediction = info.prediction
+        for blocker in self.output_blockers.values():
+            prediction = blocker.process(
+                dataclasses.replace(info, prediction=prediction), self
+            )
+        return prediction
+
+    def notify_sniffers(self, info: QueryInfo) -> None:
+        if self._worker is not None:
+            self._queue.put(info)
+
+    def _drain(self) -> None:
+        while True:
+            info = self._queue.get()
+            if info is None:
+                return
+            for sniffer in self.output_sniffers.values():
+                try:
+                    sniffer.process(info, self)
+                except Exception:
+                    logger.exception("output sniffer %s failed", sniffer.plugin_name)
+
+    def close(self) -> None:
+        if self._worker is not None:
+            self._queue.put(None)
+            self._worker.join(timeout=5)
+            self._worker = None
+
+    def describe(self) -> dict:
+        def block(plugins: dict[str, EngineServerPlugin]) -> dict:
+            return {
+                name: {
+                    "name": p.plugin_name,
+                    "description": p.plugin_description,
+                    "class": type(p).__qualname__,
+                }
+                for name, p in plugins.items()
+            }
+
+        return {
+            "plugins": {
+                "outputblockers": block(self.output_blockers),
+                "outputsniffers": block(self.output_sniffers),
+            }
+        }
+
+
+class _Reject(Exception):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        self.message = message
+
+
+class EngineService:
+    """Transport-free request logic — the ServerActor routes
+    (CreateServer.scala:405-683)."""
+
+    def __init__(
+        self,
+        deployed: DeployedEngine,
+        config: ServerConfig = ServerConfig(),
+        storage: Storage | None = None,
+        ctx: EngineContext | None = None,
+        plugin_context: EngineServerPluginContext | None = None,
+    ):
+        self.deployed = deployed
+        self.config = config
+        self.storage = storage
+        self.ctx = ctx
+        self.plugins = plugin_context or EngineServerPluginContext()
+        #: set by the HTTP wrapper; called on authorized POST /stop
+        self.on_stop = lambda: None
+
+    # -- auth (KeyAuthentication.withAccessKeyFromFile) ---------------------
+    def _check_server_key(self, params: Mapping[str, str]) -> None:
+        if self.config.server_key is None:
+            return
+        if params.get("accessKey") != self.config.server_key:
+            raise _Reject(401, "invalid accessKey")
+
+    # -- routes -------------------------------------------------------------
+    def handle(
+        self,
+        method: str,
+        path: str,
+        params: Mapping[str, str],
+        headers: Mapping[str, str],
+        body: Any,
+    ) -> tuple[int, Any]:
+        try:
+            if method == "GET" and path == "/":
+                return (200, self.status_doc())
+            if method == "POST" and path == "/queries.json":
+                return self.handle_query(body)
+            if method == "GET" and path == "/plugins.json":
+                return (200, self.plugins.describe())
+            if path == "/reload" and method in ("GET", "POST"):
+                self._check_server_key(params)
+                try:
+                    self.reload()
+                except LookupError as e:
+                    raise _Reject(404, str(e))
+                return (200, {"message": "Reloading"})
+            if method == "POST" and path == "/stop":
+                self._check_server_key(params)
+                threading.Thread(target=self.on_stop, daemon=True).start()
+                return (200, {"message": "Shutting down"})
+            return (404, {"message": f"no route for {method} {path}"})
+        except _Reject as r:
+            return (r.status, {"message": r.message})
+        except Exception as e:
+            logger.exception("unhandled error in %s %s", method, path)
+            return (500, {"message": f"internal error: {e}"})
+
+    def status_doc(self) -> dict:
+        """The GET / status page content (CreateServer.scala:442-469)."""
+        d = self.deployed
+        inst = d.instance
+        return {
+            "status": "alive",
+            "engineInstanceId": inst.id,
+            "engineFactory": inst.engine_factory,
+            "engineVariant": inst.engine_variant,
+            "startTime": inst.start_time.isoformat(),
+            "completionTime": inst.completion_time.isoformat(),
+            "algorithms": [type(a).__name__ for a in d.algorithms],
+            "serving": type(d.serving).__name__,
+            "requestCount": d.request_count,
+            "avgServingSec": d.avg_serving_sec,
+            "lastServingSec": d.last_serving_sec,
+        }
+
+    def handle_query(self, body: Any) -> tuple[int, Any]:
+        """POST /queries.json (CreateServer.scala:470-621)."""
+        if body is None or not isinstance(body, dict):
+            raise _Reject(400, "the request body must be a JSON object")
+        # prId is feedback-loop metadata carried alongside any query
+        # (CreateServer.scala:506-512), not a query field — strip before
+        # binding so strict from_wire doesn't reject it
+        body = dict(body)
+        pr_id_in = body.pop("prId", None)
+        query_class = self.deployed.query_class
+        try:
+            query = from_wire(query_class, body) if query_class else body
+        except (ValueError, TypeError) as e:
+            raise _Reject(400, f"invalid query: {e}")
+
+        try:
+            prediction = self.deployed.query(query)
+        except Exception as e:
+            logger.exception("query failed")
+            raise _Reject(500, f"query failed: {e}")
+
+        info = QueryInfo(
+            query=query,
+            prediction=prediction,
+            engine_instance_id=self.deployed.instance.id,
+        )
+        try:
+            prediction = self.plugins.run_blockers(info)
+        except Exception as e:
+            # a raising blocker rejects the prediction (plugin contract);
+            # same mapping the event server uses for input blockers
+            logger.warning("output blocker rejected query: %s", e)
+            raise _Reject(403, f"prediction rejected: {e}")
+        self.plugins.notify_sniffers(info)
+
+        response = to_wire(prediction)
+        if not isinstance(response, dict):
+            response = {"result": response}
+        if self.config.feedback:
+            # feedback loop (CreateServer.scala:514-576): tag the response
+            # with a prId and post the (query, prediction) as events
+            pr_id = pr_id_in or uuid.uuid4().hex
+            response["prId"] = pr_id
+            self._post_feedback(pr_id, body, response)
+        return (200, response)
+
+    def reload(self) -> None:
+        """Hot-swap to the latest completed instance
+        (CreateServer.scala:316-342)."""
+        new = load_deployed_engine(
+            storage=self.storage,
+            config=dataclasses.replace(self.config, engine_instance_id=None),
+            ctx=self.ctx,
+            engine=self.deployed.engine,
+        )
+        old_id = self.deployed.instance.id
+        self.deployed = new
+        logger.info("reloaded: instance %s -> %s", old_id, new.instance.id)
+
+    # -- feedback loop ------------------------------------------------------
+    def _post_feedback(self, pr_id: str, query_json: dict, response: dict) -> None:
+        """Fire-and-forget POST to the event server
+        (CreateServer.scala:550-566)."""
+
+        def post() -> None:
+            import urllib.request
+
+            url = (
+                f"http://{self.config.event_server_ip}:{self.config.event_server_port}"
+                f"/events.json?accessKey={self.config.access_key}"
+            )
+            event = {
+                "event": "predict",
+                "entityType": "pio_pr",
+                "entityId": pr_id,
+                "properties": {"query": query_json, "prediction": response},
+            }
+            try:
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(event).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=10):
+                    pass
+            except Exception as e:
+                logger.warning("feedback event POST failed: %s", e)
+
+        threading.Thread(target=post, name="pio-feedback", daemon=True).start()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: EngineService  # bound per server
+
+    def _params(self) -> dict[str, str]:
+        return {k: v[0] for k, v in parse_qs(urlparse(self.path).query).items()}
+
+    def _dispatch(self, method: str) -> None:
+        path = urlparse(self.path).path
+        body: Any = None
+        if method == "POST":
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            if raw:
+                try:
+                    body = json.loads(raw)
+                except json.JSONDecodeError:
+                    self._respond(400, {"message": "the request body is not valid JSON"})
+                    return
+        status, payload = self.service.handle(
+            method, path, self._params(), dict(self.headers.items()), body
+        )
+        self._respond(status, payload)
+
+    def _respond(self, status: int, payload: Any) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._dispatch("POST")
+
+    def log_message(self, format: str, *args) -> None:
+        logger.debug("%s - %s", self.address_string(), format % args)
+
+
+def undeploy(ip: str, port: int, server_key: str | None = None) -> bool:
+    """POST /stop to a running engine server on (ip, port) — the
+    MasterActor undeploy of a previous instance (CreateServer.scala:260-294)
+    and the CLI `pio undeploy` (commands/Engine.scala:240-276)."""
+    import urllib.error
+    import urllib.request
+
+    host = "127.0.0.1" if ip == "0.0.0.0" else ip
+    url = f"http://{host}:{port}/stop"
+    if server_key:
+        url += f"?accessKey={server_key}"
+    try:
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=5):
+            return True
+    except (urllib.error.URLError, OSError):
+        return False
+
+
+class EngineServer:
+    """HTTP lifecycle around EngineService — the MasterActor
+    (CreateServer.scala:247-382): undeploys any previous server on the
+    port, binds with retry ×3, owns shutdown."""
+
+    BIND_RETRIES = 3
+
+    def __init__(
+        self,
+        deployed: DeployedEngine,
+        config: ServerConfig = ServerConfig(),
+        storage: Storage | None = None,
+        ctx: EngineContext | None = None,
+        plugin_context: EngineServerPluginContext | None = None,
+    ):
+        self.config = config
+        self.service = EngineService(deployed, config, storage, ctx, plugin_context)
+        handler = type("BoundHandler", (_Handler,), {"service": self.service})
+        last_err: OSError | None = None
+        for attempt in range(self.BIND_RETRIES):
+            try:
+                self._httpd = ThreadingHTTPServer((config.ip, config.port), handler)
+                break
+            except OSError as e:
+                last_err = e
+                if attempt == 0 and config.port:
+                    # a previous instance may hold the port — undeploy it
+                    undeploy(config.ip, config.port, config.server_key)
+                time.sleep(1.0)
+        else:
+            raise last_err
+        self.service.on_stop = self.stop
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pio-engineserver", daemon=True
+        )
+        self._thread.start()
+        logger.info("Engine Server listening on %s:%s", self.config.ip, self.port)
+
+    def serve_forever(self) -> None:
+        logger.info("Engine Server listening on %s:%s", self.config.ip, self.port)
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self.service.plugins.close()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def create_engine_server(
+    storage: Storage | None = None,
+    config: ServerConfig = ServerConfig(),
+    ctx: EngineContext | None = None,
+    engine: Any = None,
+    plugin_context: EngineServerPluginContext | None = None,
+) -> EngineServer:
+    """Load the engine instance and bind the server — CreateServer.main
+    (CreateServer.scala:105-180)."""
+    storage = storage or Storage.default()
+    deployed = load_deployed_engine(storage=storage, config=config, ctx=ctx, engine=engine)
+    return EngineServer(deployed, config, storage, ctx, plugin_context)

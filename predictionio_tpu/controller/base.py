@@ -64,6 +64,12 @@ class BaseComponent:
     #: dataclass bound to this component's engine.json "params" object
     params_class: type = EmptyParams
 
+    #: dataclass the /queries.json body binds to (algorithms/servings).
+    #: Parity: BaseAlgorithm.queryClass via TypeResolver
+    #: (BaseAlgorithm.scala:91-109); declared explicitly here since Python
+    #: generics don't survive to runtime.
+    query_class: type | None = None
+
     def __init__(self, params: Any = None):
         self.params = params if params is not None else EmptyParams()
 

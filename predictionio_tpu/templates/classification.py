@@ -143,6 +143,7 @@ class NaiveBayesAlgorithm(HostModelAlgorithm):
     models/naive_bayes.train_multinomial on the mesh)."""
 
     params_class = AlgorithmParams
+    query_class = Query
 
     def train(self, ctx, pd: TrainingData) -> NBModel:
         mesh = ctx.mesh_if_parallel if self.params.use_mesh else None

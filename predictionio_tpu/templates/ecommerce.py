@@ -173,6 +173,7 @@ class ECommAlgorithm(ShardedAlgorithm):
     """
 
     params_class = ECommAlgorithmParams
+    query_class = Query
 
     def __init__(self, params=None):
         super().__init__(params)

@@ -236,6 +236,7 @@ class ALSAlgorithm(ShardedAlgorithm):
     """
 
     params_class = ALSAlgorithmParams
+    query_class = Query
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
         p = self.params

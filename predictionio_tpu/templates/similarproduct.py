@@ -170,6 +170,7 @@ class SimilarALSAlgorithm(ShardedAlgorithm):
     """
 
     params_class = ALSAlgorithmParams
+    query_class = Query
 
     def train(self, ctx, pd: SimilarPreparedData) -> SimilarModel:
         p = self.params

@@ -1,0 +1,190 @@
+"""CLI subcommands backed by the workflow layer: train, eval, deploy,
+undeploy.
+
+Parity: tools/.../console/Console.scala train:177/eval:227/deploy:255/
+undeploy:313 and commands/Engine.scala:37-318. The reference spawned
+`spark-submit` of CreateWorkflow/CreateServer (Runner.scala:185-307);
+here training and serving run in-process on the JAX runtime — there is no
+assembly jar or process boundary to cross, so `pio build` has no
+equivalent (Python engines import directly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from predictionio_tpu.cli.pio import register_command
+from predictionio_tpu.workflow.context import WorkflowParams
+
+
+def _load_variant(path: str) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# pio train
+# ---------------------------------------------------------------------------
+
+def _configure_train(sub) -> None:
+    p = sub.add_parser("train", help="train an engine variant")
+    p.add_argument("--engine-json", default="engine.json",
+                   help="engine variant file (default: ./engine.json)")
+    p.add_argument("--engine-factory", default="",
+                   help="override engineFactory from engine.json")
+    p.add_argument("--batch", default="", help="batch label")
+    p.add_argument("--skip-sanity-check", action="store_true")
+    p.add_argument("--stop-after-read", action="store_true")
+    p.add_argument("--stop-after-prepare", action="store_true")
+    p.add_argument("--no-save-model", action="store_true", dest="no_save_model")
+
+
+def _cmd_train(args, storage) -> int:
+    from predictionio_tpu.workflow.train import run_train
+
+    variant = _load_variant(args.engine_json)
+    if not variant and not args.engine_factory:
+        print(f"[ERROR] {args.engine_json} not found and no --engine-factory given.")
+        return 1
+    wp = WorkflowParams(
+        batch=args.batch,
+        save_model=not args.no_save_model,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+    outcome = run_train(
+        engine_factory=args.engine_factory,
+        variant=variant,
+        workflow_params=wp,
+        storage=storage,
+    )
+    print(f"[INFO] Training finished: engine instance {outcome.instance_id} "
+          f"({outcome.status})")
+    return 0 if outcome.status in ("COMPLETED", "INTERRUPTED") else 1
+
+
+# ---------------------------------------------------------------------------
+# pio eval
+# ---------------------------------------------------------------------------
+
+def _configure_eval(sub) -> None:
+    p = sub.add_parser("eval", help="evaluate an engine over a params grid")
+    p.add_argument("evaluation", help="Evaluation class spec, e.g. pkg.mod.MyEval")
+    p.add_argument("params_generator", nargs="?", default="",
+                   help="EngineParamsGenerator class spec (defaults to the "
+                        "evaluation module's own generator if omitted)")
+    p.add_argument("--batch", default="")
+
+
+def _cmd_eval(args, storage) -> int:
+    from predictionio_tpu.workflow.evaluation import run_evaluation
+
+    generator = args.params_generator or _default_generator(args.evaluation)
+    outcome = run_evaluation(
+        args.evaluation,
+        generator,
+        workflow_params=WorkflowParams(batch=args.batch),
+        storage=storage,
+    )
+    print(f"[INFO] Evaluation finished: instance {outcome.instance_id}")
+    print(f"[INFO] {outcome.result.to_one_liner()}")
+    return 0
+
+
+def _default_generator(evaluation_spec: str):
+    """When no generator spec is given, look for an EngineParamsGenerator
+    subclass/instance in the evaluation's module (the reference required
+    both classes; this is a convenience on top)."""
+    import importlib
+
+    from predictionio_tpu.controller.evaluation import EngineParamsGenerator
+    from predictionio_tpu.utils.reflection import resolve_attr
+
+    evaluation = resolve_attr(evaluation_spec)
+    module = importlib.import_module(type(evaluation).__module__
+                                     if not isinstance(evaluation, type)
+                                     else evaluation.__module__)
+    for name in dir(module):
+        obj = getattr(module, name)
+        if isinstance(obj, EngineParamsGenerator):
+            return obj
+        if (isinstance(obj, type) and issubclass(obj, EngineParamsGenerator)
+                and obj is not EngineParamsGenerator):
+            return obj()
+    raise ValueError(
+        f"no EngineParamsGenerator found in {module.__name__}; "
+        "pass one explicitly: pio eval <evaluation> <generator>"
+    )
+
+
+# ---------------------------------------------------------------------------
+# pio deploy / undeploy
+# ---------------------------------------------------------------------------
+
+def _configure_deploy(sub) -> None:
+    p = sub.add_parser("deploy", help="deploy the latest trained engine instance")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--engine-instance-id", default=None)
+    p.add_argument("--engine-json", default="engine.json")
+    p.add_argument("--feedback", action="store_true")
+    p.add_argument("--event-server-ip", default="0.0.0.0")
+    p.add_argument("--event-server-port", type=int, default=7070)
+    p.add_argument("--accesskey", default="", help="access key for feedback events")
+    p.add_argument("--server-key", default=None,
+                   help="when set, /stop and /reload require this key")
+
+
+def _cmd_deploy(args, storage) -> int:
+    from predictionio_tpu.api.engine_server import create_engine_server
+    from predictionio_tpu.workflow.deploy import ServerConfig
+
+    variant = _load_variant(args.engine_json)
+    config = ServerConfig(
+        ip=args.ip,
+        port=args.port,
+        engine_instance_id=args.engine_instance_id,
+        engine_id=variant.get("id"),
+        engine_version=variant.get("version"),
+        engine_variant=variant.get("variantId"),
+        feedback=args.feedback,
+        event_server_ip=args.event_server_ip,
+        event_server_port=args.event_server_port,
+        access_key=args.accesskey,
+        server_key=args.server_key,
+    )
+    server = create_engine_server(storage=storage, config=config)
+    print(f"[INFO] Engine instance {server.service.deployed.instance.id} "
+          f"deployed on {args.ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def _configure_undeploy(sub) -> None:
+    p = sub.add_parser("undeploy", help="stop a deployed engine server")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--server-key", default=None)
+
+
+def _cmd_undeploy(args, storage) -> int:
+    from predictionio_tpu.api.engine_server import undeploy
+
+    if undeploy(args.ip, args.port, args.server_key):
+        print(f"[INFO] Undeployed engine server at {args.ip}:{args.port}")
+        return 0
+    print(f"[ERROR] No engine server running at {args.ip}:{args.port}")
+    return 1
+
+
+register_command("train", _configure_train, _cmd_train)
+register_command("eval", _configure_eval, _cmd_eval)
+register_command("deploy", _configure_deploy, _cmd_deploy)
+register_command("undeploy", _configure_undeploy, _cmd_undeploy)

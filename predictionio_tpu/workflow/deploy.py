@@ -1,0 +1,161 @@
+"""Deployment: load a trained engine instance and answer queries.
+
+Parity: core/src/main/scala/.../workflow/CreateServer.scala —
+``createServerActorWithEngine`` (:186-244): look up the EngineInstance
+(latest completed if unspecified, commands/Engine.scala:224-228),
+deserialize the persisted models, run ``Engine.prepare_deploy`` (retrain
+Unit models / reload manifests, Engine.scala:199-257), instantiate the
+algorithms and serving from the stored params, and expose the steady-state
+query path (supplement → per-algo predict → serve, CreateServer.scala:
+470-500).
+
+TPU-first: models stay resident (host or HBM) between requests, and the
+query path re-uses each algorithm's jitted predict functions — there is
+no per-query compilation or device handoff beyond the query tensors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Sequence
+
+from predictionio_tpu.controller.engine import Engine, resolve_engine_factory
+from predictionio_tpu.storage.base import EngineInstance
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.workflow.context import EngineContext, WorkflowParams
+from predictionio_tpu.workflow.persistence import load_models
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    """Parity: ServerConfig (CreateServer.scala:74-103)."""
+
+    ip: str = "0.0.0.0"
+    port: int = 8000
+    engine_instance_id: str | None = None
+    #: defaults match run_train's engine.json fallbacks (train.py:93-95)
+    engine_id: str | None = None
+    engine_version: str | None = None
+    engine_variant: str | None = None
+    #: feedback loop: POST prediction events back to the event server
+    feedback: bool = False
+    event_server_ip: str = "0.0.0.0"
+    event_server_port: int = 7070
+    access_key: str = ""
+    #: when set, /stop and /reload require ?accessKey=<server_key>
+    #: (common KeyAuthentication, KeyAuthentication.scala:33-60)
+    server_key: str | None = None
+
+
+class DeployedEngine:
+    """A loaded engine instance ready to serve queries — the ServerActor
+    state (CreateServer.scala:384-401)."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        instance: EngineInstance,
+        algorithms: Sequence[Any],
+        serving: Any,
+        models: Sequence[Any],
+    ):
+        self.engine = engine
+        self.instance = instance
+        self.algorithms = list(algorithms)
+        self.serving = serving
+        self.models = list(models)
+        self.start_time = time.time()
+        # request bookkeeping (CreateServer.scala:399-401, 583-590);
+        # ThreadingHTTPServer serves queries concurrently — the reference
+        # serialized these updates through an actor, here a lock
+        self._stats_lock = threading.Lock()
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+
+    @property
+    def query_class(self) -> type | None:
+        for component in [*self.algorithms, self.serving]:
+            qc = getattr(component, "query_class", None)
+            if qc is not None:
+                return qc
+        return None
+
+    def query(self, query: Any) -> Any:
+        """The steady-state predict path (CreateServer.scala:479-500)."""
+        t0 = time.perf_counter()
+        supplemented = self.serving.supplement(query)
+        predictions = [
+            algo.predict(model, supplemented)
+            for algo, model in zip(self.algorithms, self.models)
+        ]
+        served = self.serving.serve(query, predictions)
+        dt = time.perf_counter() - t0
+        with self._stats_lock:
+            self.request_count += 1
+            self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+            self.last_serving_sec = dt
+        return served
+
+
+def resolve_engine_instance(
+    storage: Storage,
+    config: ServerConfig,
+) -> EngineInstance:
+    """By id when given, else the latest completed matching
+    (engine_id, engine_version, variant) — commands/Engine.scala:224-228."""
+    instances = storage.get_meta_data_engine_instances()
+    if config.engine_instance_id:
+        instance = instances.get(config.engine_instance_id)
+        if instance is None:
+            raise LookupError(f"engine instance {config.engine_instance_id!r} not found")
+        return instance
+    if config.engine_id is not None:
+        instance = instances.get_latest_completed(
+            config.engine_id,
+            config.engine_version or "1",
+            config.engine_variant or config.engine_id,
+        )
+    else:
+        # no identity given: latest COMPLETED instance overall
+        completed = [i for i in instances.get_all() if i.status == "COMPLETED"]
+        instance = max(completed, key=lambda i: i.start_time, default=None)
+    if instance is None:
+        raise LookupError(
+            "no completed engine instance found; run `pio train` first "
+            f"(engine_id={config.engine_id}, variant={config.engine_variant!r})"
+        )
+    return instance
+
+
+def load_deployed_engine(
+    storage: Storage | None = None,
+    config: ServerConfig = ServerConfig(),
+    ctx: EngineContext | None = None,
+    engine: Engine | None = None,
+) -> DeployedEngine:
+    """createServerActorWithEngine (CreateServer.scala:186-244)."""
+    storage = storage or Storage.default()
+    ctx = ctx or EngineContext(workflow_params=WorkflowParams(), storage=storage)
+    instance = resolve_engine_instance(storage, config)
+    if engine is None:
+        engine = resolve_engine_factory(instance.engine_factory)()
+    engine_params = engine.params_from_instance_json(
+        instance.data_source_params,
+        instance.preparator_params,
+        instance.algorithms_params,
+        instance.serving_params,
+    )
+    persisted = load_models(storage, instance.id)
+    models = engine.prepare_deploy(ctx, engine_params, persisted)
+    _, _, algorithms, serving = engine.make_components(engine_params)
+    logger.info(
+        "deployed engine instance %s (%s; %d algorithm(s))",
+        instance.id, instance.engine_factory, len(algorithms),
+    )
+    return DeployedEngine(engine, instance, algorithms, serving, models)

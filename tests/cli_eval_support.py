@@ -1,0 +1,36 @@
+"""Evaluation + params-generator classes resolvable by spec string from
+the CLI eval test (`pio eval tests.cli_eval_support.CliEvaluation ...`)."""
+
+from __future__ import annotations
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+)
+
+from tests.sample_engine import AlgoParams, DSParams, make_engine
+
+
+class ValueMetric(AverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(p.value)
+
+
+class CliEvaluation(Evaluation):
+    def __init__(self):
+        super().__init__()
+        self.engine_evaluator = (make_engine(), MetricEvaluator(ValueMetric()))
+
+
+class CliParamsList(EngineParamsGenerator):
+    def __init__(self):
+        super().__init__([
+            EngineParams.of(
+                data_source=DSParams(id=1, n_train=4, n_folds=2),
+                algorithms=[("sample", AlgoParams(id=0, mult=m))],
+            )
+            for m in (1, 2)
+        ])

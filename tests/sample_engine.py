@@ -101,6 +101,7 @@ class Model:
 
 class SampleAlgorithm(LocalAlgorithm):
     params_class = AlgoParams
+    query_class = Query
 
     def train(self, ctx, pd: PreparedData) -> Model:
         return Model(algo_id=self.params.id, mult=self.params.mult, source_id=pd.source_id)

@@ -1,0 +1,85 @@
+"""CLI train/eval/deploy/undeploy round-trip — the quickstart lifecycle
+(reference: tests/pio_tests/scenarios/quickstart_test.py) driven through
+`pio` with default sqlite storage in an isolated basedir."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.cli.pio import main
+from predictionio_tpu.storage.registry import Storage
+
+
+@pytest.fixture
+def cli_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    monkeypatch.chdir(tmp_path)
+    Storage.reset_default()
+    yield tmp_path
+    Storage.reset_default()
+
+
+def test_train_eval_deploy_undeploy(cli_env, capsys):
+    engine_json = {
+        "id": "cli-engine",
+        "engineFactory": "tests.sample_engine.engine_factory",
+        "datasource": {"params": {"id": 3, "n_train": 5, "n_folds": 2}},
+        "algorithms": [{"name": "sample", "params": {"id": 0, "mult": 4}}],
+    }
+    (cli_env / "engine.json").write_text(json.dumps(engine_json))
+
+    # train
+    assert main(["train"]) == 0
+    out = capsys.readouterr().out
+    assert "COMPLETED" in out
+
+    # eval (evaluation + generator live in the test support module)
+    assert main([
+        "eval",
+        "tests.cli_eval_support.CliEvaluation",
+        "tests.cli_eval_support.CliParamsList",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "Evaluation finished" in out
+
+    # deploy on an ephemeral port, serve_forever on a thread
+    t = threading.Thread(
+        target=main, args=(["deploy", "--ip", "127.0.0.1", "--port", "18432"],),
+        daemon=True,
+    )
+    t.start()
+    deadline = time.time() + 10
+    status = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen("http://127.0.0.1:18432/", timeout=2) as r:
+                status = json.loads(r.read())
+            break
+        except OSError:
+            time.sleep(0.1)
+    assert status and status["status"] == "alive"
+
+    req = urllib.request.Request(
+        "http://127.0.0.1:18432/queries.json",
+        data=json.dumps({"x": 2}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        result = json.loads(r.read())
+    assert result["value"] == 8  # mult=4
+
+    # undeploy stops it
+    assert main(["undeploy", "--ip", "127.0.0.1", "--port", "18432"]) == 0
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_train_missing_engine_json_fails(cli_env, capsys):
+    assert main(["train", "--engine-json", "nope.json"]) == 1
+    assert "not found" in capsys.readouterr().out
