@@ -17,6 +17,13 @@ from predictionio_tpu.storage.base import AccessKey, App, Channel
 from predictionio_tpu.storage.registry import Storage
 
 
+def find_channel(storage: Storage, app_id: int, channel_name: str):
+    """Channel-by-name within an app, or None — shared by app/channel
+    subcommands and export/import."""
+    channels = storage.get_meta_data_channels().get_by_app_id(app_id)
+    return next((c for c in channels if c.name == channel_name), None)
+
+
 def _cmd_version(args, storage: Storage) -> int:
     print(__version__)
     return 0
@@ -121,10 +128,7 @@ def _cmd_app(args, storage: Storage) -> int:
             print(f"[ERROR] App {args.name} does not exist.")
             return 1
         if args.channel:
-            chan = next(
-                (c for c in channels.get_by_app_id(app.id) if c.name == args.channel),
-                None,
-            )
+            chan = find_channel(storage, app.id, args.channel)
             if chan is None:
                 print(f"[ERROR] Channel {args.channel} does not exist.")
                 return 1
@@ -152,10 +156,7 @@ def _cmd_app(args, storage: Storage) -> int:
         if app is None:
             print(f"[ERROR] App {args.name} does not exist.")
             return 1
-        chan = next(
-            (c for c in channels.get_by_app_id(app.id) if c.name == args.channel),
-            None,
-        )
+        chan = find_channel(storage, app.id, args.channel)
         if chan is None:
             print(f"[ERROR] Channel {args.channel} does not exist.")
             return 1

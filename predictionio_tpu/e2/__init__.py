@@ -1,0 +1,28 @@
+"""e2 — reusable engine-building library.
+
+Parity: the reference's `e2` module (e2/src/main/scala/.../e2/): small,
+engine-agnostic building blocks (categorical Naive Bayes, Markov chain,
+binary vectorizer, cross-validation splitter) re-designed for JAX — count
+aggregation with segment_sum, top-N with lax.top_k, static shapes
+throughout.
+"""
+
+from predictionio_tpu.e2.engine import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+    MarkovChain,
+    MarkovChainModel,
+)
+from predictionio_tpu.e2.evaluation import cross_validation_split
+
+__all__ = [
+    "BinaryVectorizer",
+    "CategoricalNaiveBayes",
+    "CategoricalNaiveBayesModel",
+    "LabeledPoint",
+    "MarkovChain",
+    "MarkovChainModel",
+    "cross_validation_split",
+]

@@ -1,0 +1,269 @@
+"""e2 engine primitives: CategoricalNaiveBayes, MarkovChain,
+BinaryVectorizer.
+
+Parity: e2/src/main/scala/.../e2/engine/{CategoricalNaiveBayes.scala:24-171,
+MarkovChain.scala:26-84, BinaryVectorizer.scala:27-66}. The reference
+computed counts with RDD aggregations; here the host encodes strings to
+dense indices (BiMap) and the counting/normalizing/top-N math runs as
+jitted JAX — segment_sum onto static-shape count tables, lax.top_k for
+transition pruning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Mapping, Sequence
+
+import numpy as np
+
+from predictionio_tpu.utils.bimap import BiMap
+
+# ---------------------------------------------------------------------------
+# CategoricalNaiveBayes
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LabeledPoint:
+    """A string label + string-categorical feature vector.
+    Parity: LabeledPoint (CategoricalNaiveBayes.scala:152-162)."""
+
+    label: str
+    features: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CategoricalNaiveBayesModel:
+    """Log priors + per-(feature-position, value) log likelihoods.
+
+    Parity: CategoricalNaiveBayesModel (CategoricalNaiveBayes.scala:60-150):
+    ``priors``: label -> log P(label); ``likelihoods``: label -> per feature
+    position, value -> log P(value | label, position).
+
+    Arrays: ``log_priors`` [L]; ``log_likelihoods`` [L, F, V] where V is
+    the per-position vocab padded to the max; lookups go through the label
+    and per-position value BiMaps.
+    """
+
+    labels: BiMap
+    value_maps: tuple[BiMap, ...]      # one per feature position
+    log_priors: np.ndarray             # [L]
+    log_likelihoods: np.ndarray        # [L, F, V]
+
+    def log_score(
+        self,
+        point: LabeledPoint,
+        default_likelihood: Callable[[Sequence[float]], float] = lambda ls: -math.inf,
+    ) -> float | None:
+        """Log P(label, features) for the point's own label; None for an
+        unseen label. ``default_likelihood`` maps the label's OTHER
+        likelihoods at that position to a score for an unseen value
+        (CategoricalNaiveBayes.scala:102-139)."""
+        label_ix = self.labels.get(point.label)
+        if label_ix is None:
+            return None
+        return self._score(label_ix, point.features, default_likelihood)
+
+    def _score(self, label_ix, features, default_likelihood):
+        total = float(self.log_priors[label_ix])
+        for pos, value in enumerate(features):
+            value_ix = self.value_maps[pos].get(value)
+            row = self.log_likelihoods[label_ix, pos]
+            if value_ix is None:
+                # the reference's likelihood Map holds only values SEEN
+                # with this label; pass those (finite entries), not the
+                # padded vocab row
+                vocab = len(self.value_maps[pos])
+                seen = [float(v) for v in row[:vocab] if math.isfinite(v)]
+                total += default_likelihood(seen)
+            else:
+                total += float(row[value_ix])
+        return total
+
+    def predict(self, features: Sequence[str]) -> str:
+        """Argmax label (CategoricalNaiveBayes.scala:141-149); unseen
+        values contribute -inf like the reference's default."""
+        best_label, best = None, -math.inf
+        for label, label_ix in self.labels.to_dict().items():
+            s = self._score(label_ix, tuple(features), lambda ls: -math.inf)
+            if s > best:
+                best_label, best = label, s
+        return best_label
+
+
+class CategoricalNaiveBayes:
+    """Parity: CategoricalNaiveBayes.train (CategoricalNaiveBayes.scala:30-58)."""
+
+    @staticmethod
+    def train(points: Sequence[LabeledPoint]) -> CategoricalNaiveBayesModel:
+        if not points:
+            raise ValueError("cannot train on zero points")
+        n_features = len(points[0].features)
+        labels = BiMap.string_int(p.label for p in points)
+        value_maps = tuple(
+            BiMap.string_int(p.features[pos] for p in points)
+            for pos in range(n_features)
+        )
+        n_labels = len(labels)
+        max_vocab = max((len(m) for m in value_maps), default=1)
+
+        # encode to dense indices on host; count with one jitted segment_sum
+        label_ix = np.asarray([labels[p.label] for p in points], dtype=np.int32)
+        feat_ix = np.asarray(
+            [[value_maps[pos][p.features[pos]] for pos in range(n_features)]
+             for p in points],
+            dtype=np.int32,
+        ).reshape(len(points), n_features)
+
+        label_counts, value_counts = _nb_count(
+            label_ix, feat_ix, n_labels, n_features, max_vocab
+        )
+        label_counts = np.asarray(label_counts, dtype=np.float64)
+        value_counts = np.asarray(value_counts, dtype=np.float64)
+
+        log_priors = np.log(label_counts) - math.log(len(points))
+        with np.errstate(divide="ignore"):
+            log_likelihoods = np.log(value_counts) - np.log(
+                label_counts[:, None, None]
+            )
+        # mask out-of-vocab padding per position
+        for pos, m in enumerate(value_maps):
+            log_likelihoods[:, pos, len(m):] = -np.inf
+        return CategoricalNaiveBayesModel(
+            labels=labels,
+            value_maps=value_maps,
+            log_priors=log_priors,
+            log_likelihoods=log_likelihoods,
+        )
+
+
+def _nb_count(label_ix, feat_ix, n_labels, n_features, max_vocab):
+    """Count tables via segment_sum — the RDD combineByKey of
+    CategoricalNaiveBayes.scala:33-49 as one jitted reduction."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def count(label_ix, feat_ix):
+        label_counts = jax.ops.segment_sum(
+            jnp.ones_like(label_ix, dtype=jnp.float32), label_ix,
+            num_segments=n_labels,
+        )
+        # flatten (label, position, value) to one segment id per cell
+        pos_ix = jnp.arange(n_features, dtype=jnp.int32)[None, :]
+        flat = (
+            label_ix[:, None] * (n_features * max_vocab)
+            + pos_ix * max_vocab
+            + feat_ix
+        ).reshape(-1)
+        value_counts = jax.ops.segment_sum(
+            jnp.ones_like(flat, dtype=jnp.float32), flat,
+            num_segments=n_labels * n_features * max_vocab,
+        ).reshape(n_labels, n_features, max_vocab)
+        return label_counts, value_counts
+
+    return count(jnp.asarray(label_ix), jnp.asarray(feat_ix))
+
+
+# ---------------------------------------------------------------------------
+# MarkovChain
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MarkovChainModel:
+    """Top-N outgoing transitions per state.
+    Parity: MarkovChainModel (MarkovChain.scala:56-69)."""
+
+    n_states: int
+    top_n: int
+    #: [S, top_n] column indices and normalized probabilities, -1 padded
+    transition_index: np.ndarray
+    transition_prob: np.ndarray
+
+    def predict(self, state: int) -> list[tuple[int, float]]:
+        """Top transitions from ``state`` (MarkovChain.scala:71-79)."""
+        out = []
+        for j, p in zip(self.transition_index[state], self.transition_prob[state]):
+            if j >= 0 and p > 0:
+                out.append((int(j), float(p)))
+        return out
+
+
+class MarkovChain:
+    """Parity: MarkovChain.train (MarkovChain.scala:33-54): row-normalize
+    the transition-count matrix, keep the top-N per row. Dense [S, S]
+    build + lax.top_k, jitted."""
+
+    @staticmethod
+    def train(
+        n_states: int,
+        transitions: Sequence[tuple[int, int, float]],
+        top_n: int = 10,
+    ) -> MarkovChainModel:
+        import jax
+        import jax.numpy as jnp
+
+        rows = np.asarray([t[0] for t in transitions], dtype=np.int32)
+        cols = np.asarray([t[1] for t in transitions], dtype=np.int32)
+        vals = np.asarray([t[2] for t in transitions], dtype=np.float32)
+        k = min(top_n, n_states)
+
+        @jax.jit
+        def build(rows, cols, vals):
+            dense = jnp.zeros((n_states, n_states), dtype=jnp.float32)
+            dense = dense.at[rows, cols].add(vals)
+            row_sums = dense.sum(axis=1, keepdims=True)
+            probs = jnp.where(row_sums > 0, dense / jnp.maximum(row_sums, 1e-30), 0.0)
+            top_p, top_i = jax.lax.top_k(probs, k)
+            top_i = jnp.where(top_p > 0, top_i, -1)
+            return top_i, top_p
+
+        top_i, top_p = build(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals))
+        return MarkovChainModel(
+            n_states=n_states,
+            top_n=k,
+            transition_index=np.asarray(top_i),
+            transition_prob=np.asarray(top_p),
+        )
+
+
+# ---------------------------------------------------------------------------
+# BinaryVectorizer
+# ---------------------------------------------------------------------------
+
+
+class BinaryVectorizer:
+    """(property, value) -> one-hot index encoder.
+    Parity: BinaryVectorizer (BinaryVectorizer.scala:27-66)."""
+
+    def __init__(self, property_map: BiMap):
+        self.property_map = property_map
+
+    @staticmethod
+    def fit(pairs) -> "BinaryVectorizer":
+        """Build the index from observed (property, value) pairs
+        (BinaryVectorizer.scala:31-41)."""
+        return BinaryVectorizer(BiMap.string_int(tuple(p) for p in pairs))
+
+    def __len__(self) -> int:
+        return len(self.property_map)
+
+    def to_binary(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:
+        """One-hot encode; unknown pairs are ignored
+        (BinaryVectorizer.scala:43-53)."""
+        vec = np.zeros(len(self.property_map), dtype=np.float32)
+        for pair in pairs:
+            ix = self.property_map.get(tuple(pair))
+            if ix is not None:
+                vec[ix] = 1.0
+        return vec
+
+    def to_binary_batch(self, batch: Sequence[Sequence[tuple[str, str]]]) -> np.ndarray:
+        """[B, D] one-hot matrix — the batched form algorithms feed to the
+        mesh (rows become MXU matmul operands downstream)."""
+        out = np.zeros((len(batch), len(self.property_map)), dtype=np.float32)
+        for i, pairs in enumerate(batch):
+            out[i] = self.to_binary(pairs)
+        return out

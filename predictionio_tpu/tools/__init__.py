@@ -1,0 +1,5 @@
+"""Ops tooling: dashboard, admin API, event export/import.
+
+Parity: the reference's `tools` module servers and Spark drivers
+(tools/src/main/scala/.../tools/{dashboard/,admin/,export/,imprt/}).
+"""

@@ -1,0 +1,132 @@
+"""Evaluation dashboard on :9000.
+
+Parity: tools/src/main/scala/.../tools/dashboard/Dashboard.scala:40-160 —
+lists completed EvaluationInstances newest-first and serves each
+instance's evaluator results as text, HTML, or JSON:
+
+- ``GET /``                                        HTML index of completed
+                                                   evaluation instances
+- ``GET /engine_instances/{id}/evaluator_results.txt``
+- ``GET /engine_instances/{id}/evaluator_results.html``
+- ``GET /engine_instances/{id}/evaluator_results.json``
+
+(the reference's path segment is "engine_instances" even though the data
+is EvaluationInstances — kept for URL parity, Dashboard.scala:101-141).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from predictionio_tpu.storage.registry import Storage
+
+logger = logging.getLogger(__name__)
+
+_RESULTS_RE = re.compile(
+    r"^/engine_instances/([^/]+)/evaluator_results\.(txt|html|json)$"
+)
+
+
+class DashboardService:
+    def __init__(self, storage: Storage | None = None):
+        self.storage = storage or Storage.default()
+
+    def handle(self, method: str, path: str) -> tuple[int, str, str]:
+        """Returns (status, content_type, body)."""
+        if method != "GET":
+            return (405, "application/json", json.dumps({"message": "GET only"}))
+        if path == "/":
+            return (200, "text/html; charset=UTF-8", self.index_html())
+        m = _RESULTS_RE.match(path)
+        if m:
+            instance_id, fmt = m.groups()
+            instance = self.storage.get_meta_data_evaluation_instances().get(instance_id)
+            if instance is None or instance.status != "EVALCOMPLETED":
+                return (404, "application/json",
+                        json.dumps({"message": f"instance {instance_id} not found"}))
+            if fmt == "txt":
+                return (200, "text/plain; charset=UTF-8", instance.evaluator_results)
+            if fmt == "html":
+                return (200, "text/html; charset=UTF-8", instance.evaluator_results_html)
+            return (200, "application/json", instance.evaluator_results_json or "{}")
+        return (404, "application/json", json.dumps({"message": f"no route for {path}"}))
+
+    def index_html(self) -> str:
+        """The dashboard index (Dashboard.scala:93-100 + twirl template)."""
+        rows = []
+        for inst in self.storage.get_meta_data_evaluation_instances().get_completed():
+            rows.append(
+                "<tr><td>{id}</td><td>{start}</td><td>{cls}</td><td>{oneliner}</td>"
+                "<td><a href='/engine_instances/{id}/evaluator_results.txt'>txt</a> "
+                "<a href='/engine_instances/{id}/evaluator_results.html'>HTML</a> "
+                "<a href='/engine_instances/{id}/evaluator_results.json'>JSON</a>"
+                "</td></tr>".format(
+                    id=html.escape(inst.id),
+                    start=html.escape(inst.start_time.isoformat()),
+                    cls=html.escape(inst.evaluation_class),
+                    oneliner=html.escape(inst.evaluator_results[:200]),
+                )
+            )
+        return (
+            "<html><head><title>predictionio_tpu dashboard</title></head><body>"
+            "<h1>Completed Evaluations</h1>"
+            "<table border=1><tr><th>ID</th><th>Started</th><th>Evaluation</th>"
+            "<th>Result</th><th>Details</th></tr>"
+            + "".join(rows)
+            + "</table></body></html>"
+        )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: DashboardService
+
+    def do_GET(self) -> None:  # noqa: N802
+        status, ctype, body = self.service.handle("GET", self.path.split("?")[0])
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, format: str, *args) -> None:
+        logger.debug("%s - %s", self.address_string(), format % args)
+
+
+class Dashboard:
+    """Parity: Dashboard.createDashboard (Dashboard.scala:60-91)."""
+
+    def __init__(self, storage: Storage | None = None, ip: str = "0.0.0.0",
+                 port: int = 9000):
+        self.ip = ip
+        self.service = DashboardService(storage)
+        handler = type("BoundHandler", (_Handler,), {"service": self.service})
+        self._httpd = ThreadingHTTPServer((ip, port), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pio-dashboard", daemon=True
+        )
+        self._thread.start()
+        logger.info("Dashboard listening on %s:%s", self.ip, self.port)
+
+    def serve_forever(self) -> None:
+        logger.info("Dashboard listening on %s:%s", self.ip, self.port)
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,12 +1,13 @@
-"""CLI subcommands backed by the workflow layer: train, eval, deploy,
-undeploy.
+"""CLI subcommands backed by the workflow and tools layers: train, eval,
+deploy, undeploy, dashboard, adminserver, export, import.
 
 Parity: tools/.../console/Console.scala train:177/eval:227/deploy:255/
-undeploy:313 and commands/Engine.scala:37-318. The reference spawned
-`spark-submit` of CreateWorkflow/CreateServer (Runner.scala:185-307);
-here training and serving run in-process on the JAX runtime — there is no
-assembly jar or process boundary to cross, so `pio build` has no
-equivalent (Python engines import directly).
+undeploy:313/dashboard:326/adminserver:354/export:561/import:578 and
+commands/Engine.scala:37-318. The reference spawned `spark-submit` of
+CreateWorkflow/CreateServer (Runner.scala:185-307); here training and
+serving run in-process on the JAX runtime — there is no assembly jar or
+process boundary to cross, so `pio build` has no equivalent (Python
+engines import directly).
 """
 
 from __future__ import annotations
@@ -14,7 +15,7 @@ from __future__ import annotations
 import json
 import os
 
-from predictionio_tpu.cli.pio import register_command
+from predictionio_tpu.cli.pio import find_channel, register_command
 from predictionio_tpu.workflow.context import WorkflowParams
 
 
@@ -23,6 +24,17 @@ def _load_variant(path: str) -> dict:
         return {}
     with open(path) as f:
         return json.load(f)
+
+
+def _serve(server, label: str, ip: str) -> int:
+    """Print the bound address and block until interrupt — shared by every
+    server-launching subcommand."""
+    print(f"[INFO] {label} listening on {ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -158,13 +170,11 @@ def _cmd_deploy(args, storage) -> int:
         server_key=args.server_key,
     )
     server = create_engine_server(storage=storage, config=config)
-    print(f"[INFO] Engine instance {server.service.deployed.instance.id} "
-          f"deployed on {args.ip}:{server.port}")
-    try:
-        server.serve_forever()
-    except KeyboardInterrupt:
-        server.stop()
-    return 0
+    return _serve(
+        server,
+        f"Engine instance {server.service.deployed.instance.id}",
+        args.ip,
+    )
 
 
 def _configure_undeploy(sub) -> None:
@@ -184,7 +194,106 @@ def _cmd_undeploy(args, storage) -> int:
     return 1
 
 
+# ---------------------------------------------------------------------------
+# pio dashboard / adminserver
+# ---------------------------------------------------------------------------
+
+def _configure_dashboard(sub) -> None:
+    p = sub.add_parser("dashboard", help="launch the evaluation dashboard")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9000)
+
+
+def _cmd_dashboard(args, storage) -> int:
+    from predictionio_tpu.tools.dashboard import Dashboard
+
+    return _serve(Dashboard(storage, ip=args.ip, port=args.port),
+                  "Dashboard", args.ip)
+
+
+def _configure_adminserver(sub) -> None:
+    p = sub.add_parser("adminserver", help="launch the admin REST API")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7071)
+
+
+def _cmd_adminserver(args, storage) -> int:
+    from predictionio_tpu.tools.admin import AdminServer
+
+    return _serve(AdminServer(storage, ip=args.ip, port=args.port),
+                  "Admin API", args.ip)
+
+
+# ---------------------------------------------------------------------------
+# pio export / import
+# ---------------------------------------------------------------------------
+
+def _configure_export(sub) -> None:
+    p = sub.add_parser("export", help="export an app's events to a JSON-lines file")
+    p.add_argument("--appid", type=int, required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--channel", default=None)
+
+
+def _resolve_app_channel(storage, app_id: int, channel_name: str | None):
+    """Validate --appid refers to a real app (unlike raw DAO access, which
+    would silently auto-init an orphan event table) and resolve --channel.
+    Returns (ok, channel_id)."""
+    if storage.get_meta_data_apps().get(app_id) is None:
+        print(f"[ERROR] App id {app_id} does not exist.")
+        return False, None
+    if channel_name is None:
+        return True, None
+    chan = find_channel(storage, app_id, channel_name)
+    if chan is None:
+        print(f"[ERROR] Channel {channel_name} does not exist.")
+        return False, None
+    return True, chan.id
+
+
+def _cmd_export(args, storage) -> int:
+    from predictionio_tpu.tools.export_import import export_events
+
+    ok, channel_id = _resolve_app_channel(storage, args.appid, args.channel)
+    if not ok:
+        return 1
+    with open(args.output, "w") as f:
+        n = export_events(storage, args.appid, f, channel_id)
+    print(f"[INFO] Exported {n} events to {args.output}")
+    return 0
+
+
+def _configure_import(sub) -> None:
+    p = sub.add_parser("import", help="import events from a JSON-lines file")
+    p.add_argument("--appid", type=int, required=True)
+    p.add_argument("--input", required=True)
+    p.add_argument("--channel", default=None)
+
+
+def _cmd_import(args, storage) -> int:
+    from predictionio_tpu.tools.export_import import ImportFormatError, import_events
+
+    ok, channel_id = _resolve_app_channel(storage, args.appid, args.channel)
+    if not ok:
+        return 1
+    if not os.path.exists(args.input):
+        print(f"[ERROR] {args.input} not found.")
+        return 1
+    try:
+        with open(args.input) as f:
+            n = import_events(storage, args.appid, f, channel_id)
+    except ImportFormatError as e:
+        print(f"[ERROR] {args.input}: {e}")
+        return 1
+    print(f"[INFO] Imported {n} events from {args.input}")
+    return 0
+
+
 register_command("train", _configure_train, _cmd_train)
 register_command("eval", _configure_eval, _cmd_eval)
 register_command("deploy", _configure_deploy, _cmd_deploy)
 register_command("undeploy", _configure_undeploy, _cmd_undeploy)
+register_command("dashboard", _configure_dashboard, _cmd_dashboard)
+register_command("adminserver", _configure_adminserver, _cmd_adminserver)
+register_command("export", _configure_export, _cmd_export)
+register_command("import", _configure_import, _cmd_import)

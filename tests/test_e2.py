@@ -1,0 +1,170 @@
+"""e2 library tests — categorical NB, Markov chain, binary vectorizer,
+cross-validation (modeled on the reference's e2/src/test specs and their
+fixtures: NaiveBayesFixture, MarkovChainFixture, BinaryVectorizerFixture)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    LabeledPoint,
+    MarkovChain,
+    cross_validation_split,
+)
+
+
+# ---------------------------------------------------------------------------
+# CategoricalNaiveBayes (reference spec: CategoricalNaiveBayesTest)
+# ---------------------------------------------------------------------------
+
+POINTS = [
+    LabeledPoint("spam", ("buy", "cheap")),
+    LabeledPoint("spam", ("buy", "now")),
+    LabeledPoint("spam", ("buy", "cheap")),
+    LabeledPoint("ham", ("hello", "friend")),
+    LabeledPoint("ham", ("hello", "now")),
+]
+
+
+class TestCategoricalNaiveBayes:
+    def test_priors(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.log_priors[model.labels["spam"]] == pytest.approx(math.log(3 / 5))
+        assert model.log_priors[model.labels["ham"]] == pytest.approx(math.log(2 / 5))
+
+    def test_likelihoods(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        spam = model.labels["spam"]
+        buy = model.value_maps[0]["buy"]
+        cheap = model.value_maps[1]["cheap"]
+        assert model.log_likelihoods[spam, 0, buy] == pytest.approx(math.log(3 / 3))
+        assert model.log_likelihoods[spam, 1, cheap] == pytest.approx(math.log(2 / 3))
+
+    def test_log_score_and_predict(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        s = model.log_score(LabeledPoint("spam", ("buy", "cheap")))
+        assert s == pytest.approx(math.log(3 / 5) + math.log(1.0) + math.log(2 / 3))
+        assert model.predict(("buy", "cheap")) == "spam"
+        assert model.predict(("hello", "friend")) == "ham"
+
+    def test_unseen_label_scores_none(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.log_score(LabeledPoint("eggs", ("buy", "cheap"))) is None
+
+    def test_unseen_value_default_likelihood(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        # default: -inf
+        assert model.log_score(LabeledPoint("spam", ("buy", "UNSEEN"))) == -math.inf
+        # custom default (reference passes the label's other likelihoods)
+        s = model.log_score(
+            LabeledPoint("spam", ("buy", "UNSEEN")),
+            default_likelihood=lambda ls: min(ls) - math.log(2),
+        )
+        assert math.isfinite(s)
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            CategoricalNaiveBayes.train([])
+
+
+# ---------------------------------------------------------------------------
+# MarkovChain (reference spec: MarkovChainTest)
+# ---------------------------------------------------------------------------
+
+class TestMarkovChain:
+    def test_row_normalization_and_topn(self):
+        # state 0 -> 1 (3 times), -> 2 (1 time); state 1 -> 2 (2)
+        model = MarkovChain.train(
+            n_states=3,
+            transitions=[(0, 1, 3.0), (0, 2, 1.0), (1, 2, 2.0)],
+            top_n=2,
+        )
+        out = dict(model.predict(0))
+        assert out[1] == pytest.approx(0.75)
+        assert out[2] == pytest.approx(0.25)
+        assert dict(model.predict(1)) == {2: pytest.approx(1.0)}
+        assert model.predict(2) == []  # no outgoing transitions
+
+    def test_topn_truncates(self):
+        model = MarkovChain.train(
+            n_states=4,
+            transitions=[(0, j, float(j + 1)) for j in range(1, 4)],
+            top_n=2,
+        )
+        out = model.predict(0)
+        assert len(out) == 2
+        assert out[0][0] == 3  # highest-probability transition first
+
+    def test_duplicate_transitions_accumulate(self):
+        model = MarkovChain.train(
+            n_states=2, transitions=[(0, 1, 1.0), (0, 1, 1.0)], top_n=1
+        )
+        assert dict(model.predict(0)) == {1: pytest.approx(1.0)}
+
+
+# ---------------------------------------------------------------------------
+# BinaryVectorizer (reference spec: BinaryVectorizerTest)
+# ---------------------------------------------------------------------------
+
+class TestBinaryVectorizer:
+    def test_fit_and_encode(self):
+        vec = BinaryVectorizer.fit([("color", "red"), ("color", "blue"), ("size", "L")])
+        assert len(vec) == 3
+        v = vec.to_binary([("color", "red"), ("size", "L")])
+        assert v.sum() == 2.0
+        assert v[vec.property_map[("color", "red")]] == 1.0
+        assert v[vec.property_map[("size", "L")]] == 1.0
+
+    def test_unknown_pairs_ignored(self):
+        vec = BinaryVectorizer.fit([("a", "1")])
+        v = vec.to_binary([("a", "1"), ("zz", "99")])
+        assert v.tolist() == [1.0]
+
+    def test_batch(self):
+        vec = BinaryVectorizer.fit([("a", "1"), ("b", "2")])
+        m = vec.to_binary_batch([[("a", "1")], [("b", "2")], []])
+        assert m.shape == (3, 2)
+        assert m.sum(axis=1).tolist() == [1.0, 1.0, 0.0]
+
+
+# ---------------------------------------------------------------------------
+# cross_validation_split (reference spec: CrossValidationTest)
+# ---------------------------------------------------------------------------
+
+class TestCrossValidation:
+    def test_folds_partition_data(self):
+        data = list(range(10))
+        folds = cross_validation_split(
+            data, k=3,
+            make_training=tuple,
+            make_query_actual=lambda d: (d, d * 10),
+            eval_info={"name": "cv"},
+        )
+        assert len(folds) == 3
+        all_eval = []
+        for td, ei, qa in folds:
+            assert ei == {"name": "cv"}
+            eval_items = [q for q, _ in qa]
+            # training and eval are disjoint and cover everything
+            assert set(td) | set(eval_items) == set(data)
+            assert set(td) & set(eval_items) == set()
+            all_eval.extend(eval_items)
+        # each record held out exactly once across folds
+        assert sorted(all_eval) == data
+
+    def test_actuals_derived(self):
+        folds = cross_validation_split(
+            [1, 2], k=2, make_training=list, make_query_actual=lambda d: (d, d * 10)
+        )
+        assert folds[0][2] == [(1, 10)]
+        assert folds[1][2] == [(2, 20)]
+
+    def test_invalid_k(self):
+        with pytest.raises(ValueError):
+            cross_validation_split([1], k=0, make_training=list,
+                                   make_query_actual=lambda d: (d, d))

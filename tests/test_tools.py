@@ -1,0 +1,269 @@
+"""Dashboard, Admin API, export/import, SelfCleaningDataSource tests
+(reference specs: AdminAPISpec, the dashboard twirl listing, EventsToFile/
+FileToEvents drivers, SelfCleaningDataSource behavior)."""
+
+from __future__ import annotations
+
+import io
+import json
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.data.self_cleaning import EventWindow, SelfCleaningDataSource
+from predictionio_tpu.storage.base import App, EventFilter
+from predictionio_tpu.tools.admin import AdminServer
+from predictionio_tpu.tools.dashboard import Dashboard
+from predictionio_tpu.tools.export_import import export_events, import_events
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.headers.get_content_type(), r.read().decode()
+
+
+def _req(url, method, payload=None):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# Admin API
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def admin(storage):
+    server = AdminServer(storage, ip="127.0.0.1", port=0)
+    server.start()
+    yield server, storage
+    server.stop()
+
+
+class TestAdminAPI:
+    def test_health(self, admin):
+        server, _ = admin
+        _, payload = _req(f"http://127.0.0.1:{server.port}/", "GET")
+        assert payload == {"status": "alive"}
+
+    def test_app_lifecycle(self, admin):
+        server, storage = admin
+        base = f"http://127.0.0.1:{server.port}"
+
+        status, created = _req(f"{base}/cmd/app", "POST", {"name": "AdminApp"})
+        assert status == 201
+        assert created["name"] == "AdminApp"
+        assert created["accessKey"]
+
+        _, listing = _req(f"{base}/cmd/app", "GET")
+        assert [a["name"] for a in listing["apps"]] == ["AdminApp"]
+
+        # duplicate -> 409
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _req(f"{base}/cmd/app", "POST", {"name": "AdminApp"})
+        assert e.value.code == 409
+
+        # seed an event, then data-delete clears it
+        app = storage.get_meta_data_apps().get_by_name("AdminApp")
+        storage.get_events().insert(
+            Event(event="buy", entity_type="user", entity_id="u1"), app.id
+        )
+        assert len(list(storage.get_events().find(app.id, filter=EventFilter()))) == 1
+        status, _ = _req(f"{base}/cmd/app/AdminApp/data", "DELETE")
+        assert status == 200
+        assert list(storage.get_events().find(app.id, filter=EventFilter())) == []
+
+        status, _ = _req(f"{base}/cmd/app/AdminApp", "DELETE")
+        assert status == 200
+        _, listing = _req(f"{base}/cmd/app", "GET")
+        assert listing["apps"] == []
+
+    def test_missing_app_404(self, admin):
+        server, _ = admin
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _req(f"http://127.0.0.1:{server.port}/cmd/app/nope", "DELETE")
+        assert e.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# Dashboard
+# ---------------------------------------------------------------------------
+
+class TestDashboard:
+    def test_lists_completed_evaluations(self, storage):
+        # persist one completed evaluation through the real workflow
+        from predictionio_tpu.controller import EngineParamsGenerator
+        from predictionio_tpu.workflow.evaluation import run_evaluation
+        from tests.cli_eval_support import CliEvaluation, CliParamsList
+
+        outcome = run_evaluation(CliEvaluation(), CliParamsList(), storage=storage)
+
+        dash = Dashboard(storage, ip="127.0.0.1", port=0)
+        dash.start()
+        try:
+            base = f"http://127.0.0.1:{dash.port}"
+            _, ctype, body = _get(f"{base}/")
+            assert ctype == "text/html"
+            assert outcome.instance_id in body
+
+            _, ctype, txt = _get(
+                f"{base}/engine_instances/{outcome.instance_id}/evaluator_results.txt"
+            )
+            assert ctype == "text/plain"
+            assert txt == outcome.result.to_one_liner()
+
+            _, _, js = _get(
+                f"{base}/engine_instances/{outcome.instance_id}/evaluator_results.json"
+            )
+            assert json.loads(js)["bestIdx"] == outcome.result.best_idx
+
+            _, ctype, html_body = _get(
+                f"{base}/engine_instances/{outcome.instance_id}/evaluator_results.html"
+            )
+            assert "<table" in html_body
+
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"{base}/engine_instances/zzz/evaluator_results.txt")
+            assert e.value.code == 404
+        finally:
+            dash.stop()
+
+
+# ---------------------------------------------------------------------------
+# export / import
+# ---------------------------------------------------------------------------
+
+class TestExportImport:
+    def test_round_trip(self, storage):
+        app_id = storage.get_meta_data_apps().insert(App(0, "ExpApp"))
+        events = storage.get_events()
+        events.init(app_id)
+        for i in range(7):
+            events.insert(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{i}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": float(i)}),
+                ),
+                app_id,
+            )
+        buf = io.StringIO()
+        assert export_events(storage, app_id, buf) == 7
+
+        # import into a second app
+        app2 = storage.get_meta_data_apps().insert(App(0, "ImpApp"))
+        events.init(app2)
+        buf.seek(0)
+        assert import_events(storage, app2, buf) == 7
+        imported = sorted(
+            events.find(app2, filter=EventFilter()), key=lambda e: e.entity_id
+        )
+        assert len(imported) == 7
+        assert imported[3].properties["rating"] == 3.0
+        assert imported[3].target_entity_id == "i3"
+
+    def test_malformed_line_reports_position_and_committed(self, storage):
+        from predictionio_tpu.tools.export_import import ImportFormatError
+
+        app_id = storage.get_meta_data_apps().insert(App(0, "BadApp"))
+        storage.get_events().init(app_id)
+        good = json.dumps({"event": "buy", "entityType": "user", "entityId": "u1"})
+        buf = io.StringIO(good + "\n{not json\n")
+        with pytest.raises(ImportFormatError) as e:
+            import_events(storage, app_id, buf)
+        assert e.value.line_no == 2
+
+    def test_cli_import_rejects_unknown_app(self, tmp_path, monkeypatch):
+        from predictionio_tpu.cli.pio import main
+        from predictionio_tpu.storage.registry import Storage
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        Storage.reset_default()
+        try:
+            f = tmp_path / "in.jsonl"
+            f.write_text("")
+            assert main(["import", "--appid", "42", "--input", str(f)]) == 1
+            assert main(["export", "--appid", "42", "--output",
+                         str(tmp_path / "out.jsonl")]) == 1
+        finally:
+            Storage.reset_default()
+
+
+# ---------------------------------------------------------------------------
+# SelfCleaningDataSource
+# ---------------------------------------------------------------------------
+
+def _ev(event, entity_id, props=None, t=None):
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=entity_id,
+        properties=DataMap(props or {}),
+        event_time=t or datetime(2026, 7, 1, tzinfo=timezone.utc),
+    )
+
+
+class _CleaningDS(SelfCleaningDataSource):
+    def __init__(self, window):
+        self.event_window = window
+
+
+class TestSelfCleaningDataSource:
+    NOW = datetime(2026, 7, 10, tzinfo=timezone.utc)
+
+    def test_window_filter(self):
+        ds = _CleaningDS(EventWindow(duration=timedelta(days=3)))
+        old = _ev("buy", "u1", t=datetime(2026, 7, 1, tzinfo=timezone.utc))
+        new = _ev("buy", "u2", t=datetime(2026, 7, 9, tzinfo=timezone.utc))
+        assert ds.clean_events([old, new], now=self.NOW) == [new]
+
+    def test_compress_properties(self):
+        ds = _CleaningDS(EventWindow(compress_properties=True))
+        e1 = _ev("$set", "u1", {"a": 1, "b": 2}, t=datetime(2026, 7, 2, tzinfo=timezone.utc))
+        e2 = _ev("$set", "u1", {"b": 3, "c": 4}, t=datetime(2026, 7, 5, tzinfo=timezone.utc))
+        other = _ev("buy", "u1", t=datetime(2026, 7, 3, tzinfo=timezone.utc))
+        out = ds.clean_events([e1, e2, other], now=self.NOW)
+        sets = [e for e in out if e.event == "$set"]
+        assert len(sets) == 1
+        assert sets[0].properties.fields == {"a": 1, "b": 3, "c": 4}
+        assert sets[0].event_time == e2.event_time
+        assert other in out
+
+    def test_remove_duplicates(self):
+        ds = _CleaningDS(EventWindow(remove_duplicates=True))
+        a = _ev("buy", "u1")
+        b = _ev("buy", "u1")
+        c = _ev("buy", "u2")
+        assert ds.clean_events([a, b, c], now=self.NOW) == [a, c]
+
+    def test_no_window_passthrough(self):
+        ds = _CleaningDS(None)
+        events = [_ev("buy", "u1"), _ev("buy", "u1")]
+        assert ds.clean_events(events, now=self.NOW) == events
+
+    def test_clean_persisted(self, storage):
+        app_id = storage.get_meta_data_apps().insert(App(0, "CleanApp"))
+        dao = storage.get_events()
+        dao.init(app_id)
+        dao.insert(_ev("$set", "u1", {"a": 1}, t=datetime(2026, 7, 2, tzinfo=timezone.utc)), app_id)
+        dao.insert(_ev("$set", "u1", {"a": 2}, t=datetime(2026, 7, 5, tzinfo=timezone.utc)), app_id)
+        dao.insert(_ev("buy", "u2", t=datetime(2026, 7, 6, tzinfo=timezone.utc)), app_id)
+
+        ds = _CleaningDS(EventWindow(compress_properties=True))
+        assert ds.clean_persisted_events(storage, app_id, now=self.NOW) == 2
+        stored = list(dao.find(app_id, filter=EventFilter()))
+        assert len(stored) == 2
+        merged = next(e for e in stored if e.event == "$set")
+        assert merged.properties["a"] == 2
