@@ -32,10 +32,11 @@ import queue
 import threading
 import time
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Mapping
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.api.http_base import RestServer
 from predictionio_tpu.core.wire import from_wire, to_wire
 from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.workflow.context import EngineContext
@@ -384,12 +385,14 @@ def undeploy(ip: str, port: int, server_key: str | None = None) -> bool:
         return False
 
 
-class EngineServer:
+class EngineServer(RestServer):
     """HTTP lifecycle around EngineService — the MasterActor
     (CreateServer.scala:247-382): undeploys any previous server on the
     port, binds with retry ×3, owns shutdown."""
 
-    BIND_RETRIES = 3
+    log_label = "Engine Server"
+    thread_name = "pio-engineserver"
+    bind_retries = 3
 
     def __init__(
         self,
@@ -400,46 +403,20 @@ class EngineServer:
         plugin_context: EngineServerPluginContext | None = None,
     ):
         self.config = config
-        self.service = EngineService(deployed, config, storage, ctx, plugin_context)
-        handler = type("BoundHandler", (_Handler,), {"service": self.service})
-        last_err: OSError | None = None
-        for attempt in range(self.BIND_RETRIES):
-            try:
-                self._httpd = ThreadingHTTPServer((config.ip, config.port), handler)
-                break
-            except OSError as e:
-                last_err = e
-                if attempt == 0 and config.port:
-                    # a previous instance may hold the port — undeploy it
-                    undeploy(config.ip, config.port, config.server_key)
-                time.sleep(1.0)
-        else:
-            raise last_err
-        self.service.on_stop = self.stop
-        self._thread: threading.Thread | None = None
-
-    @property
-    def port(self) -> int:
-        return self._httpd.server_address[1]
-
-    def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="pio-engineserver", daemon=True
+        super().__init__(
+            _Handler,
+            EngineService(deployed, config, storage, ctx, plugin_context),
+            config.ip, config.port,
         )
-        self._thread.start()
-        logger.info("Engine Server listening on %s:%s", self.config.ip, self.port)
+        self.service.on_stop = self.stop
 
-    def serve_forever(self) -> None:
-        logger.info("Engine Server listening on %s:%s", self.config.ip, self.port)
-        self._httpd.serve_forever()
+    def _on_bind_failure(self, attempt: int, ip: str, port: int) -> None:
+        if attempt == 0 and port:
+            # a previous instance may hold the port — undeploy it
+            undeploy(ip, port, self.config.server_key)
 
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+    def _on_close(self) -> None:
         self.service.plugins.close()
-        if self._thread:
-            self._thread.join(timeout=5)
-            self._thread = None
 
 
 def create_engine_server(

@@ -31,10 +31,11 @@ import json
 import logging
 import re
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Mapping
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.api.http_base import RestServer
 from predictionio_tpu.api.plugins import EventInfo, EventServerPluginContext
 from predictionio_tpu.api.stats import StatsKeeper
 from predictionio_tpu.api.webhooks import (
@@ -426,9 +427,12 @@ class _Handler(BaseHTTPRequestHandler):
 _MALFORMED = object()
 
 
-class EventServer:
+class EventServer(RestServer):
     """HTTP wrapper. Parity: EventServer.createEventServer
     (EventServer.scala:632-654) — wires DAOs and binds the port."""
+
+    log_label = "Event Server"
+    thread_name = "pio-eventserver"
 
     def __init__(
         self,
@@ -437,34 +441,13 @@ class EventServer:
         plugin_context: EventServerPluginContext | None = None,
     ):
         self.config = config
-        self.service = EventService(storage, config, plugin_context)
-        handler = type("BoundHandler", (_Handler,), {"service": self.service})
-        self._httpd = ThreadingHTTPServer((config.ip, config.port), handler)
-        self._thread: threading.Thread | None = None
-
-    @property
-    def port(self) -> int:
-        return self._httpd.server_address[1]
-
-    def start(self) -> None:
-        """Serve on a background thread (returns immediately)."""
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="pio-eventserver", daemon=True
+        super().__init__(
+            _Handler, EventService(storage, config, plugin_context),
+            config.ip, config.port,
         )
-        self._thread.start()
-        logger.info("Event Server listening on %s:%s", self.config.ip, self.port)
 
-    def serve_forever(self) -> None:
-        logger.info("Event Server listening on %s:%s", self.config.ip, self.port)
-        self._httpd.serve_forever()
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+    def _on_close(self) -> None:
         self.service.close()
-        if self._thread:
-            self._thread.join(timeout=5)
-            self._thread = None
 
 
 def create_event_server(

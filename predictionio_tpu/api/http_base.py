@@ -1,0 +1,78 @@
+"""Shared REST-server lifecycle for the serving plane.
+
+The four reference servers (event server :7070, engine server :8000,
+dashboard :9000, admin API :7071) all ran on spray/Akka HTTP; here they
+share one stdlib scaffold: a handler class bound to a transport-free
+service object, optional TLS (utils/ssl_config), ephemeral-port support,
+background-thread or blocking serve, and clean shutdown.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+from predictionio_tpu.utils.ssl_config import maybe_enable_ssl
+
+logger = logging.getLogger(__name__)
+
+
+class RestServer:
+    """Subclasses set ``log_label``/``thread_name`` and may override the
+    bind-failure and close hooks."""
+
+    log_label = "Server"
+    thread_name = "pio-server"
+    bind_retries = 1
+
+    def __init__(self, handler_cls: type, service, ip: str, port: int):
+        self.ip = ip
+        self.service = service
+        handler = type("BoundHandler", (handler_cls,), {"service": service})
+        last_err: OSError | None = None
+        for attempt in range(self.bind_retries):
+            try:
+                self._httpd = ThreadingHTTPServer((ip, port), handler)
+                break
+            except OSError as e:
+                last_err = e
+                self._on_bind_failure(attempt, ip, port)
+                time.sleep(1.0)
+        else:
+            raise last_err
+        maybe_enable_ssl(self._httpd)
+        self._thread: threading.Thread | None = None
+
+    # -- hooks ---------------------------------------------------------------
+    def _on_bind_failure(self, attempt: int, ip: str, port: int) -> None:
+        """Called between bind retries (when bind_retries > 1)."""
+
+    def _on_close(self) -> None:
+        """Called after the socket closes during stop()."""
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        """Serve on a background thread (returns immediately)."""
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name=self.thread_name, daemon=True
+        )
+        self._thread.start()
+        logger.info("%s listening on %s:%s", self.log_label, self.ip, self.port)
+
+    def serve_forever(self) -> None:
+        logger.info("%s listening on %s:%s", self.log_label, self.ip, self.port)
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._on_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -148,8 +148,9 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
             models.append(model)
 
         persisted = [
-            algo.make_persistent_model(ctx, model) if params.save_model else None
-            for algo, model in zip(algorithms, models)
+            algo.make_persistent_model(ctx.with_workflow_params(algorithm_slot=i), model)
+            if params.save_model else None
+            for i, (algo, model) in enumerate(zip(algorithms, models))
         ]
         return TrainResult(models=models, persisted=persisted)
 

@@ -1,0 +1,104 @@
+"""Custom model-persistence contract + local-filesystem helper.
+
+Parity: core/src/main/scala/.../controller/{PersistentModel.scala:68-115,
+LocalFileSystemPersistentModel.scala:43-77}. A model implementing
+``PersistentModel`` owns its persistence: ``save`` stores the real
+artifact and the workflow records only a ``PersistentModelManifest``;
+at deploy the companion ``load`` restores it. Algorithms get this
+behavior automatically via ``PersistentModelAlgorithmMixin``.
+
+TPU note: this is the escape hatch for models that should NOT go through
+the pickle blob path — e.g. large sharded factor tables checkpointed
+per-shard (the templates' ALSModel.save directory checkpoints follow the
+same pattern).
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import os
+import pickle
+from typing import Any, TYPE_CHECKING
+
+from predictionio_tpu.controller.base import PersistentModelManifest
+
+if TYPE_CHECKING:
+    from predictionio_tpu.workflow.context import EngineContext
+
+logger = logging.getLogger(__name__)
+
+
+def model_base_dir() -> str:
+    """Where local model artifacts live: $PIO_MODEL_DIR or
+    $PIO_FS_BASEDIR/models or ~/.pio_store/models."""
+    if os.environ.get("PIO_MODEL_DIR"):
+        return os.environ["PIO_MODEL_DIR"]
+    base = os.environ.get(
+        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
+    )
+    return os.path.join(base, "models")
+
+
+class PersistentModel(abc.ABC):
+    """Parity: PersistentModel trait (PersistentModel.scala:68-96).
+    ``save`` returns True when it stored the model itself (the workflow
+    then persists only a manifest); False falls back to the automatic
+    pickle path."""
+
+    @abc.abstractmethod
+    def save(self, instance_id: str, params: Any) -> bool: ...
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, instance_id: str, params: Any) -> "PersistentModel":
+        """Parity: PersistentModelLoader.apply (PersistentModel.scala:98-115)."""
+
+
+class LocalFileSystemPersistentModel(PersistentModel):
+    """Pickles the model to ``<model_base_dir>/<instance_id>``.
+    Parity: LocalFileSystemPersistentModel(+Loader)
+    (LocalFileSystemPersistentModel.scala:43-77)."""
+
+    def save(self, instance_id: str, params: Any) -> bool:
+        path = os.path.join(model_base_dir(), instance_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(self, f, protocol=pickle.HIGHEST_PROTOCOL)
+        logger.info("saved persistent model to %s", path)
+        return True
+
+    @classmethod
+    def load(cls, instance_id: str, params: Any) -> "LocalFileSystemPersistentModel":
+        path = os.path.join(model_base_dir(), instance_id)
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+class PersistentModelAlgorithmMixin:
+    """Mixin for Algorithms whose models implement PersistentModel: wires
+    make_persistent_model/load_model to the model's own save/load
+    (the reference did this via makePersistentModel reflection,
+    BaseAlgorithm.scala:111-126 + WorkflowUtils.getPersistentModel)."""
+
+    def make_persistent_model(self, ctx: "EngineContext", model: Any) -> Any:
+        if isinstance(model, PersistentModel):
+            import uuid
+
+            run_id = ctx.workflow_params.engine_instance_id or uuid.uuid4().hex
+            # slot suffix: multi-algorithm engines must not share locations
+            location = f"{run_id}_a{ctx.workflow_params.algorithm_slot}"
+            if model.save(location, getattr(self, "params", None)):
+                return PersistentModelManifest(
+                    class_name=(
+                        f"{type(model).__module__}.{type(model).__qualname__}"
+                    ),
+                    location=location,
+                )
+        return model
+
+    def load_model(self, ctx: "EngineContext", manifest: PersistentModelManifest) -> Any:
+        from predictionio_tpu.utils.reflection import resolve_attr
+
+        model_cls = resolve_attr(manifest.class_name)
+        return model_cls.load(manifest.location, getattr(self, "params", None))

@@ -85,13 +85,16 @@ class SelfCleaningDataSource:
     def _remove_duplicates(events: Sequence[Event]) -> list[Event]:
         """Drop events identical up to identity fields, keeping the first
         (removePDuplicates :128-141)."""
+        import json
+
         seen = set()
         out = []
         for e in events:
             key = (
                 e.event, e.entity_type, e.entity_id,
                 e.target_entity_type, e.target_entity_id,
-                tuple(sorted(e.properties.fields.items(), key=lambda kv: kv[0])),
+                # canonical JSON: property values may be lists/dicts
+                json.dumps(e.properties.fields, sort_keys=True, default=str),
                 e.event_time,
             )
             if key in seen:

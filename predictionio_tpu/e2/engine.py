@@ -83,11 +83,13 @@ class CategoricalNaiveBayesModel:
 
     def predict(self, features: Sequence[str]) -> str:
         """Argmax label (CategoricalNaiveBayes.scala:141-149); unseen
-        values contribute -inf like the reference's default."""
+        values contribute -inf like the reference's default. When every
+        label ties at -inf, the first label wins (argmax-of-ties), so a
+        label string is always returned."""
         best_label, best = None, -math.inf
         for label, label_ix in self.labels.to_dict().items():
             s = self._score(label_ix, tuple(features), lambda ls: -math.inf)
-            if s > best:
+            if best_label is None or s > best:
                 best_label, best = label, s
         return best_label
 

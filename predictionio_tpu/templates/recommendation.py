@@ -323,11 +323,14 @@ class ALSAlgorithm(ShardedAlgorithm):
         a directory checkpoint + manifest (SURVEY.md §7 hard-parts)."""
         import os
         import tempfile
+        import uuid
 
         base = os.environ.get(
             "PIO_MODEL_DIR", os.path.join(tempfile.gettempdir(), "pio_models")
         )
-        location = os.path.join(base, f"als_{id(model):x}")
+        run_id = ctx.workflow_params.engine_instance_id or uuid.uuid4().hex
+        slot = ctx.workflow_params.algorithm_slot
+        location = os.path.join(base, f"als_{run_id}_a{slot}")
         model.save(location)
         return PersistentModelManifest(
             class_name=f"{type(self).__module__}.{type(self).__name__}",

@@ -15,10 +15,10 @@ from __future__ import annotations
 import json
 import logging
 import re
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any
 
+from predictionio_tpu.api.http_base import RestServer
 from predictionio_tpu.storage.base import AccessKey, App
 from predictionio_tpu.storage.registry import Storage
 
@@ -150,35 +150,12 @@ class _Handler(BaseHTTPRequestHandler):
         logger.debug("%s - %s", self.address_string(), format % args)
 
 
-class AdminServer:
+class AdminServer(RestServer):
     """Parity: AdminServer.createAdminServer (AdminAPI.scala:137-154)."""
+
+    log_label = "Admin API"
+    thread_name = "pio-adminserver"
 
     def __init__(self, storage: Storage | None = None, ip: str = "0.0.0.0",
                  port: int = 7071):
-        self.ip = ip
-        self.service = AdminService(storage)
-        handler = type("BoundHandler", (_Handler,), {"service": self.service})
-        self._httpd = ThreadingHTTPServer((ip, port), handler)
-        self._thread: threading.Thread | None = None
-
-    @property
-    def port(self) -> int:
-        return self._httpd.server_address[1]
-
-    def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="pio-adminserver", daemon=True
-        )
-        self._thread.start()
-        logger.info("Admin API listening on %s:%s", self.ip, self.port)
-
-    def serve_forever(self) -> None:
-        logger.info("Admin API listening on %s:%s", self.ip, self.port)
-        self._httpd.serve_forever()
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
-            self._thread = None
+        super().__init__(_Handler, AdminService(storage), ip, port)

@@ -20,9 +20,9 @@ import html
 import json
 import logging
 import re
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 
+from predictionio_tpu.api.http_base import RestServer
 from predictionio_tpu.storage.registry import Storage
 
 logger = logging.getLogger(__name__)
@@ -98,35 +98,12 @@ class _Handler(BaseHTTPRequestHandler):
         logger.debug("%s - %s", self.address_string(), format % args)
 
 
-class Dashboard:
+class Dashboard(RestServer):
     """Parity: Dashboard.createDashboard (Dashboard.scala:60-91)."""
+
+    log_label = "Dashboard"
+    thread_name = "pio-dashboard"
 
     def __init__(self, storage: Storage | None = None, ip: str = "0.0.0.0",
                  port: int = 9000):
-        self.ip = ip
-        self.service = DashboardService(storage)
-        handler = type("BoundHandler", (_Handler,), {"service": self.service})
-        self._httpd = ThreadingHTTPServer((ip, port), handler)
-        self._thread: threading.Thread | None = None
-
-    @property
-    def port(self) -> int:
-        return self._httpd.server_address[1]
-
-    def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="pio-dashboard", daemon=True
-        )
-        self._thread.start()
-        logger.info("Dashboard listening on %s:%s", self.ip, self.port)
-
-    def serve_forever(self) -> None:
-        logger.info("Dashboard listening on %s:%s", self.ip, self.port)
-        self._httpd.serve_forever()
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
-            self._thread = None
+        super().__init__(_Handler, DashboardService(storage), ip, port)

@@ -26,6 +26,38 @@ def _load_variant(path: str) -> dict:
         return json.load(f)
 
 
+def _check_template_min_version(template_json: str = "template.json") -> bool:
+    """template.json {"pio": {"version": {"min": "X.Y.Z"}}} gate on
+    train/deploy. Parity: Template.verifyTemplateMinVersion
+    (tools/.../commands/Template.scala:31-69). Returns False (with an
+    error printed) when this framework is older than the template needs."""
+    if not os.path.exists(template_json):
+        return True
+    try:
+        with open(template_json) as f:
+            spec = json.load(f)
+        min_version = spec.get("pio", {}).get("version", {}).get("min")
+    except (json.JSONDecodeError, AttributeError):
+        print(f"[WARN] {template_json} is malformed; skipping version check.")
+        return True
+    if not min_version:
+        return True
+    from predictionio_tpu import __version__
+
+    def vtuple(v):
+        return tuple(int(p) for p in str(v).split(".") if p.isdigit())
+
+    if not vtuple(min_version):
+        print(f"[WARN] {template_json} min version {min_version!r} is not "
+              "a version string; skipping version check.")
+        return True
+    if vtuple(__version__) < vtuple(min_version):
+        print(f"[ERROR] This template requires predictionio_tpu >= {min_version} "
+              f"(current: {__version__}).")
+        return False
+    return True
+
+
 def _serve(server, label: str, ip: str) -> int:
     """Print the bound address and block until interrupt — shared by every
     server-launching subcommand."""
@@ -57,6 +89,8 @@ def _configure_train(sub) -> None:
 def _cmd_train(args, storage) -> int:
     from predictionio_tpu.workflow.train import run_train
 
+    if not _check_template_min_version():
+        return 1
     variant = _load_variant(args.engine_json)
     if not variant and not args.engine_factory:
         print(f"[ERROR] {args.engine_json} not found and no --engine-factory given.")
@@ -155,6 +189,8 @@ def _cmd_deploy(args, storage) -> int:
     from predictionio_tpu.api.engine_server import create_engine_server
     from predictionio_tpu.workflow.deploy import ServerConfig
 
+    if not _check_template_min_version():
+        return 1
     variant = _load_variant(args.engine_json)
     config = ServerConfig(
         ip=args.ip,

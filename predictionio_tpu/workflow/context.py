@@ -40,6 +40,14 @@ class WorkflowParams:
     stop_after_read: bool = False
     stop_after_prepare: bool = False
     mesh_conf: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    #: set by run_train before the pipeline runs, so persistence hooks can
+    #: key custom checkpoints by training run (the reference passed
+    #: engineInstanceId into makeSerializableModels/PersistentModel.save)
+    engine_instance_id: str = ""
+    #: which algorithm-list slot is being persisted — set by Engine.train
+    #: around make_persistent_model so multi-algorithm engines don't
+    #: collide on checkpoint locations
+    algorithm_slot: int = 0
 
 
 def _factor_mesh(n: int) -> tuple[int, int]:

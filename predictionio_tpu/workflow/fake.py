@@ -1,0 +1,75 @@
+"""FakeWorkflow — run arbitrary code through the evaluation plumbing.
+
+Parity: core/src/main/scala/.../workflow/FakeWorkflow.scala:30-109
+(`pio eval HelloWorld` style): wrap a ``ctx -> None`` function in a fake
+engine/evaluator pair so it executes with the full workflow context
+(storage wired, mesh available, EvaluationInstance recorded) without
+defining a real DASE engine.
+
+Usage::
+
+    class MyRun(FakeRun):
+        def __init__(self):
+            super().__init__(lambda ctx: print(ctx.mesh))
+
+    # pio eval my_module.MyRun my_module.FakeEngineParamsGenerator
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, TYPE_CHECKING
+
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.controller.evaluation import (
+    BaseEvaluator,
+    BaseEvaluatorResult,
+    EngineParamsGenerator,
+    Evaluation,
+)
+from predictionio_tpu.controller.params import EngineParams
+
+if TYPE_CHECKING:
+    from predictionio_tpu.workflow.context import EngineContext
+
+
+class FakeEvalResult(BaseEvaluatorResult):
+    """Parity: FakeEvalResult (FakeWorkflow.scala:71-77) — noSave, so the
+    workflow records nothing beyond the run itself."""
+
+    no_save = True
+
+    def to_one_liner(self) -> str:
+        return "FakeRun completed"
+
+
+class _FakeEngine(Engine):
+    """Skips the DASE pipeline entirely; batch_eval invokes the function
+    (FakeWorkflow.scala FakeEngine:33-55 + FakeRunner:57-69)."""
+
+    def __init__(self, fn: Callable[["EngineContext"], None]):
+        super().__init__({}, {}, {}, {})
+        self._fn = fn
+
+    def batch_eval(self, ctx, engine_params_list: Sequence[EngineParams]):
+        self._fn(ctx)
+        return [(ep, []) for ep in engine_params_list]
+
+
+class _FakeEvaluator(BaseEvaluator):
+    def evaluate(self, ctx, evaluation, engine_eval_data_set):
+        return FakeEvalResult()
+
+
+class FakeRun(Evaluation):
+    """Parity: FakeRun (FakeWorkflow.scala:96-109)."""
+
+    def __init__(self, fn: Callable[["EngineContext"], None]):
+        super().__init__()
+        self.engine_evaluator = (_FakeEngine(fn), _FakeEvaluator())
+
+
+class FakeEngineParamsGenerator(EngineParamsGenerator):
+    """A single empty grid point — all a FakeRun needs."""
+
+    def __init__(self):
+        super().__init__([EngineParams()])

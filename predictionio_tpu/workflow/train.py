@@ -104,6 +104,7 @@ def run_train(
     )
     instance_id = instances.insert(instance)
     logger.info("engine instance %s: INIT", instance_id)
+    ctx = ctx.with_workflow_params(engine_instance_id=instance_id)
 
     try:
         try:

@@ -1,0 +1,178 @@
+"""PersistentModel contract, FakeWorkflow, SSL wrap, template min-version
+(reference behaviors: PersistentModel.scala, FakeWorkflow.scala,
+SSLConfiguration.scala, commands/Template.scala)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import shutil
+import ssl
+import subprocess
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.controller.base import PersistentModelManifest
+from predictionio_tpu.controller.persistent_model import (
+    LocalFileSystemPersistentModel,
+    PersistentModelAlgorithmMixin,
+)
+from predictionio_tpu.workflow.deploy import load_deployed_engine
+from predictionio_tpu.workflow.evaluation import run_evaluation
+from predictionio_tpu.workflow.fake import FakeEngineParamsGenerator, FakeRun
+from predictionio_tpu.workflow.train import run_train
+
+
+# ---------------------------------------------------------------------------
+# LocalFileSystemPersistentModel through the full train -> deploy cycle
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FsModel(LocalFileSystemPersistentModel):
+    mult: int = 1
+
+
+from predictionio_tpu.controller import LocalAlgorithm
+
+
+class FsAlgorithm(PersistentModelAlgorithmMixin, LocalAlgorithm):
+    """Algorithm whose model persists itself to the local filesystem."""
+
+    def train(self, ctx, pd):
+        return FsModel(mult=9)
+
+    def predict(self, model, query):
+        return query * model.mult
+
+    def batch_predict(self, model, queries):
+        return [(i, q * model.mult) for i, q in queries]
+
+
+class TestLocalFileSystemPersistentModel:
+    def test_train_then_deploy_roundtrip(self, storage, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_MODEL_DIR", str(tmp_path))
+        from predictionio_tpu.controller import Engine, FirstServing, IdentityPreparator
+        from tests.sample_engine import DSParams, SampleDataSource
+
+        engine = Engine(SampleDataSource, IdentityPreparator,
+                        {"fs": FsAlgorithm}, FirstServing)
+        params = EngineParams.of(
+            data_source=DSParams(id=1, n_train=3),
+            algorithms=[("fs", None)],
+        )
+        outcome = run_train(engine=engine, engine_params=params,
+                            variant={"id": "fs-engine"}, storage=storage)
+        assert outcome.status == "COMPLETED"
+        # the blob stores only a manifest; the artifact file is keyed by
+        # the engine instance id + algorithm slot
+        assert (tmp_path / f"{outcome.instance_id}_a0").exists()
+        from predictionio_tpu.workflow.persistence import load_models
+
+        persisted = load_models(storage, outcome.instance_id)
+        assert isinstance(persisted[0], PersistentModelManifest)
+
+        deployed = load_deployed_engine(storage=storage, engine=engine)
+        assert isinstance(deployed.models[0], FsModel)
+        assert deployed.query(3) == 27
+
+
+# ---------------------------------------------------------------------------
+# FakeWorkflow
+# ---------------------------------------------------------------------------
+
+class TestFakeWorkflow:
+    def test_fake_run_executes_fn_with_context(self, storage):
+        calls = []
+
+        run = FakeRun(lambda ctx: calls.append(ctx.workflow_params.batch))
+        outcome = run_evaluation(
+            run, FakeEngineParamsGenerator(), storage=storage,
+        )
+        assert calls == [""]
+        # noSave: the instance stays INIT (reference behavior) and the
+        # outcome reports NOSAVE
+        assert outcome.status == "NOSAVE"
+        inst = storage.get_meta_data_evaluation_instances().get(outcome.instance_id)
+        assert inst.status == "INIT"
+
+
+# ---------------------------------------------------------------------------
+# SSL (requires the openssl CLI for a self-signed cert)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(shutil.which("openssl") is None, reason="no openssl")
+class TestSSL:
+    def test_event_server_over_tls(self, storage, tmp_path, monkeypatch):
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost"],
+            check=True, capture_output=True,
+        )
+        monkeypatch.setenv("PIO_SSL_CERT_PATH", str(cert))
+        monkeypatch.setenv("PIO_SSL_KEY_PATH", str(key))
+
+        from predictionio_tpu.api.event_server import EventServer, EventServerConfig
+
+        server = EventServer(storage, EventServerConfig(ip="127.0.0.1", port=0))
+        server.start()
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{server.port}/", context=ctx, timeout=5
+            ) as r:
+                assert r.status == 200
+                assert json.loads(r.read())["status"] == "alive"
+            # plain http against the TLS port fails
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/", timeout=2
+                )
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# template.json min-version gate
+# ---------------------------------------------------------------------------
+
+class TestTemplateMinVersion:
+    def test_too_new_requirement_blocks_train(self, tmp_path, monkeypatch, capsys):
+        from predictionio_tpu.cli.pio import main
+        from predictionio_tpu.storage.registry import Storage
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        monkeypatch.chdir(tmp_path)
+        Storage.reset_default()
+        try:
+            (tmp_path / "template.json").write_text(
+                json.dumps({"pio": {"version": {"min": "999.0.0"}}})
+            )
+            (tmp_path / "engine.json").write_text(json.dumps(
+                {"engineFactory": "tests.sample_engine.engine_factory"}
+            ))
+            assert main(["train"]) == 1
+            assert "requires predictionio_tpu >= 999.0.0" in capsys.readouterr().out
+        finally:
+            Storage.reset_default()
+
+    def test_satisfied_requirement_passes(self, tmp_path, monkeypatch):
+        from predictionio_tpu.workflow.cli_commands import _check_template_min_version
+
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "template.json").write_text(
+            json.dumps({"pio": {"version": {"min": "0.0.1"}}})
+        )
+        assert _check_template_min_version()
+
+    def test_absent_file_passes(self, tmp_path, monkeypatch):
+        from predictionio_tpu.workflow.cli_commands import _check_template_min_version
+
+        monkeypatch.chdir(tmp_path)
+        assert _check_template_min_version()
