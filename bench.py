@@ -51,10 +51,9 @@ def numpy_half_solve(V, bucketed, rank, lam):
         n_u = b.mask.sum(axis=1)
         A = A + (lam * n_u)[:, None, None] * eye
         rhs = np.einsum("bl,blk->bk", b.vals * b.mask, F)
-        deg = b.mask.sum(axis=1)
-        A[deg == 0] = eye
+        A[n_u == 0] = eye
         x = np.linalg.solve(A, rhs[..., None])[..., 0]
-        x[deg == 0] = 0.0
+        x[n_u == 0] = 0.0
         out[b.row_ids] = x
     return out
 

@@ -300,8 +300,11 @@ class EngineService:
         def post() -> None:
             import urllib.request
 
+            from predictionio_tpu.utils.ssl_config import client_transport
+
+            scheme, ssl_ctx = client_transport()
             url = (
-                f"http://{self.config.event_server_ip}:{self.config.event_server_port}"
+                f"{scheme}://{self.config.event_server_ip}:{self.config.event_server_port}"
                 f"/events.json?accessKey={self.config.access_key}"
             )
             event = {
@@ -317,7 +320,7 @@ class EngineService:
                     headers={"Content-Type": "application/json"},
                     method="POST",
                 )
-                with urllib.request.urlopen(req, timeout=10):
+                with urllib.request.urlopen(req, timeout=10, context=ssl_ctx):
                     pass
             except Exception as e:
                 logger.warning("feedback event POST failed: %s", e)
@@ -373,13 +376,16 @@ def undeploy(ip: str, port: int, server_key: str | None = None) -> bool:
     import urllib.error
     import urllib.request
 
+    from predictionio_tpu.utils.ssl_config import client_transport
+
+    scheme, ssl_ctx = client_transport()
     host = "127.0.0.1" if ip == "0.0.0.0" else ip
-    url = f"http://{host}:{port}/stop"
+    url = f"{scheme}://{host}:{port}/stop"
     if server_key:
         url += f"?accessKey={server_key}"
     try:
         req = urllib.request.Request(url, data=b"", method="POST")
-        with urllib.request.urlopen(req, timeout=5):
+        with urllib.request.urlopen(req, timeout=5, context=ssl_ctx):
             return True
     except (urllib.error.URLError, OSError):
         return False

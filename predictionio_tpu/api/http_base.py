@@ -31,17 +31,15 @@ class RestServer:
         self.ip = ip
         self.service = service
         handler = type("BoundHandler", (handler_cls,), {"service": service})
-        last_err: OSError | None = None
         for attempt in range(self.bind_retries):
             try:
                 self._httpd = ThreadingHTTPServer((ip, port), handler)
                 break
-            except OSError as e:
-                last_err = e
+            except OSError:
+                if attempt == self.bind_retries - 1:
+                    raise
                 self._on_bind_failure(attempt, ip, port)
                 time.sleep(1.0)
-        else:
-            raise last_err
         maybe_enable_ssl(self._httpd)
         self._thread: threading.Thread | None = None
 

@@ -254,6 +254,10 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+#: commands that run the JAX pipeline and therefore take part in the
+#: multi-host jax.distributed barrier
+COMPUTE_COMMANDS = frozenset({"train", "eval", "deploy"})
+
 _COMMANDS = {
     "version": _cmd_version,
     "status": _cmd_status,
@@ -285,6 +289,14 @@ def main(argv: list[str] | None = None) -> int:
     if not args.command:
         parser.print_help()
         return 1
+    if args.command in COMPUTE_COMMANDS:
+        # multi-host: wire jax.distributed over DCN when PIO_NUM_HOSTS > 1
+        # (the spark-submit --master surface of the reference). Only
+        # compute commands join the coordinator barrier — admin commands
+        # must not block on the other hosts.
+        from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
+
+        maybe_initialize_distributed()
     storage = Storage.default()
     return _COMMANDS[args.command](args, storage)
 

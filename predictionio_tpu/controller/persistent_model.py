@@ -40,6 +40,20 @@ def model_base_dir() -> str:
     return os.path.join(base, "models")
 
 
+def checkpoint_location(ctx: "EngineContext", prefix: str) -> str:
+    """The canonical directory for a template's model checkpoint:
+    ``<model_base_dir>/<prefix>_<run>_a<slot>`` — keyed by training run
+    and algorithm slot so multi-algorithm engines and successive runs
+    never collide."""
+    import uuid
+
+    run_id = ctx.workflow_params.engine_instance_id or uuid.uuid4().hex
+    return os.path.join(
+        model_base_dir(),
+        f"{prefix}_{run_id}_a{ctx.workflow_params.algorithm_slot}",
+    )
+
+
 class PersistentModel(abc.ABC):
     """Parity: PersistentModel trait (PersistentModel.scala:68-96).
     ``save`` returns True when it stored the model itself (the workflow

@@ -1,0 +1,85 @@
+"""Legacy batch-view helpers (deprecated in the reference, kept for parity).
+
+Parity: data/src/main/scala/.../data/view/{LBatchView.scala,
+PBatchView.scala, DataView.scala} — predicate-combinator queries over an
+event batch: filter chains, property aggregation to a point in time, and
+fold/group reductions. The reference deprecated these in favor of
+PEventStore; this module exists so users migrating view-based engines
+have a drop-in, but new code should use EventStore + the Preparator.
+"""
+
+from __future__ import annotations
+
+import warnings
+from datetime import datetime
+from typing import Any, Callable, Iterable, TypeVar
+
+from predictionio_tpu.core.aggregation import aggregate_properties
+from predictionio_tpu.core.datamap import PropertyMap
+from predictionio_tpu.core.event import Event
+
+T = TypeVar("T")
+
+
+class BatchView:
+    """An in-memory event batch with combinator queries.
+
+    Parity: LBatchView.LEventStore/ViewPredicates (LBatchView.scala:33+).
+    """
+
+    def __init__(self, events: Iterable[Event], _warned: bool = False):
+        if not _warned:
+            warnings.warn(
+                "BatchView is a legacy API (deprecated in the reference); "
+                "use EventStore.find/aggregate_properties",
+                DeprecationWarning,
+                stacklevel=2,
+            )
+        self._events = list(events)
+
+    # -- predicates (ViewPredicates parity) ---------------------------------
+    def filter(self, predicate: Callable[[Event], bool]) -> "BatchView":
+        return BatchView((e for e in self._events if predicate(e)), _warned=True)
+
+    def event_name(self, name: str) -> "BatchView":
+        return self.filter(lambda e: e.event == name)
+
+    def entity_type(self, entity_type: str) -> "BatchView":
+        return self.filter(lambda e: e.entity_type == entity_type)
+
+    def before(self, t: datetime) -> "BatchView":
+        return self.filter(lambda e: e.event_time < t)
+
+    def after(self, t: datetime) -> "BatchView":
+        return self.filter(lambda e: e.event_time >= t)
+
+    # -- terminal operations ------------------------------------------------
+    def events(self) -> list[Event]:
+        return list(self._events)
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def aggregate_properties(
+        self, entity_type: str, until_time: datetime | None = None
+    ) -> dict[str, PropertyMap]:
+        """$set/$unset/$delete fold per entity, optionally up to a point in
+        time (LBatchView.aggregateProperties parity)."""
+        selected = (
+            e for e in self._events
+            if e.entity_type == entity_type
+            and (until_time is None or e.event_time < until_time)
+        )
+        return aggregate_properties(selected)
+
+    def group_by_entity(self) -> dict[tuple[str, str], list[Event]]:
+        out: dict[tuple[str, str], list[Event]] = {}
+        for e in self._events:
+            out.setdefault((e.entity_type, e.entity_id), []).append(e)
+        return out
+
+    def fold(self, init: T, op: Callable[[T, Event], T]) -> T:
+        acc = init
+        for e in self._events:
+            acc = op(acc, e)
+        return acc

@@ -1,0 +1,61 @@
+"""Multi-host runtime initialization (DCN).
+
+The reference scaled across machines through Spark's driver/executor
+model (spark-submit --master, Runner.scala:185-307); the TPU-native
+equivalent is `jax.distributed`: every host runs the same program,
+`jax.distributed.initialize` wires them over DCN, and the global mesh
+spans all hosts' devices — ICI inside a slice, DCN between slices
+(SURVEY.md §2.6 TPU-equivalent note).
+
+Env contract (the spark-submit argument surface collapsed to env vars):
+
+- ``PIO_NUM_HOSTS``            total processes (absent/1 = single host)
+- ``PIO_HOST_INDEX``           this process's index [0, n)
+- ``PIO_COORDINATOR_ADDRESS``  host:port of process 0
+
+The CLI calls :func:`maybe_initialize_distributed` once at startup; it is
+a no-op unless PIO_NUM_HOSTS > 1, so single-host users never notice it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def maybe_initialize_distributed() -> bool:
+    """Initialize jax.distributed from PIO_* env vars when configured.
+    Returns whether multi-host mode is active. Idempotent."""
+    global _initialized
+    num_hosts = int(os.environ.get("PIO_NUM_HOSTS", "1"))
+    if num_hosts <= 1:
+        return False
+    if _initialized:
+        return True
+
+    coordinator = os.environ.get("PIO_COORDINATOR_ADDRESS")
+    host_index = os.environ.get("PIO_HOST_INDEX")
+    if coordinator is None or host_index is None:
+        raise RuntimeError(
+            "PIO_NUM_HOSTS > 1 requires PIO_COORDINATOR_ADDRESS (host:port "
+            "of host 0) and PIO_HOST_INDEX (this host's index)"
+        )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_hosts,
+        process_id=int(host_index),
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed initialized: host %s of %s (coordinator %s); "
+        "%d local / %d global devices",
+        host_index, num_hosts, coordinator,
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True

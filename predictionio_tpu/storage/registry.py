@@ -70,6 +70,7 @@ def _builtin_backends() -> None:
     if _builtins_loaded:
         return
     _builtins_loaded = True
+    from predictionio_tpu.storage.fileevents import FileEventsStorageClient
     from predictionio_tpu.storage.localfs import LocalFSStorageClient
     from predictionio_tpu.storage.memory import MemoryStorageClient
     from predictionio_tpu.storage.sqlite import SQLiteStorageClient
@@ -80,6 +81,10 @@ def _builtin_backends() -> None:
     # whose sources say TYPE=jdbc keep working.
     _BACKENDS.setdefault("jdbc", SQLiteStorageClient)
     _BACKENDS.setdefault("localfs", LocalFSStorageClient)
+    # append-only JSONL event store — the reference's hbase role
+    # (event-data only); "hbase" aliases to it for pio-env.sh compatibility
+    _BACKENDS.setdefault("fileevents", FileEventsStorageClient)
+    _BACKENDS.setdefault("hbase", FileEventsStorageClient)
 
 
 class Storage:

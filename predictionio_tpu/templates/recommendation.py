@@ -321,16 +321,9 @@ class ALSAlgorithm(ShardedAlgorithm):
         """Unlike the reference's PAlgorithm (forced retrain-on-deploy for
         RDD models, PAlgorithm.scala:89-101), sharded factors persist via
         a directory checkpoint + manifest (SURVEY.md §7 hard-parts)."""
-        import os
-        import tempfile
-        import uuid
+        from predictionio_tpu.controller.persistent_model import checkpoint_location
 
-        base = os.environ.get(
-            "PIO_MODEL_DIR", os.path.join(tempfile.gettempdir(), "pio_models")
-        )
-        run_id = ctx.workflow_params.engine_instance_id or uuid.uuid4().hex
-        slot = ctx.workflow_params.algorithm_slot
-        location = os.path.join(base, f"als_{run_id}_a{slot}")
+        location = checkpoint_location(ctx, "als")
         model.save(location)
         return PersistentModelManifest(
             class_name=f"{type(self).__module__}.{type(self).__name__}",

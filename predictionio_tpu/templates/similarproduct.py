@@ -235,15 +235,10 @@ class SimilarALSAlgorithm(ShardedAlgorithm):
     def make_persistent_model(self, ctx, model: SimilarModel):
         import json
         import os
-        import tempfile
-        import uuid
 
-        base = os.environ.get(
-            "PIO_MODEL_DIR", os.path.join(tempfile.gettempdir(), "pio_models")
-        )
-        run_id = ctx.workflow_params.engine_instance_id or uuid.uuid4().hex
-        slot = ctx.workflow_params.algorithm_slot
-        location = os.path.join(base, f"simals_{run_id}_a{slot}")
+        from predictionio_tpu.controller.persistent_model import checkpoint_location
+
+        location = checkpoint_location(ctx, "simals")
         model.als.save(location)
         with open(os.path.join(location, "categories.json"), "w") as f:
             json.dump({k: list(v) for k, v in model.categories.items()}, f)

@@ -38,3 +38,25 @@ def maybe_enable_ssl(httpd, cert_file: str | None = None, key_file: str | None =
         wrap_server_socket(httpd, cert, key)
         return True
     return False
+
+
+def client_transport() -> tuple[str, "ssl.SSLContext | None"]:
+    """(scheme, ssl_context) the framework's OWN control-plane clients
+    (undeploy /stop, the feedback loop) must use to reach its servers.
+
+    When the env cert is configured every server speaks TLS, so clients
+    return ("https", ctx) with the configured cert trusted as the CA —
+    hostname checking is off because the control plane dials loopback/IPs
+    with a typically self-signed cert; the cert pin is the trust anchor.
+    """
+    cert, key = ssl_paths_from_env()
+    if not (cert and key):
+        return ("http", None)
+    context = ssl.create_default_context()
+    context.check_hostname = False
+    try:
+        context.load_verify_locations(cert)
+        context.verify_mode = ssl.CERT_REQUIRED
+    except ssl.SSLError:
+        context.verify_mode = ssl.CERT_NONE
+    return ("https", context)

@@ -309,9 +309,10 @@ class TestFeedbackLoop:
             )
             assert r2["prId"] == "client-pr-1"
             # feedback is async fire-and-forget; poll the event store
+            # (generous deadline: the suite may be CPU-saturated)
             from predictionio_tpu.storage.base import EventFilter
 
-            deadline = time.time() + 5
+            deadline = time.time() + 20
             found = []
             while time.time() < deadline and not found:
                 found = list(storage.get_events().find(

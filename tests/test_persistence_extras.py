@@ -137,6 +137,43 @@ class TestSSL:
         finally:
             server.stop()
 
+    def test_undeploy_reaches_tls_engine_server(self, storage, tmp_path, monkeypatch):
+        """The framework's own control-plane clients must speak TLS when
+        the servers do (undeploy posts /stop)."""
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost"],
+            check=True, capture_output=True,
+        )
+        monkeypatch.setenv("PIO_SSL_CERT_PATH", str(cert))
+        monkeypatch.setenv("PIO_SSL_KEY_PATH", str(key))
+
+        from predictionio_tpu.api.engine_server import create_engine_server, undeploy
+        from predictionio_tpu.workflow.deploy import ServerConfig
+        from predictionio_tpu.controller import EngineParams
+        from tests.sample_engine import AlgoParams, DSParams
+
+        run_train(
+            engine_factory="tests.sample_engine.engine_factory",
+            engine_params=EngineParams.of(
+                data_source=DSParams(id=1, n_train=3),
+                algorithms=[("sample", AlgoParams(id=0, mult=2))],
+            ),
+            variant={"id": "tls-engine"},
+            storage=storage,
+        )
+        server = create_engine_server(
+            storage=storage, config=ServerConfig(ip="127.0.0.1", port=0)
+        )
+        server.start()
+        try:
+            assert undeploy("127.0.0.1", server.port)
+        finally:
+            server.stop()
+
 
 # ---------------------------------------------------------------------------
 # template.json min-version gate

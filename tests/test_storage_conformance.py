@@ -42,6 +42,31 @@ def client(request, tmp_path):
     c.close()
 
 
+@pytest.fixture(params=["memory", "sqlite", "sqlite_file", "fileevents"])
+def events_client(request, tmp_path):
+    """Event-store conformance adds the events-only fileevents backend
+    (the reference ran the same LEventsSpec against hbase)."""
+    if request.param == "fileevents":
+        from predictionio_tpu.storage.fileevents import FileEventsStorageClient
+
+        c = FileEventsStorageClient(
+            StorageClientConfig(properties={"PATH": str(tmp_path / "fe")})
+        )
+        yield c
+        c.events().close()
+        return
+    if request.param == "memory":
+        c = MemoryStorageClient()
+    elif request.param == "sqlite":
+        c = SQLiteStorageClient(StorageClientConfig(test=True))
+    else:
+        c = SQLiteStorageClient(
+            StorageClientConfig(properties={"PATH": str(tmp_path / "pio.sqlite")})
+        )
+    yield c
+    c.close()
+
+
 def ev(name="rate", entity="u1", minutes=0, target=None, props=None):
     return Event(
         event=name,
@@ -59,8 +84,8 @@ def ev(name="rate", entity="u1", minutes=0, target=None, props=None):
 # ---------------------------------------------------------------------------
 
 class TestEvents:
-    def test_insert_get_delete_roundtrip(self, client):
-        events = client.events()
+    def test_insert_get_delete_roundtrip(self, events_client):
+        events = events_client.events()
         events.init(1)
         e = ev(props={"rating": 4.5, "note": "good"}, target="i1")
         eid = events.insert(e, 1)
@@ -73,8 +98,8 @@ class TestEvents:
         assert events.delete(eid, 1) is False
         assert events.get(eid, 1) is None
 
-    def test_channel_isolation(self, client):
-        events = client.events()
+    def test_channel_isolation(self, events_client):
+        events = events_client.events()
         events.init(1)
         events.init(1, 5)
         eid = events.insert(ev(), 1, 5)
@@ -83,15 +108,15 @@ class TestEvents:
         assert list(events.find(1)) == []
         assert len(list(events.find(1, 5))) == 1
 
-    def test_app_isolation(self, client):
-        events = client.events()
+    def test_app_isolation(self, events_client):
+        events = events_client.events()
         events.init(1)
         events.init(2)
         events.insert(ev(), 1)
         assert list(events.find(2)) == []
 
-    def test_find_filters(self, client):
-        events = client.events()
+    def test_find_filters(self, events_client):
+        events = events_client.events()
         events.init(1)
         events.insert_batch(
             [
@@ -119,8 +144,8 @@ class TestEvents:
         assert len(f(entity_type="user")) == 5
         assert len(f(entity_type="other")) == 0
 
-    def test_find_order_limit_reversed(self, client):
-        events = client.events()
+    def test_find_order_limit_reversed(self, events_client):
+        events = events_client.events()
         events.init(1)
         events.insert_batch([ev(minutes=m) for m in (30, 10, 20)], 1)
         times = [e.event_time for e in events.find(1)]
@@ -130,8 +155,8 @@ class TestEvents:
         two = list(events.find(1, None, EventFilter(limit=2)))
         assert len(two) == 2
 
-    def test_aggregate_properties(self, client):
-        events = client.events()
+    def test_aggregate_properties(self, events_client):
+        events = events_client.events()
         events.init(1)
         events.insert_batch(
             [
@@ -165,8 +190,8 @@ class TestEvents:
         # required-fields filter (LEvents.scala:246-252)
         assert events.aggregate_properties(1, "user", required=["missing"]) == {}
 
-    def test_find_single_entity_latest(self, client):
-        events = client.events()
+    def test_find_single_entity_latest(self, events_client):
+        events = events_client.events()
         events.init(1)
         events.insert_batch([ev("view", "u1", m, target=f"i{m}") for m in range(5)], 1)
         got = list(
@@ -174,8 +199,8 @@ class TestEvents:
         )
         assert [e.target_entity_id for e in got] == ["i4", "i3"]
 
-    def test_remove_drops_data(self, client):
-        events = client.events()
+    def test_remove_drops_data(self, events_client):
+        events = events_client.events()
         events.init(1)
         events.insert(ev(), 1)
         events.remove(1)
@@ -368,3 +393,22 @@ def test_engine_instance_mixed_offset_ordering(client):
         )
     )
     assert insts.get_latest_completed("eng", "1", "v1").id == a
+
+
+def test_fileevents_persists_across_reopen(tmp_path):
+    """The append-only log replays after a restart (the durability HBase
+    gave the reference's event store)."""
+    from predictionio_tpu.storage.fileevents import FileEventsStorageClient
+
+    path = str(tmp_path / "fe")
+    c1 = FileEventsStorageClient(StorageClientConfig(properties={"PATH": path}))
+    events = c1.events()
+    events.init(1)
+    kept = events.insert(ev(props={"rating": 2.0}), 1)
+    dropped = events.insert(ev(entity="u2"), 1)
+    events.delete(dropped, 1)
+
+    c2 = FileEventsStorageClient(StorageClientConfig(properties={"PATH": path}))
+    replayed = list(c2.events().find(1, filter=EventFilter()))
+    assert [e.event_id for e in replayed] == [kept]
+    assert replayed[0].properties["rating"] == 2.0

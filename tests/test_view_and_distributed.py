@@ -1,0 +1,134 @@
+"""Legacy BatchView combinators, distributed-init env contract, and the
+basic_app_usecases CLI scenario (reference:
+tests/pio_tests/scenarios/basic_app_usecases.py)."""
+
+from __future__ import annotations
+
+import json
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.data.view import BatchView
+from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def _ev(name, entity, minutes=0, props=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=entity,
+        properties=DataMap(props or {}),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+class TestBatchView:
+    def _view(self):
+        with pytest.warns(DeprecationWarning):
+            return BatchView([
+                _ev("$set", "u1", 0, {"a": 1}),
+                _ev("$set", "u1", 5, {"a": 2, "b": 3}),
+                _ev("buy", "u1", 10),
+                _ev("buy", "u2", 20),
+                _ev("rate", "u2", 30),
+            ])
+
+    def test_filter_chain(self):
+        v = self._view()
+        assert len(v.event_name("buy")) == 2
+        assert len(v.event_name("buy").filter(lambda e: e.entity_id == "u2")) == 1
+        assert len(v.before(T0 + timedelta(minutes=15))) == 3
+        assert len(v.after(T0 + timedelta(minutes=15))) == 2
+
+    def test_aggregate_properties_to_time(self):
+        v = self._view()
+        now_props = v.aggregate_properties("user")
+        assert now_props["u1"]["a"] == 2
+        assert now_props["u1"]["b"] == 3
+        early = v.aggregate_properties("user", until_time=T0 + timedelta(minutes=2))
+        assert early["u1"]["a"] == 1
+        assert "b" not in early["u1"]
+
+    def test_group_and_fold(self):
+        v = self._view()
+        groups = v.group_by_entity()
+        assert len(groups[("user", "u1")]) == 3
+        count = v.fold(0, lambda acc, e: acc + 1)
+        assert count == 5
+
+
+class TestDistributedInit:
+    def test_noop_single_host(self, monkeypatch):
+        monkeypatch.delenv("PIO_NUM_HOSTS", raising=False)
+        assert maybe_initialize_distributed() is False
+
+    def test_missing_coordinator_raises(self, monkeypatch):
+        monkeypatch.setenv("PIO_NUM_HOSTS", "2")
+        monkeypatch.delenv("PIO_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.delenv("PIO_HOST_INDEX", raising=False)
+        with pytest.raises(RuntimeError, match="PIO_COORDINATOR_ADDRESS"):
+            maybe_initialize_distributed()
+
+
+class TestBasicAppUsecases:
+    """App/channel/data-delete CRUD via the CLI — the reference's
+    basic_app_usecases.py integration scenario."""
+
+    @pytest.fixture
+    def cli(self, tmp_path, monkeypatch):
+        from predictionio_tpu.cli.pio import main
+        from predictionio_tpu.storage.registry import Storage
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        monkeypatch.chdir(tmp_path)
+        Storage.reset_default()
+        yield main
+        Storage.reset_default()
+
+    def test_app_channel_lifecycle(self, cli, capsys):
+        from predictionio_tpu.storage.registry import Storage
+
+        assert cli(["app", "new", "UseApp", "--access-key", "ukey"]) == 0
+        # duplicate app
+        assert cli(["app", "new", "UseApp"]) == 1
+        # channels
+        assert cli(["app", "channel-new", "UseApp", "chan1"]) == 0
+        assert cli(["app", "channel-new", "UseApp", "bad name!"]) == 1
+        capsys.readouterr()
+        assert cli(["app", "show", "UseApp"]) == 0
+        out = capsys.readouterr().out
+        assert "chan1" in out and "ukey" in out
+
+        # events into default + channel, then channel-scoped data-delete
+        storage = Storage.default()
+        app = storage.get_meta_data_apps().get_by_name("UseApp")
+        chan = storage.get_meta_data_channels().get_by_app_id(app.id)[0]
+        events = storage.get_events()
+        events.insert(_ev("buy", "u1"), app.id)
+        events.insert(_ev("buy", "u2"), app.id, chan.id)
+        from predictionio_tpu.storage.base import EventFilter
+
+        assert cli(["app", "data-delete", "UseApp", "--channel", "chan1"]) == 0
+        assert list(events.find(app.id, chan.id, EventFilter())) == []
+        assert len(list(events.find(app.id, filter=EventFilter()))) == 1
+
+        assert cli(["app", "channel-delete", "UseApp", "chan1"]) == 0
+        assert cli(["app", "delete", "UseApp"]) == 0
+        capsys.readouterr()
+        assert cli(["app", "list"]) == 0
+        assert "UseApp" not in capsys.readouterr().out
+
+    def test_accesskey_lifecycle(self, cli, capsys):
+        assert cli(["app", "new", "KeyApp"]) == 0
+        assert cli(["accesskey", "new", "KeyApp", "--access-key", "k2",
+                    "--event", "buy", "--event", "rate"]) == 0
+        capsys.readouterr()
+        assert cli(["accesskey", "list", "KeyApp"]) == 0
+        out = capsys.readouterr().out
+        assert "k2" in out and "buy,rate" in out
+        assert cli(["accesskey", "delete", "k2"]) == 0
