@@ -1,0 +1,88 @@
+"""Native runtime components (C++, ctypes-bound).
+
+``load_eventlog()`` returns the compiled event-log library (see
+eventlog.cc) or None when a toolchain isn't available — callers fall
+back to the pure-Python codec in storage/binevents.py, which implements
+the identical byte format.
+
+The library is built on demand with g++ (baked into the image) and
+cached next to the source; a rebuild happens only when the source is
+newer than the .so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "eventlog.cc")
+_SO = os.path.join(_DIR, "_eventlog.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _ensure_built() -> str | None:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def load_eventlog() -> ctypes.CDLL | None:
+    """Compile (if needed) and load the native event log; None on failure."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        so = _ensure_built()
+        if so is None:
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            _load_failed = True
+            return None
+        c_char_pp = ctypes.POINTER(ctypes.c_char_p)
+        u8_pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+        u64_p = ctypes.POINTER(ctypes.c_uint64)
+        lib.pio_open.argtypes = [ctypes.c_char_p]
+        lib.pio_open.restype = ctypes.c_void_p
+        lib.pio_close.argtypes = [ctypes.c_void_p]
+        lib.pio_close.restype = ctypes.c_int
+        lib.pio_flush.argtypes = [ctypes.c_void_p]
+        lib.pio_flush.restype = ctypes.c_int
+        lib.pio_write_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.pio_write_put.restype = ctypes.c_int
+        lib.pio_write_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pio_write_del.restype = ctypes.c_int
+        lib.pio_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, c_char_pp,
+            ctypes.c_int32, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, u8_pp, u64_p,
+        ]
+        lib.pio_scan.restype = ctypes.c_int
+        lib.pio_get.argtypes = [ctypes.c_char_p, ctypes.c_char_p, u8_pp, u64_p]
+        lib.pio_get.restype = ctypes.c_int
+        lib.pio_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.pio_free.restype = None
+        _lib = lib
+        return _lib

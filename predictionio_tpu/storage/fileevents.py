@@ -143,7 +143,8 @@ class FileEvents(base.Events):
                 e for e in self._load(app_id, channel_id).values()
                 if filter.matches(e)
             ]
-        events.sort(key=lambda e: e.event_time, reverse=filter.reversed)
+        events.sort(key=lambda e: (e.event_time, e.event_id or ""),
+                    reverse=filter.reversed)
         if filter.limit is not None and filter.limit >= 0:
             events = events[: filter.limit]
         return iter(events)

@@ -70,6 +70,7 @@ def _builtin_backends() -> None:
     if _builtins_loaded:
         return
     _builtins_loaded = True
+    from predictionio_tpu.storage.binevents import BinEventsStorageClient
     from predictionio_tpu.storage.fileevents import FileEventsStorageClient
     from predictionio_tpu.storage.localfs import LocalFSStorageClient
     from predictionio_tpu.storage.memory import MemoryStorageClient
@@ -82,9 +83,15 @@ def _builtin_backends() -> None:
     _BACKENDS.setdefault("jdbc", SQLiteStorageClient)
     _BACKENDS.setdefault("localfs", LocalFSStorageClient)
     # append-only JSONL event store — the reference's hbase role
-    # (event-data only); "hbase" aliases to it for pio-env.sh compatibility
+    # (event-data only)
     _BACKENDS.setdefault("fileevents", FileEventsStorageClient)
-    _BACKENDS.setdefault("hbase", FileEventsStorageClient)
+    # binary event log with the native (C++) scan path; "hbase" aliases to
+    # it for pio-env.sh compatibility — it is the high-throughput
+    # event-store role the reference filled with HBase. Note binevents
+    # (.bin under PATH or ~/.pio_store/binevents) and fileevents (.jsonl)
+    # use different on-disk formats/directories; pick one per deployment.
+    _BACKENDS.setdefault("binevents", BinEventsStorageClient)
+    _BACKENDS.setdefault("hbase", BinEventsStorageClient)
 
 
 class Storage:

@@ -1,0 +1,200 @@
+"""Native (C++) event-log scanner: build, correctness, and byte-level
+interoperability with the pure-Python codec.
+
+The native library is the TPU build's data-loader runtime component (the
+reference's full-event-scan hot path, SURVEY.md §3.1); these tests pin
+that (a) it builds and loads in this image, (b) both codecs produce
+interchangeable files, (c) filtered scans agree exactly with
+EventFilter.matches semantics, and (d) a torn tail record is tolerated.
+"""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import EventFilter
+from predictionio_tpu.storage.binevents import BinEvents
+from predictionio_tpu import native
+
+T0 = datetime(2021, 6, 1, tzinfo=timezone.utc)
+
+
+def ev(name="rate", entity="u1", minutes=0, target=None, props=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=entity,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+def fill(store, app=1):
+    ids = []
+    ids.append(store.insert(ev("rate", "u1", 0, target="i1", props={"r": 4}), app))
+    ids.append(store.insert(ev("rate", "u2", 5, target="i2", props={"r": 2}), app))
+    ids.append(store.insert(ev("buy", "u1", 10, target="i2"), app))
+    ids.append(store.insert(ev("$set", "u3", 15, props={"a": 1}), app))
+    return ids
+
+
+def test_native_library_builds_and_loads():
+    lib = native.load_eventlog()
+    assert lib is not None, "g++ is in this image; the native path must build"
+
+
+@pytest.mark.parametrize("write_native,read_native", [
+    (True, False), (False, True), (True, True), (False, False),
+])
+def test_codec_interop(tmp_path, write_native, read_native):
+    """Files written by either codec are read identically by the other."""
+    path = str(tmp_path / "log")
+    w = BinEvents(path, use_native=write_native)
+    if write_native:
+        assert w.native_active
+    ids = fill(w)
+    w.close()
+
+    r = BinEvents(path, use_native=read_native)
+    got = {e.event_id: e for e in r.find(1)}
+    assert set(got) == set(ids)
+    e = got[ids[0]]
+    assert e.event == "rate"
+    assert e.entity_id == "u1"
+    assert e.target_entity_id == "i1"
+    assert e.properties.get("r") == 4
+    assert e.event_time == T0
+    r.close()
+
+
+def test_native_filtered_scan_matches_python(tmp_path):
+    path = str(tmp_path / "log")
+    store = BinEvents(path, use_native=True)
+    assert store.native_active
+    fill(store)
+
+    filters = [
+        EventFilter(),
+        EventFilter(event_names=["rate"]),
+        EventFilter(entity_type="user", entity_id="u1"),
+        EventFilter(start_time=T0 + timedelta(minutes=5)),
+        EventFilter(until_time=T0 + timedelta(minutes=5)),
+        EventFilter(start_time=T0, until_time=T0 + timedelta(minutes=10)),
+        EventFilter(target_entity_type=None),          # must be absent
+        EventFilter(target_entity_type="item"),
+        EventFilter(target_entity_id="i2"),
+        EventFilter(event_names=["rate", "buy"], reversed=True, limit=2),
+    ]
+    py = BinEvents(path, use_native=False)
+    for flt in filters:
+        nat_ids = [e.event_id for e in store.find(1, filter=flt)]
+        py_ids = [e.event_id for e in py.find(1, filter=flt)]
+        assert nat_ids == py_ids, f"filter {flt} diverged"
+    store.close()
+    py.close()
+
+
+def test_delete_and_overwrite_compaction(tmp_path):
+    path = str(tmp_path / "log")
+    store = BinEvents(path, use_native=True)
+    ids = fill(store)
+    assert store.delete(ids[1], 1) is True
+    assert store.delete(ids[1], 1) is False      # already gone
+    assert store.get(ids[1], 1) is None
+    # re-put with the same id: last put wins
+    e = store.get(ids[0], 1)
+    updated = Event(
+        event="rate", entity_type="user", entity_id="u1",
+        target_entity_type="item", target_entity_id="i9",
+        properties=DataMap({"r": 5}), event_time=e.event_time,
+        event_id=ids[0],
+    )
+    store.insert(updated, 1)
+    got = store.get(ids[0], 1)
+    assert got.target_entity_id == "i9"
+    assert got.properties.get("r") == 5
+    assert len(list(store.find(1))) == 3
+    store.close()
+
+
+def test_torn_tail_record_is_tolerated(tmp_path):
+    path = str(tmp_path / "log")
+    store = BinEvents(path, use_native=True)
+    ids = fill(store)
+    store.close()
+    log = str(tmp_path / "log" / "events_1.bin")
+    with open(log, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xef\x01partial")  # torn record
+    for use_native in (True, False):
+        r = BinEvents(path, use_native=use_native)
+        assert {e.event_id for e in r.find(1)} == set(ids)
+        r.close()
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_writes_after_torn_tail_survive(tmp_path, use_native):
+    """Crash repair: opening for append truncates the torn tail, so
+    post-crash inserts are durable and visible (not appended behind an
+    unreadable record)."""
+    path = str(tmp_path / "log")
+    store = BinEvents(path, use_native=use_native)
+    ids = fill(store)
+    store.close()
+    log = str(tmp_path / "log" / "events_1.bin")
+    with open(log, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xef\x01partial")
+    store = BinEvents(path, use_native=use_native)
+    new_id = store.insert(ev("buy", "u7", 42, target="i3"), 1)
+    assert store.get(new_id, 1) is not None
+    assert {e.event_id for e in store.find(1)} == set(ids) | {new_id}
+    store.close()
+    # and a fresh reader (either codec) sees everything
+    r = BinEvents(path, use_native=not use_native)
+    assert {e.event_id for e in r.find(1)} == set(ids) | {new_id}
+    r.close()
+
+
+def test_empty_event_names_matches_nothing(tmp_path):
+    """EventFilter(event_names=[]) means 'match nothing' on both codecs."""
+    path = str(tmp_path / "log")
+    store = BinEvents(path, use_native=True)
+    fill(store)
+    assert list(store.find(1, filter=EventFilter(event_names=[]))) == []
+    py = BinEvents(path, use_native=False)
+    assert list(py.find(1, filter=EventFilter(event_names=[]))) == []
+    store.close()
+    py.close()
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_equal_timestamp_order_is_codec_independent(tmp_path, use_native):
+    """Equal event_time order (and limit cuts) tie-break on event_id, so
+    both codecs return the identical sequence."""
+    path = str(tmp_path / "log" / str(use_native))
+    store = BinEvents(path, use_native=use_native)
+    for i in range(8):
+        store.insert(
+            Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                  event_time=T0, event_id=f"id{i:02d}"),
+            1,
+        )
+    got = [e.event_id for e in store.find(1)]
+    assert got == [f"id{i:02d}" for i in range(8)]
+    cut = [e.event_id for e in store.find(1, filter=EventFilter(limit=3))]
+    assert cut == ["id00", "id01", "id02"]
+    store.close()
+
+
+def test_channel_isolation(tmp_path):
+    store = BinEvents(str(tmp_path / "log"), use_native=True)
+    store.insert(ev("rate", "u1"), 1)
+    store.insert(ev("buy", "u9"), 1, channel_id=7)
+    assert [e.event for e in store.find(1)] == ["rate"]
+    assert [e.event for e in store.find(1, channel_id=7)] == ["buy"]
+    assert store.remove(1, channel_id=7) is True
+    assert list(store.find(1, channel_id=7)) == []
+    store.close()

@@ -42,15 +42,32 @@ def client(request, tmp_path):
     c.close()
 
 
-@pytest.fixture(params=["memory", "sqlite", "sqlite_file", "fileevents"])
+@pytest.fixture(params=[
+    "memory", "sqlite", "sqlite_file", "fileevents",
+    "binevents", "binevents_py",
+])
 def events_client(request, tmp_path):
-    """Event-store conformance adds the events-only fileevents backend
-    (the reference ran the same LEventsSpec against hbase)."""
+    """Event-store conformance adds the events-only fileevents and
+    binevents backends (the reference ran the same LEventsSpec against
+    hbase). binevents runs twice: native C++ scan path and the
+    pure-Python codec fallback."""
     if request.param == "fileevents":
         from predictionio_tpu.storage.fileevents import FileEventsStorageClient
 
         c = FileEventsStorageClient(
             StorageClientConfig(properties={"PATH": str(tmp_path / "fe")})
+        )
+        yield c
+        c.events().close()
+        return
+    if request.param.startswith("binevents"):
+        from predictionio_tpu.storage.binevents import BinEventsStorageClient
+
+        native = "true" if request.param == "binevents" else "false"
+        c = BinEventsStorageClient(
+            StorageClientConfig(
+                properties={"PATH": str(tmp_path / "be"), "NATIVE": native}
+            )
         )
         yield c
         c.events().close()
