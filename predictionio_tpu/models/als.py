@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.ops import pallas_topk
 from predictionio_tpu.ops import topk as topk_ops
 from predictionio_tpu.utils.bimap import BiMap, EntityIdIxMap
 
@@ -71,7 +72,8 @@ class ALSModel:
             else jnp.ones((self.item_factors.shape[0],), dtype=jnp.float32)
         )
         k = min(_serving_k(num), self.item_factors.shape[0])
-        vals, idxs = topk_ops.recommend_topk(
+        # auto-dispatches to the pallas streaming kernel at catalog scale
+        vals, idxs = pallas_topk.recommend_topk_fused(
             self.user_factors[jnp.asarray([uix])],
             self.item_factors,
             jnp.asarray(cols),

@@ -33,6 +33,7 @@ from predictionio_tpu.controller import (
 )
 from predictionio_tpu.controller.base import PersistentModelManifest
 from predictionio_tpu.models.als import ALSModel
+from predictionio_tpu.ops import pallas_topk
 from predictionio_tpu.ops import topk as topk_ops
 from predictionio_tpu.ops.als import RatingsCOO, als_train
 from predictionio_tpu.utils.bimap import EntityIdIxMap
@@ -296,7 +297,8 @@ class ALSAlgorithm(ShardedAlgorithm):
                 mask[j, : len(s)] = 1.0
         allow = jnp.ones((model.item_factors.shape[0],), dtype=jnp.float32)
         k = min(max_num, model.item_factors.shape[0])
-        vals, idxs = topk_ops.recommend_topk(
+        # auto-dispatches to the pallas streaming kernel at catalog scale
+        vals, idxs = pallas_topk.recommend_topk_fused(
             model.user_factors[jnp.asarray(uixs)],
             model.item_factors,
             jnp.asarray(cols),
