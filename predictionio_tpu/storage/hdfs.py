@@ -1,0 +1,100 @@
+"""Network-filesystem model storage backend (the reference's HDFS role).
+
+Parity: storage/hdfs/src/main/scala/.../hdfs/{StorageClient,
+HDFSModels}.scala:31-60 — model blobs under a configured distributed
+filesystem path. The reference reached HDFS through the Hadoop
+``FileSystem`` client; the TPU-native deployment story is a mounted
+network filesystem (NFS / GCS-FUSE / Lustre on Cloud TPU VMs), so this
+backend addresses the store by path like ``localfs`` but adds the
+durability discipline a shared filesystem needs:
+
+- writes go to a tempfile, are fsync'd, then atomically renamed;
+- the directory entry is fsync'd after rename so the blob survives a
+  host crash (NFS close-to-open consistency makes this observable to
+  other hosts — e.g. a trainer writing a model that a serving host on
+  another VM loads);
+- reads retry once on ESTALE-style transient errors.
+
+Config properties: ``PATH`` (mount-point directory; default
+``~/.pio_store/hdfs_models``), ``PREFIX`` (file-name prefix).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+
+from predictionio_tpu.storage import base
+from predictionio_tpu.storage.base import Model, StorageClientConfig
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # some filesystems refuse O_RDONLY on dirs; rename already done
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class NetworkFSModels(base.Models):
+    def __init__(self, path: str, prefix: str = ""):
+        self._path = path
+        self._prefix = prefix
+        os.makedirs(path, exist_ok=True)
+
+    def _file(self, model_id: str) -> str:
+        safe = model_id.replace("/", "_").replace("..", "_")
+        return os.path.join(self._path, f"{self._prefix}{safe}")
+
+    def insert(self, model: Model) -> None:
+        target = self._file(model.id)
+        tmp = target + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(model.models)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
+        _fsync_dir(self._path)
+
+    def get(self, model_id: str) -> Model | None:
+        for attempt in (0, 1):
+            try:
+                with open(self._file(model_id), "rb") as f:
+                    return Model(model_id, f.read())
+            except FileNotFoundError:
+                return None
+            except OSError as exc:
+                # NFS handle went stale between open and read — retry once
+                if attempt == 0 and exc.errno in (errno.ESTALE, errno.EIO):
+                    continue
+                raise
+        return None
+
+    def delete(self, model_id: str) -> None:
+        try:
+            os.remove(self._file(model_id))
+        except FileNotFoundError:
+            pass
+        _fsync_dir(self._path)
+
+
+class HDFSStorageClient(base.BaseStorageClient):
+    """Config properties: PATH (mounted network-FS dir), PREFIX."""
+
+    prefix = "HDFS"
+
+    def __init__(self, config: StorageClientConfig = StorageClientConfig()):
+        super().__init__(config)
+        path = config.properties.get(
+            "PATH",
+            os.path.join(os.path.expanduser("~"), ".pio_store", "hdfs_models"),
+        )
+        self._models = NetworkFSModels(
+            os.path.abspath(path), config.properties.get("PREFIX", "")
+        )
+
+    def models(self) -> NetworkFSModels:
+        return self._models

@@ -71,9 +71,12 @@ def _builtin_backends() -> None:
         return
     _builtins_loaded = True
     from predictionio_tpu.storage.binevents import BinEventsStorageClient
+    from predictionio_tpu.storage.elasticsearch import ESStorageClient
     from predictionio_tpu.storage.fileevents import FileEventsStorageClient
+    from predictionio_tpu.storage.hdfs import HDFSStorageClient
     from predictionio_tpu.storage.localfs import LocalFSStorageClient
     from predictionio_tpu.storage.memory import MemoryStorageClient
+    from predictionio_tpu.storage.s3 import S3StorageClient
     from predictionio_tpu.storage.sqlite import SQLiteStorageClient
 
     _BACKENDS.setdefault("memory", MemoryStorageClient)
@@ -92,6 +95,12 @@ def _builtin_backends() -> None:
     # use different on-disk formats/directories; pick one per deployment.
     _BACKENDS.setdefault("binevents", BinEventsStorageClient)
     _BACKENDS.setdefault("hbase", BinEventsStorageClient)
+    # network-filesystem and object-store model repositories
+    # (reference storage/hdfs, storage/s3)
+    _BACKENDS.setdefault("hdfs", HDFSStorageClient)
+    _BACKENDS.setdefault("s3", S3StorageClient)
+    # REST metadata/event store (reference storage/elasticsearch, 5.x REST)
+    _BACKENDS.setdefault("elasticsearch", ESStorageClient)
 
 
 class Storage:
