@@ -72,7 +72,9 @@ class ALSModel:
             else jnp.ones((self.item_factors.shape[0],), dtype=jnp.float32)
         )
         k = min(_serving_k(num), self.item_factors.shape[0])
-        # auto-dispatches to the pallas streaming kernel at catalog scale
+        # fused entry point for contract parity; with B=1 the auto
+        # dispatch always takes the XLA path — the pallas kernel engages
+        # only for batched prediction (batch_predict) at catalog scale
         vals, idxs = pallas_topk.recommend_topk_fused(
             self.user_factors[jnp.asarray([uix])],
             self.item_factors,

@@ -30,8 +30,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = jnp.float32(-jnp.inf)
 _TILE_I = 512
+#: auto-dispatch envelope, from measured v5e crossovers and VMEM budget:
+#: the kernel wins at catalog scale with enough queries to amortize the
+#: per-tile VPU selection, loses (or over-fills VMEM) outside it.
+_MIN_ITEMS = 786_432
+_MIN_BATCH = 24
+_MAX_BATCH = 512   # (B, S) seen arrays + (B, tile) scores must fit VMEM
+_MAX_K = 32        # selection loop unrolls k times per tile
+#: static menu of seen-pad widths; callers pad to 512, real per-batch
+#: seen counts are usually tiny — trimming to the smallest fitting width
+#: shrinks the unrolled mask loop by up to 64x at identical results
+_SEEN_WIDTHS = (8, 32, 128, 512)
 
 
 def _topk_kernel(user_ref, item_ref, allow_ref, seen_cols_ref, seen_mask_ref,
@@ -170,22 +180,55 @@ def recommend_topk_fused(
     VPU-bound selection only beats XLA's materialize+top_k once the
     score matrix stops fitting cheaply — wins observed at I>=~1M items
     with B>=~32 queries (6.3 ms vs 7.8 ms at I=1M/B=32; loses below,
-    e.g. 1.3 ms vs 0.8 ms at the MovieLens-scale I=27k). Forcing
-    ``use_pallas=True`` is exact (bit-identical indices on chip) at any
-    size."""
-    mode = _kernel_mode()
+    e.g. 1.3 ms vs 0.8 ms at the MovieLens-scale I=27k). The auto
+    dispatch also stays inside the kernel's envelope (B<=512 for VMEM,
+    k<=32 for the unrolled selection loop). Forcing ``use_pallas=True``
+    is exact (bit-identical indices on chip) at any size. Any failure to
+    build/run the kernel falls back to the XLA path."""
     if use_pallas is None:
         use_pallas = (
-            item_f.shape[0] >= 786_432 and user_vecs.shape[0] >= 24
+            item_f.shape[0] >= _MIN_ITEMS
+            and _MIN_BATCH <= user_vecs.shape[0] <= _MAX_BATCH
+            and k <= _MAX_K
         )
-    if mode is None or not use_pallas or allow.ndim != 1:
+    # probe (a real Mosaic compile) only when the kernel would be used
+    if not use_pallas or allow.ndim != 1 or (mode := _kernel_mode()) is None:
         from predictionio_tpu.ops.topk import recommend_topk
 
         return recommend_topk(user_vecs, item_f, seen_cols, seen_mask, allow, k)
+    seen_cols, seen_mask = _trim_seen(seen_cols, seen_mask)
     tile_i = min(tile_i, max(128, pl.cdiv(item_f.shape[0], 128) * 128))
-    return _pallas_masked_topk(
-        user_vecs, item_f, seen_cols.astype(jnp.int32),
-        seen_mask.astype(jnp.float32),
-        allow.astype(jnp.float32).reshape(1, -1),
-        k, tile_i, mode == "interpret",
+    try:
+        return _pallas_masked_topk(
+            user_vecs, item_f, seen_cols.astype(jnp.int32),
+            seen_mask.astype(jnp.float32),
+            allow.astype(jnp.float32).reshape(1, -1),
+            k, tile_i, mode == "interpret",
+        )
+    except Exception:
+        # e.g. a batch/seen-width combination Mosaic rejects on this
+        # generation — serve the request on the XLA path instead
+        from predictionio_tpu.ops.topk import recommend_topk
+
+        return recommend_topk(user_vecs, item_f, seen_cols, seen_mask, allow, k)
+
+
+def _trim_seen(seen_cols: jax.Array, seen_mask: jax.Array):
+    """Shrink the seen-item pad to the smallest static width covering the
+    batch's real max seen count (concrete arrays only — under a tracer
+    the caller's pad stands). The kernel unrolls its mask loop S times,
+    so this directly scales its per-tile VPU work."""
+    if isinstance(seen_mask, jax.core.Tracer) or seen_mask.ndim != 2:
+        return seen_cols, seen_mask
+    # bound by the last occupied slot (not the count): entries need not
+    # be left-packed
+    occupied = jnp.where(
+        seen_mask > 0,
+        jnp.arange(1, seen_mask.shape[1] + 1)[None, :],
+        0,
     )
+    real = int(jnp.max(occupied))
+    for width in _SEEN_WIDTHS:
+        if real <= width < seen_mask.shape[1]:
+            return seen_cols[:, :width], seen_mask[:, :width]
+    return seen_cols, seen_mask

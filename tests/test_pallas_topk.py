@@ -67,6 +67,55 @@ def test_masks_are_respected():
             assert (r, int(c)) not in seen
 
 
+def test_auto_dispatch_envelope():
+    """Auto dispatch stays inside the kernel's VMEM/unroll envelope:
+    out-of-envelope shapes must take the XLA path, not crash."""
+    from predictionio_tpu.ops import pallas_topk as ptk
+
+    ok = dict(item_f=ptk._MIN_ITEMS, b=ptk._MIN_BATCH, k=10)
+
+    def decided(items, b, k):
+        # replicate the use_pallas=None decision without running anything
+        return (items >= ptk._MIN_ITEMS
+                and ptk._MIN_BATCH <= b <= ptk._MAX_BATCH
+                and k <= ptk._MAX_K)
+
+    assert decided(ok["item_f"], ok["b"], ok["k"])
+    assert not decided(ok["item_f"] - 1, ok["b"], ok["k"])      # small catalog
+    assert not decided(ok["item_f"], ptk._MIN_BATCH - 1, ok["k"])  # tiny batch
+    assert not decided(ok["item_f"], ptk._MAX_BATCH + 1, ok["k"])  # VMEM blowup
+    assert not decided(ok["item_f"], ok["b"], ptk._MAX_K + 1)      # huge k
+
+
+def test_seen_trim_respects_unpacked_entries():
+    """_trim_seen keeps a real entry sitting past the count-based width."""
+    from predictionio_tpu.ops.pallas_topk import _trim_seen
+
+    cols = jnp.zeros((2, 512), jnp.int32).at[1, 100].set(42)
+    mask = jnp.zeros((2, 512), jnp.float32).at[1, 100].set(1.0)
+    tcols, tmask = _trim_seen(cols, mask)
+    assert tcols.shape[1] >= 101
+    assert int(tcols[1, 100]) == 42 and float(tmask[1, 100]) == 1.0
+    # fully-empty seen arrays trim to the smallest width
+    tcols2, _ = _trim_seen(jnp.zeros((2, 512), jnp.int32),
+                           jnp.zeros((2, 512), jnp.float32))
+    assert tcols2.shape[1] == 8
+
+
+def test_trimmed_seen_matches_reference():
+    """End-to-end through recommend_topk_fused with a wide sparse pad."""
+    rng = np.random.default_rng(7)
+    user_vecs, item_f, _, _, allow, k = make_case(rng, b=4, items=700, k=10)
+    cols = jnp.zeros((4, 512), jnp.int32).at[2, 60].set(5).at[0, 0].set(9)
+    mask = jnp.zeros((4, 512), jnp.float32).at[2, 60].set(1.0).at[0, 0].set(1.0)
+    ref_v, ref_i = recommend_topk(user_vecs, item_f, cols, mask, allow, k)
+    got_v, got_i = recommend_topk_fused(user_vecs, item_f, cols, mask, allow,
+                                        k, use_pallas=True)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(ref_i))
+
+
 def test_fewer_eligible_than_k_pads_with_neg_inf():
     rng = np.random.default_rng(1)
     user_vecs, item_f, seen_cols, seen_mask, _, _ = make_case(
