@@ -302,4 +302,11 @@ def main(argv: list[str] | None = None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # Re-resolve main through the canonical module name: under
+    # ``python -m predictionio_tpu.cli.pio`` this file executes as
+    # ``__main__`` while workflow.cli_commands registers train/deploy/...
+    # into the ``predictionio_tpu.cli.pio`` instance — calling the local
+    # main() would silently drop those subcommands.
+    from predictionio_tpu.cli.pio import main as _canonical_main
+
+    sys.exit(_canonical_main())

@@ -1,13 +1,15 @@
 """CLI subcommands backed by the workflow and tools layers: train, eval,
-deploy, undeploy, dashboard, adminserver, export, import.
+deploy, undeploy, dashboard, adminserver, export, import, build, run,
+upgrade, template.
 
-Parity: tools/.../console/Console.scala train:177/eval:227/deploy:255/
-undeploy:313/dashboard:326/adminserver:354/export:561/import:578 and
-commands/Engine.scala:37-318. The reference spawned `spark-submit` of
-CreateWorkflow/CreateServer (Runner.scala:185-307); here training and
-serving run in-process on the JAX runtime — there is no assembly jar or
-process boundary to cross, so `pio build` has no equivalent (Python
-engines import directly).
+Parity: tools/.../console/Console.scala build:147/train:177/eval:227/
+deploy:255/undeploy:313/dashboard:326/adminserver:354/run:367/upgrade:396/
+template:546/export:561/import:578 and commands/Engine.scala:37-318. The
+reference spawned `spark-submit` of CreateWorkflow/CreateServer
+(Runner.scala:185-307); here training and serving run in-process on the
+JAX runtime — there is no assembly jar or process boundary to cross, so
+`pio build` reduces to the checks the reference's compile step enforced
+(factory resolves, engine.json params bind).
 """
 
 from __future__ import annotations
@@ -325,6 +327,112 @@ def _cmd_import(args, storage) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# pio build / run / upgrade / template
+# ---------------------------------------------------------------------------
+
+def _configure_build(sub) -> None:
+    p = sub.add_parser("build", help="verify an engine variant is runnable")
+    p.add_argument("--engine-json", default="engine.json",
+                   help="engine variant file (default: ./engine.json)")
+    p.add_argument("--engine-factory", default="",
+                   help="override engineFactory from engine.json")
+
+
+def _cmd_build(args, storage) -> int:
+    """Verify the engine variant: template version gate + engineFactory
+    import + instantiation. Parity: commands/Engine.scala build:65-163 —
+    the reference generated pio.sbt and ran sbt package/assembly; Python
+    engines import directly, so "build" reduces to the same checks the
+    reference's compile step enforced (factory resolves, params bind)."""
+    from predictionio_tpu.controller.engine import resolve_engine_factory
+
+    if not _check_template_min_version():
+        return 1
+    try:
+        variant = _load_variant(args.engine_json)
+    except json.JSONDecodeError as exc:
+        print(f"[ERROR] {args.engine_json} is not valid JSON: {exc}")
+        return 1
+    factory_path = args.engine_factory or variant.get("engineFactory", "")
+    if not factory_path:
+        if variant:
+            print(f"[ERROR] {args.engine_json} has no engineFactory and "
+                  "no --engine-factory given.")
+        else:
+            print(f"[ERROR] {args.engine_json} not found and no "
+                  "--engine-factory given.")
+        return 1
+    try:
+        factory = resolve_engine_factory(factory_path)
+        engine = factory()
+    except Exception as exc:
+        print(f"[ERROR] engineFactory {factory_path!r} failed: {exc}")
+        return 1
+    try:
+        engine.params_from_variant_json(variant)
+    except Exception as exc:
+        print(f"[ERROR] engine.json params do not bind: {exc}")
+        return 1
+    print(f"[INFO] Build successful: {factory_path} "
+          f"({type(engine).__name__}) binds {args.engine_json}.")
+    return 0
+
+
+def _configure_run(sub) -> None:
+    p = sub.add_parser(
+        "run", help="run an arbitrary main function with storage wired up")
+    p.add_argument("main", help="dotted path module[:function] (default function: main)")
+    p.add_argument("args", nargs="*", help="arguments passed through")
+
+
+def _cmd_run(args, storage) -> int:
+    """Launch an arbitrary user main with the PIO environment prepared.
+    Parity: commands/Engine.scala run:278 (spark-submit of a user class);
+    here the user names ``pkg.module[:function]`` and it runs in-process
+    with storage initialised."""
+    import importlib
+
+    target = args.main
+    mod_name, _, fn_name = target.partition(":")
+    fn_name = fn_name or "main"
+    try:
+        module = importlib.import_module(mod_name)
+        fn = getattr(module, fn_name)
+    except (ImportError, AttributeError) as exc:
+        print(f"[ERROR] cannot resolve {target!r}: {exc}")
+        return 1
+    result = fn(*args.args)
+    # bool subclasses int; a main returning True means success, not rc=1
+    if isinstance(result, bool):
+        return 0 if result else 1
+    return int(result) if isinstance(result, int) else 0
+
+
+def _configure_upgrade(sub) -> None:
+    sub.add_parser("upgrade", help="(no longer supported)")
+
+
+def _cmd_upgrade(args, storage) -> int:
+    # Parity: Console.scala:664-666 — upgrade is a hard error upstream too.
+    print("[ERROR] Upgrade is no longer supported")
+    return 1
+
+
+def _configure_template(sub) -> None:
+    p = sub.add_parser("template", help="(no longer supported; use git)")
+    p.add_argument("subcommand", nargs="*")
+
+
+def _cmd_template(args, storage) -> int:
+    # Parity: Console.scala:691-694 — template gallery was retired upstream;
+    # engine templates ship in predictionio_tpu.templates instead.
+    print("[ERROR] template commands are no longer supported.")
+    print("[ERROR] Built-in engine templates live in predictionio_tpu.templates "
+          "(recommendation, similarproduct, ecommerce, classification).")
+    return 1
+
+
 register_command("train", _configure_train, _cmd_train)
 register_command("eval", _configure_eval, _cmd_eval)
 register_command("deploy", _configure_deploy, _cmd_deploy)
@@ -333,3 +441,7 @@ register_command("dashboard", _configure_dashboard, _cmd_dashboard)
 register_command("adminserver", _configure_adminserver, _cmd_adminserver)
 register_command("export", _configure_export, _cmd_export)
 register_command("import", _configure_import, _cmd_import)
+register_command("build", _configure_build, _cmd_build)
+register_command("run", _configure_run, _cmd_run)
+register_command("upgrade", _configure_upgrade, _cmd_upgrade)
+register_command("template", _configure_template, _cmd_template)

@@ -34,3 +34,9 @@ class CliParamsList(EngineParamsGenerator):
             )
             for m in (1, 2)
         ])
+
+
+def run_target(*args):
+    """Target for the `pio run` CLI test."""
+    print(f"run_target({', '.join(args)})")
+    return 0

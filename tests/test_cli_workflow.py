@@ -83,3 +83,63 @@ def test_train_eval_deploy_undeploy(cli_env, capsys):
 def test_train_missing_engine_json_fails(cli_env, capsys):
     assert main(["train", "--engine-json", "nope.json"]) == 1
     assert "not found" in capsys.readouterr().out
+
+
+def test_build_validates_variant(cli_env, capsys):
+    engine_json = {
+        "id": "cli-engine",
+        "engineFactory": "tests.sample_engine.engine_factory",
+        "datasource": {"params": {"id": 3, "n_train": 5, "n_folds": 2}},
+        "algorithms": [{"name": "sample", "params": {"id": 0, "mult": 3}}],
+    }
+    with open("engine.json", "w") as f:
+        json.dump(engine_json, f)
+    assert main(["build"]) == 0
+    assert "Build successful" in capsys.readouterr().out
+
+    # bad factory fails
+    engine_json["engineFactory"] = "tests.sample_engine.no_such_factory"
+    with open("engine.json", "w") as f:
+        json.dump(engine_json, f)
+    assert main(["build"]) == 1
+    assert "failed" in capsys.readouterr().out
+
+    # unbindable params fail
+    engine_json["engineFactory"] = "tests.sample_engine.engine_factory"
+    engine_json["algorithms"] = [{"name": "no-such-algo", "params": {}}]
+    with open("engine.json", "w") as f:
+        json.dump(engine_json, f)
+    assert main(["build"]) == 1
+    assert "do not bind" in capsys.readouterr().out
+
+
+def test_run_invokes_target_main(cli_env, capsys):
+    assert main(["run", "tests.cli_eval_support:run_target", "a", "b"]) == 0
+    assert "run_target(a, b)" in capsys.readouterr().out
+    assert main(["run", "tests.no_such_module:main"]) == 1
+
+
+def test_upgrade_and_template_report_unsupported(cli_env, capsys):
+    # Parity: Console.scala:664-666, 691-694
+    assert main(["upgrade"]) == 1
+    assert main(["template", "get", "x"]) == 1
+    out = capsys.readouterr().out
+    assert "no longer supported" in out
+
+
+def test_module_entrypoint_registers_workflow_commands(cli_env):
+    # `python -m predictionio_tpu.cli.pio` must expose train/deploy —
+    # regression test for the __main__ double-import dropping them.
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    env = dict(os.environ, PYTHONPATH=repo_root)
+    out = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.cli.pio", "--help"],
+        capture_output=True, text=True, env=env,
+    ).stdout
+    for cmd in ("train", "deploy", "eval", "build"):
+        assert cmd in out
