@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Editable install of the framework (role of bin/install.sh).
+set -e
+cd "$(dirname "$0")/.."
+"${PIO_PYTHON:-python3}" -m pip install -e .
+echo "Installed. Try: pio status"

@@ -256,7 +256,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 #: commands that run the JAX pipeline and therefore take part in the
 #: multi-host jax.distributed barrier
-COMPUTE_COMMANDS = frozenset({"train", "eval", "deploy"})
+COMPUTE_COMMANDS = frozenset({"train", "eval", "deploy", "run"})
 
 _COMMANDS = {
     "version": _cmd_version,

@@ -99,8 +99,12 @@ def _builtin_backends() -> None:
     # (reference storage/hdfs, storage/s3)
     _BACKENDS.setdefault("hdfs", HDFSStorageClient)
     _BACKENDS.setdefault("s3", S3StorageClient)
-    # REST metadata/event store (reference storage/elasticsearch, 5.x REST)
+    # REST metadata/event store (reference storage/elasticsearch, 5.x REST);
+    # "elasticsearch1" aliases to it so pio-env.sh files written for the
+    # reference's 1.x transport backend keep working (storage/elasticsearch1
+    # was metadata-only — this one is a superset).
     _BACKENDS.setdefault("elasticsearch", ESStorageClient)
+    _BACKENDS.setdefault("elasticsearch1", ESStorageClient)
 
 
 class Storage:

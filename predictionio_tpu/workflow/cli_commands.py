@@ -21,11 +21,18 @@ from predictionio_tpu.cli.pio import find_channel, register_command
 from predictionio_tpu.workflow.context import WorkflowParams
 
 
-def _load_variant(path: str) -> dict:
+def _load_variant(path: str) -> dict | None:
+    """Parse an engine variant file. {} when the file is absent; None
+    (with a printed error) when it exists but is not valid JSON — every
+    subcommand gets the same clean diagnostic instead of a traceback."""
     if not os.path.exists(path):
         return {}
     with open(path) as f:
-        return json.load(f)
+        try:
+            return json.load(f)
+        except json.JSONDecodeError as exc:
+            print(f"[ERROR] {path} is not valid JSON: {exc}")
+            return None
 
 
 def _check_template_min_version(template_json: str = "template.json") -> bool:
@@ -94,6 +101,8 @@ def _cmd_train(args, storage) -> int:
     if not _check_template_min_version():
         return 1
     variant = _load_variant(args.engine_json)
+    if variant is None:
+        return 1
     if not variant and not args.engine_factory:
         print(f"[ERROR] {args.engine_json} not found and no --engine-factory given.")
         return 1
@@ -194,6 +203,8 @@ def _cmd_deploy(args, storage) -> int:
     if not _check_template_min_version():
         return 1
     variant = _load_variant(args.engine_json)
+    if variant is None:
+        return 1
     config = ServerConfig(
         ip=args.ip,
         port=args.port,
@@ -349,14 +360,12 @@ def _cmd_build(args, storage) -> int:
 
     if not _check_template_min_version():
         return 1
-    try:
-        variant = _load_variant(args.engine_json)
-    except json.JSONDecodeError as exc:
-        print(f"[ERROR] {args.engine_json} is not valid JSON: {exc}")
+    variant = _load_variant(args.engine_json)
+    if variant is None:
         return 1
     factory_path = args.engine_factory or variant.get("engineFactory", "")
     if not factory_path:
-        if variant:
+        if os.path.exists(args.engine_json):
             print(f"[ERROR] {args.engine_json} has no engineFactory and "
                   "no --engine-factory given.")
         else:
@@ -383,7 +392,10 @@ def _configure_run(sub) -> None:
     p = sub.add_parser(
         "run", help="run an arbitrary main function with storage wired up")
     p.add_argument("main", help="dotted path module[:function] (default function: main)")
-    p.add_argument("args", nargs="*", help="arguments passed through")
+    import argparse
+
+    p.add_argument("args", nargs=argparse.REMAINDER,
+                   help="arguments passed through verbatim")
 
 
 def _cmd_run(args, storage) -> int:

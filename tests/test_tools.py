@@ -267,3 +267,50 @@ class TestSelfCleaningDataSource:
         assert len(stored) == 2
         merged = next(e for e in stored if e.event == "$set")
         assert merged.properties["a"] == 2
+
+
+class TestBinScripts:
+    """The bin/ launcher stack (role of the reference's bin/pio*,
+    tools/.../console entry): pio wrapper execs the Python console;
+    pio-start-all/pio-stop-all manage daemons with pidfiles."""
+
+    def test_bin_pio_version_and_daemon_lifecycle(self, tmp_path):
+        import os
+        import pathlib
+        import subprocess
+        import time
+        import urllib.request
+
+        repo = pathlib.Path(__file__).resolve().parents[1]
+        env = dict(
+            os.environ,
+            PIO_FS_BASEDIR=str(tmp_path),
+            PIO_PID_DIR=str(tmp_path),
+            PIO_LOG_DIR=str(tmp_path),
+            PIO_EVENTSERVER_PORT="17172",
+            PIO_DASHBOARD_PORT="19192",
+            PIO_ADMINSERVER_PORT="17173",
+        )
+        out = subprocess.run([str(repo / "bin" / "pio"), "version"],
+                             capture_output=True, text=True, env=env)
+        assert out.returncode == 0 and out.stdout.strip()
+
+        subprocess.run([str(repo / "bin" / "pio-start-all")],
+                       check=True, env=env, capture_output=True)
+        try:
+            alive = None
+            for _ in range(60):
+                try:
+                    with urllib.request.urlopen(
+                            "http://127.0.0.1:17172/", timeout=2) as r:
+                        alive = json.loads(r.read())
+                    break
+                except OSError:
+                    time.sleep(0.5)
+            assert alive == {"status": "alive"}
+            assert (tmp_path / "eventserver.pid").exists()
+        finally:
+            stop = subprocess.run([str(repo / "bin" / "pio-stop-all")],
+                                  env=env, capture_output=True, text=True)
+        assert "Stopped eventserver" in stop.stdout
+        assert not (tmp_path / "eventserver.pid").exists()
