@@ -143,16 +143,16 @@ class Storage:
     # -- env parsing (Storage.scala:120-199) --------------------------------
     def _parse_sources(self) -> dict[str, tuple[str, StorageClientConfig]]:
         sources: dict[str, tuple[str, StorageClientConfig]] = {}
+        # A source's name is everything between the prefix and the _TYPE
+        # suffix, so names may themselves contain underscores (PIO_SQLITE).
         names = {
-            k.split("_")[3]
+            k[len(_SOURCES_PREFIX) + 1 : -len("_TYPE")]
             for k in self._env
-            if k.startswith(_SOURCES_PREFIX + "_") and len(k.split("_")) >= 5
+            if k.startswith(_SOURCES_PREFIX + "_") and k.endswith("_TYPE")
+            and len(k) > len(_SOURCES_PREFIX) + 1 + len("_TYPE")
         }
         for name in names:
             type_key = f"{_SOURCES_PREFIX}_{name}_TYPE"
-            if type_key not in self._env:
-                logger.warning("Storage source %s has no TYPE; skipping", name)
-                continue
             prefix = f"{_SOURCES_PREFIX}_{name}_"
             props = {
                 k[len(prefix):]: v

@@ -1,0 +1,168 @@
+"""Full-lifecycle quickstart over the real REST planes.
+
+The integration scenario of the reference's
+tests/pio_tests/scenarios/quickstart_test.py:50-170 — app creation,
+event ingestion over HTTP with access-key auth, training the ALS
+recommendation template from the event store, deploying, querying over
+HTTP, re-training on fresh events, hot-swapping via /reload, and
+stopping — all through the same CLI entry points a user runs.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.cli.pio import main
+from predictionio_tpu.storage.registry import Storage
+
+EVENT_PORT = 17174
+ENGINE_PORT = 18434
+
+N_USERS = 16
+N_ITEMS = 12
+
+
+@pytest.fixture
+def cli_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    monkeypatch.chdir(tmp_path)
+    Storage.reset_default()
+    yield tmp_path
+    Storage.reset_default()
+
+
+def _post(url: str, payload: dict | list, timeout: float = 10):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url: str, timeout: float = 10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _wait_alive(port: int, deadline_s: float = 30) -> dict:
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            return _get(f"http://127.0.0.1:{port}/", timeout=2)[1]
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"server on :{port} never came up")
+
+
+def _rating_event(user: int, item: int, rating: float) -> dict:
+    return {
+        "event": "rate",
+        "entityType": "user",
+        "entityId": f"u{user}",
+        "targetEntityType": "item",
+        "targetEntityId": f"i{item}",
+        "properties": {"rating": rating},
+    }
+
+
+def test_quickstart_full_lifecycle(cli_env):
+    # -- pio app new ---------------------------------------------------------
+    assert main(["app", "new", "QuickApp", "--access-key", "qs-key"]) == 0
+
+    # -- event server up, ingest over HTTP ----------------------------------
+    es_thread = threading.Thread(
+        target=main,
+        args=(["eventserver", "--ip", "127.0.0.1", "--port", str(EVENT_PORT)],),
+        daemon=True,
+    )
+    es_thread.start()
+    assert _wait_alive(EVENT_PORT) == {"status": "alive"}
+
+    base = f"http://127.0.0.1:{EVENT_PORT}"
+    # two taste clusters (even/odd), single posts + one batch post
+    singles, batch = [], []
+    for u in range(N_USERS):
+        for i in range(N_ITEMS):
+            if u == 0 and i == 0:
+                continue  # held out: the item u0 should be recommended
+            if i % 2 == u % 2:
+                (singles if (u + i) % 3 else batch).append(
+                    _rating_event(u, i, 5.0)
+                )
+            elif (u * 7 + i) % 5 == 0:
+                singles.append(_rating_event(u, i, 1.0))
+    for ev in singles:
+        status, body = _post(f"{base}/events.json?accessKey=qs-key", ev)
+        assert status == 201 and "eventId" in body
+    status, results = _post(f"{base}/batch/events.json?accessKey=qs-key", batch[:50])
+    assert status == 200
+    assert all(r["status"] == 201 for r in results)
+
+    # wrong access key is rejected
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/events.json?accessKey=wrong", singles[0])
+    assert exc.value.code == 401
+
+    # -- train ---------------------------------------------------------------
+    engine_json = {
+        "id": "quickstart",
+        "engineFactory":
+            "predictionio_tpu.templates.recommendation.engine_factory",
+        "datasource": {"params": {"app_name": "QuickApp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 8, "num_iterations": 8,
+                        "lambda_": 0.05, "seed": 1}}
+        ],
+    }
+    (cli_env / "engine.json").write_text(json.dumps(engine_json))
+    assert main(["train"]) == 0
+
+    # -- deploy + query over HTTP -------------------------------------------
+    dep_thread = threading.Thread(
+        target=main,
+        args=(["deploy", "--ip", "127.0.0.1", "--port", str(ENGINE_PORT)],),
+        daemon=True,
+    )
+    dep_thread.start()
+    assert _wait_alive(ENGINE_PORT)["status"] == "alive"
+
+    qbase = f"http://127.0.0.1:{ENGINE_PORT}"
+    status, result = _post(f"{qbase}/queries.json", {"user": "u0", "num": 4})
+    assert status == 200
+    scores = result["itemScores"]
+    assert 0 < len(scores) <= 4
+    # already-rated items are filtered, so the held-out even item wins
+    assert scores[0]["item"] == "i0"
+
+    # -- new events, retrain, hot-swap via /reload --------------------------
+    for i in range(N_ITEMS):
+        if i % 2 == 1:
+            _post(f"{base}/events.json?accessKey=qs-key",
+                  _rating_event(99, i, 5.0))
+    assert main(["train"]) == 0
+    status, _ = _post(f"{qbase}/reload", {})
+    assert status == 200
+    # swapped model serves the user that only exists in the second training
+    status, result = _post(f"{qbase}/queries.json", {"user": "u99", "num": 4})
+    assert status == 200
+    # u99 exists only in the second training run; its rated (odd) items
+    # are filtered so every recommendation is an unrated even item
+    assert len(result["itemScores"]) > 0
+    assert all(int(s["item"][1:]) % 2 == 0 for s in result["itemScores"])
+
+    # -- stop both planes ----------------------------------------------------
+    status, _ = _post(f"{qbase}/stop", {})
+    assert status == 200
+    dep_thread.join(timeout=10)
+    assert not dep_thread.is_alive()
+    assert main(["undeploy", "--ip", "127.0.0.1",
+                 "--port", str(EVENT_PORT)]) in (0, 1)  # stop event server
