@@ -1,0 +1,289 @@
+"""Self-attentive sequential recommendation (SASRec-family) on TPU.
+
+Next-item prediction over per-user event sequences — the neural upgrade
+of the reference's e2 MarkovChain (e2/.../engine/MarkovChain.scala:26-84,
+top-N transition model): where MarkovChain keeps first-order transition
+counts, this trains a causal transformer over full session histories.
+
+TPU-first design:
+- matmuls run in bf16 on the MXU (params and softmax/LN statistics stay
+  f32); logits against the tied item-embedding table accumulate f32.
+- fixed (batch, max_len) shapes — sessions are truncated/left-padded on
+  the host, so there is exactly one compile per config.
+- parallelism: batch shards over the mesh "data" axis; long sequences
+  shard over a "seq" axis using ring attention (ops/attention.py) —
+  K/V blocks rotate over ICI with lax.ppermute, so no device ever
+  materialises full-sequence attention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from functools import partial
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops.attention import full_attention, ring_attention
+
+logger = logging.getLogger(__name__)
+
+PAD = 0  # item id 0 is reserved for padding; real ids start at 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqRecConfig:
+    vocab: int              # number of items + 1 (pad)
+    max_len: int = 64
+    d_model: int = 64
+    n_heads: int = 2
+    n_layers: int = 2
+    mlp_mult: int = 4
+    dropout: float = 0.0    # kept for config parity; inference-free model
+    dtype: Any = jnp.bfloat16
+
+
+def init_params(key: jax.Array, cfg: SeqRecConfig) -> dict:
+    """f32 parameter pytree; compute casts to cfg.dtype per-op."""
+    keys = jax.random.split(key, 3 + cfg.n_layers)
+    d, h = cfg.d_model, cfg.mlp_mult * cfg.d_model
+    scale = 1.0 / math.sqrt(d)
+
+    def dense(k, m, n):
+        return jax.random.normal(k, (m, n), dtype=jnp.float32) / math.sqrt(m)
+
+    params = {
+        "item_emb": jax.random.normal(
+            keys[0], (cfg.vocab, d), dtype=jnp.float32) * scale,
+        "pos_emb": jax.random.normal(
+            keys[1], (cfg.max_len, d), dtype=jnp.float32) * scale,
+        "out_ln": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[3 + i], 6)
+        params["layers"].append({
+            "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+            "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+            "wqkv": dense(lk[0], d, 3 * d),
+            "wo": dense(lk[1], d, d),
+            "w1": dense(lk[2], d, h),
+            "b1": jnp.zeros((h,)),
+            "w2": dense(lk[3], h, d),
+            "b2": jnp.zeros((d,)),
+        })
+    return params
+
+
+def _ln(x: jax.Array, g: jax.Array, b: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-6) * g + b).astype(x.dtype)
+
+
+def forward(
+    params: Mapping,
+    seqs: jax.Array,           # (B, S) int32 item ids, right-padded with PAD
+    cfg: SeqRecConfig,
+    mesh: Mesh | None = None,
+    seq_axis: str = "seq",
+) -> jax.Array:
+    """Hidden states (B, S, D) in cfg.dtype. When ``mesh`` has a
+    ``seq_axis``, attention runs as ring attention over it."""
+    B, S = seqs.shape
+    d, H = cfg.d_model, cfg.n_heads
+    hd = d // H
+    mask = (seqs != PAD).astype(jnp.float32)           # (B, S)
+
+    x = params["item_emb"][seqs].astype(cfg.dtype)     # (B, S, D)
+    x = x + params["pos_emb"][None, :S].astype(cfg.dtype)
+    x = x * mask[..., None].astype(cfg.dtype)
+
+    use_ring = mesh is not None and seq_axis in mesh.shape and \
+        int(mesh.shape[seq_axis]) > 1
+
+    for layer in params["layers"]:
+        hpre = _ln(x, layer["ln1"]["g"], layer["ln1"]["b"])
+        qkv = hpre @ layer["wqkv"].astype(cfg.dtype)   # (B, S, 3D)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if use_ring:
+            att = ring_attention(q, k, v, mesh, seq_axis=seq_axis,
+                                 causal=True, kv_mask=mask)
+        else:
+            att = full_attention(q, k, v, causal=True, kv_mask=mask)
+        att = att.transpose(0, 2, 1, 3).reshape(B, S, d)
+        x = x + att @ layer["wo"].astype(cfg.dtype)
+
+        hpre = _ln(x, layer["ln2"]["g"], layer["ln2"]["b"])
+        hmid = jax.nn.gelu(hpre @ layer["w1"].astype(cfg.dtype)
+                           + layer["b1"].astype(cfg.dtype))
+        x = x + hmid @ layer["w2"].astype(cfg.dtype) + \
+            layer["b2"].astype(cfg.dtype)
+
+    return _ln(x, params["out_ln"]["g"], params["out_ln"]["b"])
+
+
+def logits_from_hidden(params: Mapping, h: jax.Array) -> jax.Array:
+    """Tied-weight output projection, f32 accumulation: (B, S, V)."""
+    return jnp.einsum("bsd,vd->bsv", h,
+                      params["item_emb"].astype(h.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def next_item_loss(
+    params: Mapping,
+    seqs: jax.Array,     # (B, S) inputs
+    targets: jax.Array,  # (B, S) next item per position, PAD=ignore
+    cfg: SeqRecConfig,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Mean masked softmax cross-entropy of next-item prediction."""
+    h = forward(params, seqs, cfg, mesh)
+    logits = logits_from_hidden(params, h)             # (B, S, V) f32
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tmask = (targets != PAD).astype(jnp.float32)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * tmask) / jnp.maximum(jnp.sum(tmask), 1.0)
+
+
+@dataclasses.dataclass
+class SeqRecModel:
+    params: dict
+    cfg: SeqRecConfig
+    item_index: Any = None  # utils.bimap.BiMap id <-> dense index (set by caller)
+
+
+def _adam_update(params, grads, m, v, step, lr, b1=0.9, b2=0.999, eps=1e-8):
+    m = jax.tree.map(lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+    v = jax.tree.map(lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+    bc1 = 1 - b1 ** step
+    bc2 = 1 - b2 ** step
+    params = jax.tree.map(
+        lambda p, mm, vv: p - lr * (mm / bc1) / (jnp.sqrt(vv / bc2) + eps),
+        params, m, v,
+    )
+    return params, m, v
+
+
+def make_train_step(cfg: SeqRecConfig, mesh: Mesh | None = None):
+    """One jitted Adam step. Under a mesh, batch shards over "data" and
+    (when present) sequence over "seq"; parameters stay replicated and
+    XLA inserts the gradient psums over ICI."""
+
+    def step_fn(params, opt_m, opt_v, step, seqs, targets, lr):
+        loss, grads = jax.value_and_grad(next_item_loss)(
+            params, seqs, targets, cfg, mesh)
+        params, opt_m, opt_v = _adam_update(
+            params, grads, opt_m, opt_v, step, lr)
+        return params, opt_m, opt_v, loss
+
+    if mesh is not None:
+        batch_spec = P("data", "seq") if "seq" in mesh.shape else P("data")
+        rep = NamedSharding(mesh, P())
+        data_sh = NamedSharding(mesh, batch_spec)
+        return jax.jit(
+            step_fn,
+            in_shardings=(rep, rep, rep, None, data_sh, data_sh, None),
+            out_shardings=(rep, rep, rep, None),
+        )
+    return jax.jit(step_fn)
+
+
+def pad_sequences(
+    sequences: list[list[int]], max_len: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Keep each sequence's most recent max_len+1 items and produce
+    (inputs, targets): inputs are seq[:-1] right-padded with PAD,
+    targets the shifted next items."""
+    B = len(sequences)
+    inputs = np.zeros((B, max_len), dtype=np.int32)
+    targets = np.zeros((B, max_len), dtype=np.int32)
+    for i, seq in enumerate(sequences):
+        seq = seq[-(max_len + 1):]
+        ins, tgt = seq[:-1], seq[1:]
+        inputs[i, : len(ins)] = ins
+        targets[i, : len(tgt)] = tgt
+    return inputs, targets
+
+
+def train(
+    sequences: list[list[int]],
+    cfg: SeqRecConfig,
+    *,
+    epochs: int = 20,
+    batch_size: int = 64,
+    lr: float = 1e-3,
+    seed: int = 0,
+    mesh: Mesh | None = None,
+) -> dict:
+    """Full training loop over dense-indexed item sequences (ids >= 1)."""
+    inputs, targets = pad_sequences(sequences, cfg.max_len)
+    n = inputs.shape[0]
+    # static batch shape: pad the set so every step uses the same compile
+    bs = min(batch_size, n)
+    if mesh is not None:
+        mult = int(mesh.shape.get("data", 1))
+        bs = max(mult, (bs // mult) * mult)
+    pad_rows = (-n) % bs
+    if pad_rows:
+        inputs = np.concatenate([inputs, np.zeros((pad_rows, cfg.max_len),
+                                                  np.int32)])
+        targets = np.concatenate([targets, np.zeros((pad_rows, cfg.max_len),
+                                                    np.int32)])
+        n = inputs.shape[0]
+
+    key = jax.random.PRNGKey(seed)
+    params = init_params(key, cfg)
+    opt_m = jax.tree.map(jnp.zeros_like, params)
+    opt_v = jax.tree.map(jnp.zeros_like, params)
+    step = make_train_step(cfg, mesh)
+
+    rng = np.random.default_rng(seed)
+    it = 0
+    for epoch in range(epochs):
+        order = rng.permutation(n)
+        losses = []
+        for s in range(0, n, bs):
+            idx = order[s : s + bs]
+            it += 1
+            params, opt_m, opt_v, loss = step(
+                params, opt_m, opt_v, it,
+                jnp.asarray(inputs[idx]), jnp.asarray(targets[idx]),
+                jnp.float32(lr),
+            )
+            losses.append(loss)
+        if epoch == 0 or (epoch + 1) % 5 == 0:
+            logger.info("seqrec epoch %d loss %.4f", epoch + 1,
+                        float(jnp.mean(jnp.stack(losses))))
+    return params
+
+
+@partial(jax.jit, static_argnames=("k", "cfg"))
+def predict_topk(
+    params: Mapping, history: jax.Array, k: int, cfg: SeqRecConfig,
+    vocab_mask: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k next items for (B, S) histories (the serving hot path; one
+    compile per (shape, k, cfg)). ``vocab_mask`` (V,) f32 is added to
+    the logits — 0 for allowed ids, a large negative for pad/seen/
+    disallowed ids."""
+    # hidden state at the last real position of each history
+    mask = (history != PAD)
+    last = jnp.maximum(jnp.sum(mask, axis=1) - 1, 0)   # (B,)
+    h = forward(params, history, cfg)
+    hl = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]  # (B, D)
+    logits = jnp.einsum("bd,vd->bv", hl, params["item_emb"].astype(h.dtype),
+                        preferred_element_type=jnp.float32)
+    logits = logits + vocab_mask[None, :]
+    return jax.lax.top_k(logits, k)

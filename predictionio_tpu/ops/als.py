@@ -19,7 +19,9 @@ TPU-first design (NOT a translation of MLlib's block solver):
   slow on TPU. Padding waste is bounded by the bucket growth factor.
 - **Static shapes.** Bucket shapes are the only compile keys; iteration
   count, λ, α are runtime values. lax.scan over fixed-size slabs bounds
-  HBM usage regardless of dataset size.
+  the solver's working set; rating slabs are HBM-resident by default
+  (fastest) or streamed per bucket with ``hbm_resident=False`` when the
+  padded rating set exceeds device memory.
 - **Batched Cholesky.** Per-row K×K systems are solved with
   ``jnp.linalg.cholesky`` + two batched triangular solves (vmapped by
   construction), keeping the solve on-device.
@@ -171,46 +173,56 @@ class DeviceBucketedRatings:
     nnz: int
 
 
+def _stage_bucket(
+    bucket: Bucket,
+    rank: int,
+    mesh: Mesh | None,
+    max_slab_elems: int,
+) -> DeviceBucket:
+    """Transfer one bucket's slabs to the device (sharded over the mesh's
+    data axis when given), padding row counts up to full slabs."""
+    data_axis = int(mesh.shape["data"]) if mesh is not None else 1
+    n = bucket.row_ids.shape[0]
+    s, b = _slab_shape(n, bucket.pad_len, rank, data_axis, max_slab_elems)
+    total = s * b
+
+    def pad3(a):
+        p = np.zeros((total, a.shape[1]), dtype=a.dtype)
+        p[:n] = a
+        return p.reshape(s, b, a.shape[1])
+
+    deg = np.zeros((total,), dtype=np.int32)
+    deg[:n] = bucket.mask.sum(axis=1).astype(np.int32)
+    cols, vals = pad3(bucket.cols), pad3(bucket.vals)
+    deg = deg.reshape(s, b)
+    if mesh is not None:
+        slab_sh = NamedSharding(mesh, P(None, "data", None))
+        deg_sh = NamedSharding(mesh, P(None, "data"))
+        cols = jax.device_put(cols, slab_sh)
+        vals = jax.device_put(vals, slab_sh)
+        deg = jax.device_put(deg, deg_sh)
+    else:
+        cols, vals, deg = map(jax.device_put, (cols, vals, deg))
+    return DeviceBucket(
+        row_ids=jax.device_put(jnp.asarray(bucket.row_ids)),
+        cols=cols, vals=vals, deg=deg, n=n, pad_len=bucket.pad_len,
+    )
+
+
 def stage_buckets(
     bucketed: BucketedRatings,
     rank: int,
     mesh: Mesh | None = None,
     max_slab_elems: int = 1 << 24,
 ) -> DeviceBucketedRatings:
-    """Transfer bucket slabs to the device (sharded over the mesh's data
-    axis when given), padding row counts up to full slabs."""
-    data_axis = int(mesh.shape["data"]) if mesh is not None else 1
-    staged = []
-    for bucket in bucketed.buckets:
-        n = bucket.row_ids.shape[0]
-        s, b = _slab_shape(n, bucket.pad_len, rank, data_axis, max_slab_elems)
-        total = s * b
-
-        def pad3(a):
-            p = np.zeros((total, a.shape[1]), dtype=a.dtype)
-            p[:n] = a
-            return p.reshape(s, b, a.shape[1])
-
-        deg = np.zeros((total,), dtype=np.int32)
-        deg[:n] = bucket.mask.sum(axis=1).astype(np.int32)
-        cols, vals = pad3(bucket.cols), pad3(bucket.vals)
-        deg = deg.reshape(s, b)
-        if mesh is not None:
-            slab_sh = NamedSharding(mesh, P(None, "data", None))
-            deg_sh = NamedSharding(mesh, P(None, "data"))
-            cols = jax.device_put(cols, slab_sh)
-            vals = jax.device_put(vals, slab_sh)
-            deg = jax.device_put(deg, deg_sh)
-        else:
-            cols, vals, deg = map(jax.device_put, (cols, vals, deg))
-        staged.append(
-            DeviceBucket(
-                row_ids=jax.device_put(jnp.asarray(bucket.row_ids)),
-                cols=cols, vals=vals, deg=deg, n=n, pad_len=bucket.pad_len,
-            )
-        )
+    """Stage every bucket HBM-resident. Peak device memory is the full
+    padded rating set (~8 bytes x padded nnz per orientation) — for sets
+    that don't fit, keep host ``BucketedRatings`` and let ``solve_half``
+    stream one bucket at a time instead (``als_train(hbm_resident=False)``)."""
     return DeviceBucketedRatings(
-        tuple(staged), bucketed.num_rows, bucketed.num_cols, bucketed.nnz
+        tuple(_stage_bucket(b, rank, mesh, max_slab_elems)
+              for b in bucketed.buckets),
+        bucketed.num_rows, bucketed.num_cols, bucketed.nnz,
     )
 
 
@@ -312,11 +324,10 @@ def solve_half(
     omits them from the factor RDD.
 
     Pass a :class:`DeviceBucketedRatings` (from :func:`stage_buckets`)
-    when calling repeatedly — host BucketedRatings is re-staged on every
-    call, which is transfer-bound.
+    when calling repeatedly — a host ``BucketedRatings`` is streamed one
+    bucket at a time per call (bounded device memory, but re-transferred
+    every call, which is transfer-bound across iterations).
     """
-    if isinstance(bucketed, BucketedRatings):
-        bucketed = stage_buckets(bucketed, rank, mesh, max_slab_elems)
     lam_a = jnp.float32(lam)
     alpha_a = jnp.float32(alpha)
     gram = _gramian(V) if implicit else jnp.zeros((rank, rank), dtype=V.dtype)
@@ -327,7 +338,10 @@ def solve_half(
         V = jax.device_put(V, rep)
         out = jax.device_put(out, rep)
 
+    streaming = isinstance(bucketed, BucketedRatings)
     for bucket in bucketed.buckets:
+        if streaming:  # transient slabs, freed after this bucket's solve
+            bucket = _stage_bucket(bucket, rank, mesh, max_slab_elems)
         X = _solve_slabs(V, bucket.cols, bucket.vals, bucket.deg,
                          lam_a, alpha_a, gram, implicit)
         X = X.reshape(-1, rank)[: bucket.n]
@@ -359,12 +373,19 @@ def als_train(
     bucket_growth: int = 2,
     max_row_len: int | None = None,
     max_slab_elems: int = 1 << 24,
+    hbm_resident: bool = True,
 ) -> ALSFactors:
     """Full alternating-least-squares training.
 
     Parity target: `ALS.train(ratings, rank, iterations, lambda)` /
     `ALS.trainImplicit(..., alpha)` semantics from the reference templates
     (ALSAlgorithm.scala:79-85); same hyperparameter meanings.
+
+    ``hbm_resident=True`` stages all rating slabs on device once (fast;
+    needs ~8 bytes x padded nnz x 2 orientations of HBM).
+    ``hbm_resident=False`` streams one slab batch at a time per
+    half-step — peak device memory bounded by ``max_slab_elems`` at the
+    cost of re-transferring ratings every iteration.
     """
     by_user = bucket_rows(ratings, min_bucket, bucket_growth, max_row_len)
     by_item = bucket_rows(ratings.transpose(), min_bucket, bucket_growth, max_row_len)
@@ -373,9 +394,10 @@ def als_train(
         ratings.nnz, ratings.num_rows, len(by_user.buckets),
         ratings.num_cols, len(by_item.buckets), rank,
     )
-    # stage slabs in HBM once — iterations are then pure device compute
-    by_user = stage_buckets(by_user, rank, mesh, max_slab_elems)
-    by_item = stage_buckets(by_item, rank, mesh, max_slab_elems)
+    if hbm_resident:
+        # stage slabs in HBM once — iterations are then pure device compute
+        by_user = stage_buckets(by_user, rank, mesh, max_slab_elems)
+        by_item = stage_buckets(by_item, rank, mesh, max_slab_elems)
 
     # MLlib-style init: scaled gaussian item factors, users solved first
     key = jax.random.PRNGKey(seed)

@@ -1,0 +1,140 @@
+"""Attention kernels: full causal attention and ring attention for
+sequence/context parallelism.
+
+The reference has no sequence models (SURVEY.md §5 "long-context:
+absent") — this is the TPU build's own scale axis, powering the
+session-based sequential recommendation engine (models/seqrec.py). Long
+sessions shard over a mesh "seq" axis: each device holds one block of
+the sequence, and K/V blocks rotate around the ring with
+``lax.ppermute`` while a flash-style online softmax accumulates partial
+results — compute overlaps the ICI transfer and no device ever holds
+the full sequence (Liu et al., Ring Attention; blockwise transformers).
+
+All logits accumulate in f32 regardless of input dtype (bf16 inputs
+recommended on TPU — the matmuls tile onto the MXU).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG = jnp.float32(-1e30)  # large-negative instead of -inf: keeps exp() NaN-free
+
+
+def full_attention(
+    q: jax.Array,  # (B, H, S, D)
+    k: jax.Array,  # (B, H, S, D)
+    v: jax.Array,  # (B, H, S, D)
+    *,
+    causal: bool = True,
+    kv_mask: jax.Array | None = None,  # (B, S) 1=real, 0=pad
+) -> jax.Array:
+    """Reference single-device attention; returns (B, H, S, D) in q.dtype."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bhsd,bhtd->bhst", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits / jnp.float32(math.sqrt(d))
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((s, t), dtype=bool))
+        logits = jnp.where(cmask[None, None], logits, _NEG)
+    if kv_mask is not None:
+        logits = jnp.where(kv_mask[:, None, None, :].astype(bool), logits, _NEG)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+def _ring_attention_local(
+    q: jax.Array,        # (B, H, Sl, D) local query block
+    k: jax.Array,        # (B, H, Sl, D) local key block (rotates)
+    v: jax.Array,        # (B, H, Sl, D) local value block (rotates)
+    kv_mask: jax.Array,  # (B, Sl) local key padding mask (rotates)
+    *,
+    axis_name: str,
+    causal: bool,
+) -> jax.Array:
+    """Per-device body run under shard_map: online-softmax accumulation
+    over ring-rotated K/V blocks."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Sl, D = q.shape
+    scale = jnp.float32(1.0 / math.sqrt(D))
+
+    q_pos = idx * Sl + lax.iota(jnp.int32, Sl)          # global query positions
+    block_pos = lax.iota(jnp.int32, Sl)
+
+    qf = q.astype(jnp.float32)
+    m0 = jnp.full((B, H, Sl), _NEG, dtype=jnp.float32)   # running max
+    l0 = jnp.zeros((B, H, Sl), dtype=jnp.float32)        # running denominator
+    o0 = jnp.zeros((B, H, Sl, D), dtype=jnp.float32)     # running numerator
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        m, l, o, k_blk, v_blk, mask_blk = carry
+        # the block arriving at step i originated on device (idx - i) mod n
+        src = (idx - i) % n
+        k_pos = src * Sl + block_pos
+        logits = jnp.einsum("bhsd,bhtd->bhst", qf, k_blk.astype(jnp.float32))
+        logits = logits * scale
+        valid = mask_blk[:, None, None, :].astype(bool)
+        if causal:
+            valid = valid & (q_pos[None, None, :, None] >= k_pos[None, None, None, :])
+        logits = jnp.where(valid, logits, _NEG)
+
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # blocks that are entirely masked contribute nothing; alpha/p stay
+        # finite because _NEG - _NEG == 0 and exp(0)=1 is cancelled by the
+        # seen-mask below
+        seen = m_new > _NEG / 2
+        alpha = jnp.where(seen, jnp.exp(m - m_new), 0.0)
+        p = jnp.exp(logits - m_new[..., None])
+        p = jnp.where(valid & seen[..., None], p, 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhst,bhtd->bhsd", p, v_blk.astype(jnp.float32))
+
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        return m_new, l, o, k_blk, v_blk, mask_blk
+
+    m, l, o, *_ = lax.fori_loop(0, n, step, (m0, l0, o0, k, v, kv_mask))
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    out = jnp.where((l > 0)[..., None], out, 0.0)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    causal: bool = True,
+    kv_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Sequence-parallel attention: (B, H, S, D) arrays whose S dimension
+    is sharded over ``mesh`` axis ``seq_axis``. S must divide evenly by
+    the axis size. Works inside jit (shard_map composes with pjit)."""
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:1] + q.shape[2:3], dtype=jnp.float32)
+    spec4 = P(None, None, seq_axis, None)
+    spec2 = P(None, seq_axis)
+    fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
+                           causal=causal)
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(spec4, spec4, spec4, spec2),
+        out_specs=spec4,
+        check_vma=False,
+    )(q, k, v, kv_mask)

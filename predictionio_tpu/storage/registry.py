@@ -154,10 +154,15 @@ class Storage:
         for name in names:
             type_key = f"{_SOURCES_PREFIX}_{name}_TYPE"
             prefix = f"{_SOURCES_PREFIX}_{name}_"
+            # keys that belong to a LONGER source name sharing this prefix
+            # (e.g. source PIO vs PIO_SQLITE) are not this source's props
+            longer = [f"{_SOURCES_PREFIX}_{other}_" for other in names
+                      if other != name and other.startswith(name + "_")]
             props = {
                 k[len(prefix):]: v
                 for k, v in self._env.items()
                 if k.startswith(prefix) and k != type_key
+                and not any(k.startswith(lp) for lp in longer)
             }
             sources[name] = (
                 self._env[type_key],
@@ -166,6 +171,13 @@ class Storage:
                     test=props.pop("TEST", "false").lower() == "true",
                     properties=props,
                 ),
+            )
+        if sources:
+            # surfaced so misparsed names (a property key ending in _TYPE
+            # reads as its own source) are visible to operators
+            logger.info(
+                "storage sources: %s",
+                {n: t for n, (t, _) in sorted(sources.items())},
             )
         return sources
 

@@ -1,0 +1,273 @@
+"""Session-based sequential recommendation engine template.
+
+Next-item prediction over each user's time-ordered event history with a
+causal transformer (models/seqrec, SASRec-family) — the neural
+counterpart of the reference's MarkovChain transition model
+(e2/.../engine/MarkovChain.scala:26-84) and its experimental
+complementary-purchase template family (examples/experimental). Query
+{"user": ..., "num": N} (or {"items": [recent ids], "num": N}) answers
+with the N most likely next items.
+
+Long sessions are first-class: with engine.json mesh axes
+{"data": D, "seq": S} the attention runs as ring attention over the
+"seq" mesh axis (ops/attention.py), so context length scales across
+devices over ICI.
+
+Usage (engine.json):
+    {"engineFactory":
+       "predictionio_tpu.templates.sessionrec.engine_factory",
+     "datasource": {"params": {"app_name": "MyApp",
+                               "event_names": ["view", "buy"]}},
+     "algorithms": [{"name": "seqrec",
+                     "params": {"d_model": 64, "n_layers": 2,
+                                "max_len": 64, "epochs": 20}}]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    FirstServing,
+    HostModelAlgorithm,
+    IdentityPreparator,
+    Params,
+    SanityCheck,
+)
+from predictionio_tpu.models import seqrec
+from predictionio_tpu.utils.bimap import BiMap
+
+_NEG = np.float32(-1e30)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str = ""
+    items: tuple = ()        # explicit recent-item history (overrides user)
+    num: int = 10
+    black_list: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: tuple = ("view", "buy")
+    entity_type: str = "user"
+    target_entity_type: str = "item"
+    min_sequence_len: int = 2
+    eval_k: int = 0
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    sequences: dict  # user id -> [item ids, time-ordered]
+
+    def sanity_check(self) -> None:
+        assert self.sequences, "no user event sequences found"
+
+
+class SessionDataSource(DataSource):
+    """Reads per-user time-ordered item interaction sequences.
+
+    The event scan mirrors the reference recommendation DataSource
+    (tests/pio_tests/engines/recommendation-engine/src/main/scala/
+    DataSource.scala:38-105) but keeps event order instead of folding
+    to ratings."""
+
+    params_class = DataSourceParams
+
+    def _read(self, ctx) -> TrainingData:
+        p = self.params
+        events = ctx.event_store().find(
+            p.app_name,
+            entity_type=p.entity_type,
+            event_names=list(p.event_names),
+            target_entity_type=p.target_entity_type,
+        )
+        per_user: dict[str, list] = {}
+        for ev in events:
+            if not ev.target_entity_id:
+                continue
+            per_user.setdefault(ev.entity_id, []).append(
+                (ev.event_time, ev.target_entity_id)
+            )
+        sequences = {
+            user: [item for _, item in sorted(pairs, key=lambda t: t[0])]
+            for user, pairs in per_user.items()
+        }
+        sequences = {
+            u: seq for u, seq in sequences.items()
+            if len(seq) >= self.params.min_sequence_len
+        }
+        return TrainingData(sequences=sequences)
+
+    def read_training(self, ctx) -> TrainingData:
+        return self._read(ctx)
+
+    def read_eval(self, ctx):
+        """Leave-one-out per fold: hold out each user's final item
+        (the standard sequential-recommendation protocol)."""
+        p = self.params
+        full = self._read(ctx)
+        folds = []
+        users = sorted(full.sequences)
+        k = max(p.eval_k, 1)
+        for fold in range(k):
+            train_seqs, qa = {}, []
+            for i, u in enumerate(users):
+                seq = full.sequences[u]
+                if i % k == fold and len(seq) > p.min_sequence_len:
+                    train_seqs[u] = seq[:-1]
+                    qa.append((Query(user=u), seq[-1]))
+                else:
+                    train_seqs[u] = seq
+            folds.append((TrainingData(sequences=train_seqs), {"fold": fold}, qa))
+        return folds
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgorithmParams(Params):
+    d_model: int = 64
+    n_heads: int = 2
+    n_layers: int = 2
+    max_len: int = 64
+    epochs: int = 20
+    batch_size: int = 64
+    lr: float = 1e-3
+    seed: int = 0
+    use_mesh: bool = True
+
+
+@dataclasses.dataclass
+class SeqRecEngineModel:
+    params: dict            # transformer weights (host numpy pytree)
+    cfg: seqrec.SeqRecConfig
+    item_index: BiMap       # item id string -> dense index (1-based)
+    histories: dict         # user -> [dense item indices] (serving state)
+
+
+class SeqRecAlgorithm(HostModelAlgorithm):
+    """Trains the causal transformer on the mesh; serves jitted top-k."""
+
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, pd: TrainingData) -> SeqRecEngineModel:
+        p = self.params
+        items = sorted({i for seq in pd.sequences.values() for i in seq})
+        # dense ids start at 1: index 0 is the PAD token
+        item_index = BiMap({item: i + 1 for i, item in enumerate(items)})
+        dense = {
+            u: [item_index[i] for i in seq] for u, seq in pd.sequences.items()
+        }
+        cfg = seqrec.SeqRecConfig(
+            vocab=len(items) + 1,
+            max_len=p.max_len,
+            d_model=p.d_model,
+            n_heads=p.n_heads,
+            n_layers=p.n_layers,
+        )
+        mesh = ctx.mesh_if_parallel if p.use_mesh else None
+        if mesh is not None and "seq" in mesh.shape and \
+                p.max_len % int(mesh.shape["seq"]):
+            raise ValueError(
+                f"max_len {p.max_len} must be a multiple of the seq mesh "
+                f"axis size ({int(mesh.shape['seq'])})"
+            )
+        weights = seqrec.train(
+            list(dense.values()), cfg,
+            epochs=p.epochs, batch_size=p.batch_size, lr=p.lr,
+            seed=p.seed, mesh=mesh,
+        )
+        import jax
+
+        return SeqRecEngineModel(
+            params=jax.tree.map(np.asarray, weights),
+            cfg=cfg,
+            item_index=item_index,
+            histories=dense,
+        )
+
+    # -- serving ------------------------------------------------------------
+
+    def _history_for(self, model: SeqRecEngineModel, query: Query):
+        if query.items:
+            return [
+                model.item_index.get(i)
+                for i in query.items
+                if model.item_index.get(i) is not None
+            ]
+        return model.histories.get(query.user, [])
+
+    def predict(self, model: SeqRecEngineModel, query: Query) -> PredictedResult:
+        import jax.numpy as jnp
+
+        history = self._history_for(model, query)
+        if not history:
+            return PredictedResult()
+        S = model.cfg.max_len
+        hist = np.zeros((1, S), np.int32)
+        tail = history[-S:]
+        hist[0, : len(tail)] = tail
+        mask = np.zeros((model.cfg.vocab,), np.float32)
+        mask[seqrec.PAD] = _NEG
+        for dense_id in tail:                       # don't repeat the session
+            mask[dense_id] = _NEG
+        for item in query.black_list:
+            di = model.item_index.get(item)
+            if di is not None:
+                mask[di] = _NEG
+        k = min(query.num, model.cfg.vocab - 1)
+        scores, ids = seqrec.predict_topk(
+            _as_device_tree(model.params),
+            jnp.asarray(hist), k, model.cfg, jnp.asarray(mask),
+        )
+        inv = model.item_index.inverse
+        out = []
+        for s, i in zip(np.asarray(scores)[0], np.asarray(ids)[0]):
+            if s <= _NEG / 2:
+                continue
+            item = inv.get(int(i))
+            if item is not None:
+                out.append(ItemScore(item=item, score=float(s)))
+        return PredictedResult(item_scores=tuple(out))
+
+
+_DEVICE_CACHE: dict[int, object] = {}
+
+
+def _as_device_tree(host_params: Mapping):
+    """Device-put the weight pytree once per model instance (serving keeps
+    models HBM-resident between requests — SURVEY.md §7 stage 7)."""
+    key = id(host_params)
+    if key not in _DEVICE_CACHE:
+        import jax
+
+        _DEVICE_CACHE.clear()  # one live model per process is the norm
+        _DEVICE_CACHE[key] = jax.tree.map(jax.device_put, dict(host_params))
+    return _DEVICE_CACHE[key]
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class_map=SessionDataSource,
+        preparator_class_map=IdentityPreparator,
+        algorithm_class_map={"seqrec": SeqRecAlgorithm},
+        serving_class_map=FirstServing,
+    )

@@ -78,12 +78,15 @@ def test_quickstart_full_lifecycle(cli_env):
     assert main(["app", "new", "QuickApp", "--access-key", "qs-key"]) == 0
 
     # -- event server up, ingest over HTTP ----------------------------------
-    es_thread = threading.Thread(
-        target=main,
-        args=(["eventserver", "--ip", "127.0.0.1", "--port", str(EVENT_PORT)],),
-        daemon=True,
+    # (started through the API object rather than `main(["eventserver"])`
+    # so the test can stop it — the CLI command blocks until SIGINT)
+    from predictionio_tpu.api.event_server import EventServer, EventServerConfig
+
+    es = EventServer(
+        Storage.default(),
+        EventServerConfig(ip="127.0.0.1", port=EVENT_PORT, stats=True),
     )
-    es_thread.start()
+    es.start()
     assert _wait_alive(EVENT_PORT) == {"status": "alive"}
 
     base = f"http://127.0.0.1:{EVENT_PORT}"
@@ -164,5 +167,6 @@ def test_quickstart_full_lifecycle(cli_env):
     assert status == 200
     dep_thread.join(timeout=10)
     assert not dep_thread.is_alive()
-    assert main(["undeploy", "--ip", "127.0.0.1",
-                 "--port", str(EVENT_PORT)]) in (0, 1)  # stop event server
+    es.stop()
+    with pytest.raises(OSError):
+        _get(f"{base}/", timeout=1)
