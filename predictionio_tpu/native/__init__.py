@@ -25,20 +25,34 @@ _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _load_failed = False
 
+_bucketize_lock = threading.Lock()
+_bucketize_lib: ctypes.CDLL | None = None
+_bucketize_failed = False
 
-def _ensure_built() -> str | None:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
+
+def _build(src: str, so: str) -> str | None:
+    try:
+        if os.path.exists(so) and (
+            not os.path.exists(src)  # prebuilt .so shipped without source
+            or os.path.getmtime(so) >= os.path.getmtime(src)
+        ):
+            return so
+    except OSError:
+        pass
     try:
         subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC],
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so, src],
             check=True,
             capture_output=True,
             timeout=120,
         )
-        return _SO
+        return so
     except (OSError, subprocess.SubprocessError):
         return None
+
+
+def _ensure_built() -> str | None:
+    return _build(_SRC, _SO)
 
 
 def load_eventlog() -> ctypes.CDLL | None:
@@ -86,3 +100,45 @@ def load_eventlog() -> ctypes.CDLL | None:
         lib.pio_free.restype = None
         _lib = lib
         return _lib
+
+
+def load_bucketize() -> ctypes.CDLL | None:
+    """Compile (if needed) and load the native ratings bucketizer
+    (bucketize.cc); None on failure — ops/als.bucket_rows falls back to
+    the NumPy implementation with identical slab layout."""
+    global _bucketize_lib, _bucketize_failed
+    with _bucketize_lock:
+        if _bucketize_lib is not None or _bucketize_failed:
+            return _bucketize_lib
+        so = _build(os.path.join(_DIR, "bucketize.cc"),
+                    os.path.join(_DIR, "_bucketize.so"))
+        if so is None:
+            _bucketize_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            _bucketize_failed = True
+            return None
+        i32_p = ctypes.POINTER(ctypes.c_int32)
+        i64_p = ctypes.POINTER(ctypes.c_int64)
+        f32_p = ctypes.POINTER(ctypes.c_float)
+        lib.pio_bucketize.argtypes = [
+            ctypes.c_int64, i32_p, i32_p, f32_p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.pio_bucketize.restype = ctypes.c_void_p
+        lib.pio_bucketize_num_buckets.argtypes = [ctypes.c_void_p]
+        lib.pio_bucketize_num_buckets.restype = ctypes.c_int32
+        lib.pio_bucketize_bucket_info.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, i32_p, i64_p,
+        ]
+        lib.pio_bucketize_bucket_info.restype = ctypes.c_int
+        lib.pio_bucketize_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, i32_p, i32_p, f32_p, i32_p,
+        ]
+        lib.pio_bucketize_fill.restype = ctypes.c_int
+        lib.pio_bucketize_free.argtypes = [ctypes.c_void_p]
+        lib.pio_bucketize_free.restype = None
+        _bucketize_lib = lib
+        return _bucketize_lib

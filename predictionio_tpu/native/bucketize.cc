@@ -1,0 +1,184 @@
+// Native ratings bucketizer: COO triples -> padded per-row slabs.
+//
+// The host-side data-prep hot path for the ALS engine (ops/als.py
+// bucket_rows): groups ratings by row, caps heavy rows keeping their
+// top-valued entries, and packs each power-of-`growth` degree class
+// into dense (n, pad_len) slabs. The Python/NumPy implementation loops
+// per unique row (~|users| Python iterations at MovieLens-20M scale);
+// this does one counting sort + one packing pass in C, O(nnz).
+//
+// Handle-based C API (ctypes, see native/__init__.py load_bucketize):
+//   h  = pio_bucketize(nnz, rows, cols, vals, min_len, growth, max_len)
+//   nb = pio_bucketize_num_buckets(h)
+//   pio_bucketize_bucket_info(h, b, &pad_len, &n)
+//   pio_bucketize_fill(h, b, row_ids_out, cols_out, vals_out, deg_out)
+//   pio_bucketize_free(h)
+// Output buffers are caller(NumPy)-allocated; fill packs entries to the
+// row prefix (cols/vals zero-padded past deg), matching the Python
+// layout contract in ops/als.Bucket.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct RowRef {
+    int64_t start;   // offset into the row-sorted order
+    int32_t row_id;
+    int32_t count;   // raw degree
+    int32_t kept;    // capped degree
+};
+
+struct BucketPlan {
+    int32_t pad_len;
+    std::vector<int64_t> row_refs;  // indices into rows_
+};
+
+struct Bucketizer {
+    std::vector<int64_t> order;     // nnz entries sorted by row (stable)
+    std::vector<RowRef> rows_;
+    std::vector<BucketPlan> buckets;
+    const int32_t* cols;
+    const float* vals;
+};
+
+int32_t pad_len_for(int32_t kept, int32_t min_len, int32_t growth) {
+    int64_t len = min_len;
+    while (len < kept) len *= growth;
+    return static_cast<int32_t>(len);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pio_bucketize(int64_t nnz, const int32_t* rows, const int32_t* cols,
+                    const float* vals, int32_t min_len, int32_t growth,
+                    int32_t max_len) {
+    if (nnz < 0 || min_len <= 0 || growth < 2) return nullptr;
+    auto* bz = new Bucketizer();
+    bz->cols = cols;
+    bz->vals = vals;
+
+    // counting sort by row id (stable): row ids are dense indices.
+    // Negative ids (corrupted input / int32 overflow upstream) would be
+    // out-of-bounds writes below — reject and let the caller fall back.
+    int32_t max_row = -1;
+    for (int64_t i = 0; i < nnz; ++i) {
+        if (rows[i] < 0) {
+            delete bz;
+            return nullptr;
+        }
+        max_row = std::max(max_row, rows[i]);
+    }
+    const int64_t n_rows = static_cast<int64_t>(max_row) + 1;
+    std::vector<int64_t> counts(n_rows + 1, 0);
+    for (int64_t i = 0; i < nnz; ++i) ++counts[rows[i] + 1];
+    std::vector<int64_t> offsets(counts);
+    for (int64_t r = 0; r < n_rows; ++r) offsets[r + 1] += offsets[r];
+    bz->order.resize(nnz);
+    {
+        std::vector<int64_t> cursor(offsets.begin(), offsets.end() - 1);
+        for (int64_t i = 0; i < nnz; ++i) bz->order[cursor[rows[i]]++] = i;
+    }
+
+    // per-row refs for non-empty rows
+    for (int64_t r = 0; r < n_rows; ++r) {
+        const int64_t c = offsets[r + 1] - offsets[r];
+        if (c == 0) continue;
+        RowRef ref;
+        ref.start = offsets[r];
+        ref.row_id = static_cast<int32_t>(r);
+        ref.count = static_cast<int32_t>(c);
+        ref.kept = (max_len > 0 && c > max_len) ? max_len
+                                                : static_cast<int32_t>(c);
+        bz->rows_.push_back(ref);
+    }
+
+    // group rows by pad length (ascending, like np.unique in the
+    // Python implementation)
+    std::vector<std::pair<int32_t, int64_t>> keyed;  // (pad_len, row index)
+    keyed.reserve(bz->rows_.size());
+    for (int64_t i = 0; i < static_cast<int64_t>(bz->rows_.size()); ++i) {
+        keyed.emplace_back(
+            pad_len_for(bz->rows_[i].kept, min_len, growth), i);
+    }
+    std::stable_sort(keyed.begin(), keyed.end(),
+                     [](const auto& a, const auto& b) {
+                         return a.first < b.first;
+                     });
+    for (const auto& [pl, idx] : keyed) {
+        if (bz->buckets.empty() || bz->buckets.back().pad_len != pl) {
+            bz->buckets.push_back(BucketPlan{pl, {}});
+        }
+        bz->buckets.back().row_refs.push_back(idx);
+    }
+    return bz;
+}
+
+int32_t pio_bucketize_num_buckets(void* handle) {
+    if (!handle) return -1;
+    return static_cast<int32_t>(
+        static_cast<Bucketizer*>(handle)->buckets.size());
+}
+
+int pio_bucketize_bucket_info(void* handle, int32_t b, int32_t* pad_len,
+                              int64_t* n) {
+    if (!handle) return -1;
+    auto* bz = static_cast<Bucketizer*>(handle);
+    if (b < 0 || b >= static_cast<int32_t>(bz->buckets.size())) return -1;
+    *pad_len = bz->buckets[b].pad_len;
+    *n = static_cast<int64_t>(bz->buckets[b].row_refs.size());
+    return 0;
+}
+
+int pio_bucketize_fill(void* handle, int32_t b, int32_t* row_ids_out,
+                       int32_t* cols_out, float* vals_out, int32_t* deg_out) {
+    if (!handle) return -1;
+    auto* bz = static_cast<Bucketizer*>(handle);
+    if (b < 0 || b >= static_cast<int32_t>(bz->buckets.size())) return -1;
+    const BucketPlan& plan = bz->buckets[b];
+    const int32_t pl = plan.pad_len;
+
+    std::vector<int64_t> scratch;  // value-sorted entry indices (capped rows)
+    for (int64_t j = 0; j < static_cast<int64_t>(plan.row_refs.size()); ++j) {
+        const RowRef& ref = bz->rows_[plan.row_refs[j]];
+        row_ids_out[j] = ref.row_id;
+        deg_out[j] = ref.kept;
+        int32_t* crow = cols_out + j * pl;
+        float* vrow = vals_out + j * pl;
+        std::memset(crow, 0, sizeof(int32_t) * pl);
+        std::memset(vrow, 0, sizeof(float) * pl);
+        if (ref.kept < ref.count) {
+            // capped heavy row: keep the top-valued entries
+            scratch.resize(ref.count);
+            for (int32_t t = 0; t < ref.count; ++t) {
+                scratch[t] = bz->order[ref.start + t];
+            }
+            std::partial_sort(
+                scratch.begin(), scratch.begin() + ref.kept, scratch.end(),
+                [bz](int64_t a, int64_t c) {
+                    return bz->vals[a] > bz->vals[c];
+                });
+            for (int32_t t = 0; t < ref.kept; ++t) {
+                crow[t] = bz->cols[scratch[t]];
+                vrow[t] = bz->vals[scratch[t]];
+            }
+        } else {
+            for (int32_t t = 0; t < ref.kept; ++t) {
+                const int64_t e = bz->order[ref.start + t];
+                crow[t] = bz->cols[e];
+                vrow[t] = bz->vals[e];
+            }
+        }
+    }
+    return 0;
+}
+
+void pio_bucketize_free(void* handle) {
+    delete static_cast<Bucketizer*>(handle);
+}
+
+}  // extern "C"

@@ -72,16 +72,27 @@ class RatingsCOO:
 
 @dataclasses.dataclass(frozen=True)
 class Bucket:
-    """All rows whose degree pads to ``pad_len``: dense (n, pad_len) slabs."""
+    """All rows whose degree pads to ``pad_len``: dense (n, pad_len) slabs.
+
+    Entries are packed to the row prefix, so the pad mask is fully
+    determined by ``deg`` and derived on demand."""
 
     row_ids: np.ndarray  # int32 (n,) original row indices
     cols: np.ndarray     # int32 (n, pad_len)
     vals: np.ndarray     # float32 (n, pad_len)
-    mask: np.ndarray     # float32 (n, pad_len) 1=real, 0=pad
+    deg: np.ndarray      # int32 (n,) real entries per row
 
     @property
     def pad_len(self) -> int:
         return int(self.cols.shape[1])
+
+    @property
+    def mask(self) -> np.ndarray:
+        """(n, pad_len) f32 — 1 for real entries, 0 for padding."""
+        return (
+            np.arange(self.pad_len, dtype=np.int32)[None, :]
+            < self.deg[:, None]
+        ).astype(np.float32)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,13 +104,22 @@ class BucketedRatings:
 
 
 def bucket_rows(
-    coo: RatingsCOO, min_len: int = 8, growth: int = 2, max_len: int | None = None
+    coo: RatingsCOO, min_len: int = 8, growth: int = 2,
+    max_len: int | None = None, use_native: bool = True,
 ) -> BucketedRatings:
     """Group ratings by row into padded power-of-``growth`` buckets.
 
     ``max_len`` caps a row's kept ratings (highest-value kept) — the
     recompile-control knob for pathological heavy rows.
+
+    The packing pass runs in native C++ when available (one counting
+    sort + one fill over nnz entries, native/bucketize.cc); the NumPy
+    path below is the fallback with an identical slab layout.
     """
+    if use_native:
+        native = _bucket_rows_native(coo, min_len, growth, max_len)
+        if native is not None:
+            return native
     order = np.argsort(coo.rows, kind="stable")
     rows = coo.rows[order]
     cols = coo.cols[order]
@@ -121,7 +141,6 @@ def bucket_rows(
         n = len(sel)
         b_cols = np.zeros((n, pl), dtype=np.int32)
         b_vals = np.zeros((n, pl), dtype=np.float32)
-        b_mask = np.zeros((n, pl), dtype=np.float32)
         for j, ui in enumerate(sel):
             s, c = start[ui], capped[ui]
             if c < counts[ui]:  # keep the top-valued ratings of a capped row
@@ -130,11 +149,63 @@ def bucket_rows(
                 seg = slice(s, s + c)
             b_cols[j, :c] = cols[seg]
             b_vals[j, :c] = vals[seg]
-            b_mask[j, :c] = 1.0
         buckets.append(
-            Bucket(uniq[sel].astype(np.int32), b_cols, b_vals, b_mask)
+            Bucket(uniq[sel].astype(np.int32), b_cols, b_vals,
+                   capped[sel].astype(np.int32))
         )
     return BucketedRatings(tuple(buckets), coo.num_rows, coo.num_cols, coo.nnz)
+
+
+def _bucket_rows_native(
+    coo: RatingsCOO, min_len: int, growth: int, max_len: int | None
+) -> BucketedRatings | None:
+    """C++ packing path; None when the native toolchain is unavailable."""
+    import ctypes
+
+    from predictionio_tpu.native import load_bucketize
+
+    lib = load_bucketize()
+    if lib is None or coo.nnz == 0:
+        return None
+
+    i32_p = ctypes.POINTER(ctypes.c_int32)
+    f32_p = ctypes.POINTER(ctypes.c_float)
+
+    def ptr(a, ty):
+        return a.ctypes.data_as(ty)
+
+    rows = np.ascontiguousarray(coo.rows, dtype=np.int32)
+    cols = np.ascontiguousarray(coo.cols, dtype=np.int32)
+    vals = np.ascontiguousarray(coo.vals, dtype=np.float32)
+    handle = lib.pio_bucketize(
+        coo.nnz, ptr(rows, i32_p), ptr(cols, i32_p), ptr(vals, f32_p),
+        min_len, growth, 0 if max_len is None else max_len,
+    )
+    if not handle:
+        return None
+    try:
+        buckets = []
+        for b in range(lib.pio_bucketize_num_buckets(handle)):
+            pad_len = ctypes.c_int32()
+            n = ctypes.c_int64()
+            if lib.pio_bucketize_bucket_info(
+                    handle, b, ctypes.byref(pad_len), ctypes.byref(n)):
+                return None
+            pl, nn = int(pad_len.value), int(n.value)
+            b_ids = np.empty((nn,), dtype=np.int32)
+            b_cols = np.empty((nn, pl), dtype=np.int32)
+            b_vals = np.empty((nn, pl), dtype=np.float32)
+            b_deg = np.empty((nn,), dtype=np.int32)
+            if lib.pio_bucketize_fill(
+                    handle, b, ptr(b_ids, i32_p), ptr(b_cols, i32_p),
+                    ptr(b_vals, f32_p), ptr(b_deg, i32_p)):
+                return None
+            buckets.append(Bucket(b_ids, b_cols, b_vals, b_deg))
+        return BucketedRatings(
+            tuple(buckets), coo.num_rows, coo.num_cols, coo.nnz
+        )
+    finally:
+        lib.pio_bucketize_free(handle)
 
 
 # ---------------------------------------------------------------------------
@@ -192,7 +263,7 @@ def _stage_bucket(
         return p.reshape(s, b, a.shape[1])
 
     deg = np.zeros((total,), dtype=np.int32)
-    deg[:n] = bucket.mask.sum(axis=1).astype(np.int32)
+    deg[:n] = bucket.deg
     cols, vals = pad3(bucket.cols), pad3(bucket.vals)
     deg = deg.reshape(s, b)
     if mesh is not None:

@@ -160,6 +160,15 @@ class SeqRecEngineModel:
     cfg: seqrec.SeqRecConfig
     item_index: BiMap       # item id string -> dense index (1-based)
     histories: dict         # user -> [dense item indices] (serving state)
+    # device-resident weight cache, populated on first predict; never
+    # serialized (recreated after checkpoint load / reload)
+    device_tree: Any = dataclasses.field(default=None, repr=False,
+                                         compare=False)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["device_tree"] = None
+        return state
 
 
 class SeqRecAlgorithm(HostModelAlgorithm):
@@ -235,7 +244,7 @@ class SeqRecAlgorithm(HostModelAlgorithm):
                 mask[di] = _NEG
         k = min(query.num, model.cfg.vocab - 1)
         scores, ids = seqrec.predict_topk(
-            _as_device_tree(model.params),
+            _as_device_tree(model),
             jnp.asarray(hist), k, model.cfg, jnp.asarray(mask),
         )
         inv = model.item_index.inverse
@@ -249,19 +258,16 @@ class SeqRecAlgorithm(HostModelAlgorithm):
         return PredictedResult(item_scores=tuple(out))
 
 
-_DEVICE_CACHE: dict[int, object] = {}
-
-
-def _as_device_tree(host_params: Mapping):
+def _as_device_tree(model: SeqRecEngineModel):
     """Device-put the weight pytree once per model instance (serving keeps
-    models HBM-resident between requests — SURVEY.md §7 stage 7)."""
-    key = id(host_params)
-    if key not in _DEVICE_CACHE:
+    models HBM-resident between requests — SURVEY.md §7 stage 7). Cached
+    on the model object itself, so a hot-swap (/reload) naturally drops
+    the old device weights with the old model."""
+    if model.device_tree is None:
         import jax
 
-        _DEVICE_CACHE.clear()  # one live model per process is the norm
-        _DEVICE_CACHE[key] = jax.tree.map(jax.device_put, dict(host_params))
-    return _DEVICE_CACHE[key]
+        model.device_tree = jax.tree.map(jax.device_put, dict(model.params))
+    return model.device_tree
 
 
 def engine_factory() -> Engine:

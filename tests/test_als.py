@@ -246,3 +246,41 @@ class TestPredictAndModel:
         itf = np.asarray(m.item_factors)
         np.testing.assert_allclose(got[0], uf[0] @ itf[2], rtol=1e-5)
         np.testing.assert_allclose(got[1], uf[1] @ itf[3], rtol=1e-5)
+
+
+class TestNativeBucketizer:
+    """native/bucketize.cc vs the NumPy fallback: identical slab layout."""
+
+    def test_native_matches_python(self):
+        rng = np.random.default_rng(3)
+        nnz = 20_000
+        coo = RatingsCOO(
+            (400 * rng.random(nnz) ** 1.5).astype(np.int32),
+            (300 * rng.random(nnz) ** 1.5).astype(np.int32),
+            rng.random(nnz).astype(np.float32) * 5,
+            400, 300,
+        )
+        nat = bucket_rows(coo, min_len=8, max_len=64)
+        py = bucket_rows(coo, min_len=8, max_len=64, use_native=False)
+        assert [b.pad_len for b in nat.buckets] == [b.pad_len for b in py.buckets]
+        for bn, bp in zip(nat.buckets, py.buckets):
+            on, op = np.argsort(bn.row_ids), np.argsort(bp.row_ids)
+            np.testing.assert_array_equal(bn.row_ids[on], bp.row_ids[op])
+            np.testing.assert_array_equal(bn.deg[on], bp.deg[op])
+            for j in range(len(on)):
+                a, b = on[j], op[j]
+                da, db = int(bn.deg[a]), int(bp.deg[b])
+                sa = sorted(zip(bn.cols[a][:da].tolist(), bn.vals[a][:da].tolist()))
+                sb = sorted(zip(bp.cols[b][:db].tolist(), bp.vals[b][:db].tolist()))
+                if da < 64:
+                    assert sa == sb
+                else:  # capped rows keep the same top-value multiset
+                    assert sorted(v for _, v in sa) == sorted(v for _, v in sb)
+            # padding stays zeroed
+            assert (bn.cols * (1 - bn.mask)).sum() == 0
+            assert (bn.vals * (1 - bn.mask)).sum() == 0
+
+    def test_empty_and_fallback(self):
+        coo = RatingsCOO(np.zeros(0, np.int32), np.zeros(0, np.int32),
+                         np.zeros(0, np.float32), 4, 4)
+        assert bucket_rows(coo).buckets == ()
