@@ -140,14 +140,19 @@ class ALSModel:
 
     # ---- persistence ----------------------------------------------------
     def save(self, directory: str) -> None:
-        """np.savez factors + JSON id maps — the orbax-style checkpoint
-        for this model family (single-host layout)."""
+        """Factor tables via utils/checkpoint.save_sharded (orbax: sharded
+        jax.Arrays write shard-locally, no gather-to-host — the SURVEY §7
+        sharded-persistence contract) + JSON id maps."""
+        from predictionio_tpu.utils.checkpoint import save_sharded
+
         os.makedirs(directory, exist_ok=True)
-        np.savez(
-            os.path.join(directory, "factors.npz"),
-            user=np.asarray(self.user_factors),
-            item=np.asarray(self.item_factors),
-        )
+        legacy = os.path.join(directory, "factors.npz")
+        if os.path.exists(legacy):
+            os.remove(legacy)  # a stale legacy file would shadow this save
+        save_sharded(directory, {
+            "user": self.user_factors,
+            "item": self.item_factors,
+        })
         meta = {
             "rank": self.rank,
             "user_ids": self.user_ids.id_to_ix.to_dict(),
@@ -158,8 +163,25 @@ class ALSModel:
             json.dump(meta, f)
 
     @staticmethod
-    def load(directory: str) -> "ALSModel":
-        data = np.load(os.path.join(directory, "factors.npz"))
+    def load(directory: str, shardings: dict | None = None) -> "ALSModel":
+        """``shardings`` optionally maps "user"/"item" to target
+        ``NamedSharding``s so factors restore straight onto a mesh."""
+        has_new = os.path.exists(os.path.join(directory, "checkpoint_meta.json"))
+        if not has_new and os.path.exists(os.path.join(directory, "factors.npz")):
+            # legacy single-file layout
+            legacy = np.load(os.path.join(directory, "factors.npz"))
+            data = {"user": legacy["user"], "item": legacy["item"]}
+            if shardings:
+                import jax
+
+                data = {
+                    k: jax.device_put(v, shardings[k]) if k in shardings else v
+                    for k, v in data.items()
+                }
+        else:
+            from predictionio_tpu.utils.checkpoint import load_sharded
+
+            data = load_sharded(directory, shardings=shardings)
         with open(os.path.join(directory, "model.json")) as f:
             meta = json.load(f)
         return ALSModel(

@@ -124,7 +124,7 @@ def load_bucketize() -> ctypes.CDLL | None:
         i64_p = ctypes.POINTER(ctypes.c_int64)
         f32_p = ctypes.POINTER(ctypes.c_float)
         lib.pio_bucketize.argtypes = [
-            ctypes.c_int64, i32_p, i32_p, f32_p,
+            ctypes.c_int64, i32_p, i32_p, f32_p, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ]
         lib.pio_bucketize.restype = ctypes.c_void_p

@@ -8,7 +8,8 @@
 // this does one counting sort + one packing pass in C, O(nnz).
 //
 // Handle-based C API (ctypes, see native/__init__.py load_bucketize):
-//   h  = pio_bucketize(nnz, rows, cols, vals, min_len, growth, max_len)
+//   h  = pio_bucketize(nnz, rows, cols, vals, num_rows, min_len, growth,
+//                      max_len)
 //   nb = pio_bucketize_num_buckets(h)
 //   pio_bucketize_bucket_info(h, b, &pad_len, &n)
 //   pio_bucketize_fill(h, b, row_ids_out, cols_out, vals_out, deg_out)
@@ -55,25 +56,24 @@ int32_t pad_len_for(int32_t kept, int32_t min_len, int32_t growth) {
 extern "C" {
 
 void* pio_bucketize(int64_t nnz, const int32_t* rows, const int32_t* cols,
-                    const float* vals, int32_t min_len, int32_t growth,
-                    int32_t max_len) {
-    if (nnz < 0 || min_len <= 0 || growth < 2) return nullptr;
+                    const float* vals, int32_t num_rows, int32_t min_len,
+                    int32_t growth, int32_t max_len) try {
+    if (nnz < 0 || num_rows < 0 || min_len <= 0 || growth < 2) return nullptr;
     auto* bz = new Bucketizer();
     bz->cols = cols;
     bz->vals = vals;
 
-    // counting sort by row id (stable): row ids are dense indices.
-    // Negative ids (corrupted input / int32 overflow upstream) would be
-    // out-of-bounds writes below — reject and let the caller fall back.
-    int32_t max_row = -1;
+    // counting sort by row id (stable): row ids are dense indices in
+    // [0, num_rows). Out-of-range ids (corrupted input / int32 overflow
+    // upstream) would be out-of-bounds writes or huge allocations below —
+    // reject and let the caller fall back to the NumPy path.
     for (int64_t i = 0; i < nnz; ++i) {
-        if (rows[i] < 0) {
+        if (rows[i] < 0 || rows[i] >= num_rows) {
             delete bz;
             return nullptr;
         }
-        max_row = std::max(max_row, rows[i]);
     }
-    const int64_t n_rows = static_cast<int64_t>(max_row) + 1;
+    const int64_t n_rows = num_rows;
     std::vector<int64_t> counts(n_rows + 1, 0);
     for (int64_t i = 0; i < nnz; ++i) ++counts[rows[i] + 1];
     std::vector<int64_t> offsets(counts);
@@ -116,6 +116,9 @@ void* pio_bucketize(int64_t nnz, const int32_t* rows, const int32_t* cols,
         bz->buckets.back().row_refs.push_back(idx);
     }
     return bz;
+} catch (...) {
+    // no C++ exception may cross the ctypes boundary (std::terminate)
+    return nullptr;
 }
 
 int32_t pio_bucketize_num_buckets(void* handle) {
@@ -135,7 +138,8 @@ int pio_bucketize_bucket_info(void* handle, int32_t b, int32_t* pad_len,
 }
 
 int pio_bucketize_fill(void* handle, int32_t b, int32_t* row_ids_out,
-                       int32_t* cols_out, float* vals_out, int32_t* deg_out) {
+                       int32_t* cols_out, float* vals_out, int32_t* deg_out)
+try {
     if (!handle) return -1;
     auto* bz = static_cast<Bucketizer*>(handle);
     if (b < 0 || b >= static_cast<int32_t>(bz->buckets.size())) return -1;
@@ -175,6 +179,8 @@ int pio_bucketize_fill(void* handle, int32_t b, int32_t* row_ids_out,
         }
     }
     return 0;
+} catch (...) {
+    return -1;
 }
 
 void pio_bucketize_free(void* handle) {

@@ -179,7 +179,7 @@ def _bucket_rows_native(
     vals = np.ascontiguousarray(coo.vals, dtype=np.float32)
     handle = lib.pio_bucketize(
         coo.nnz, ptr(rows, i32_p), ptr(cols, i32_p), ptr(vals, f32_p),
-        min_len, growth, 0 if max_len is None else max_len,
+        coo.num_rows, min_len, growth, 0 if max_len is None else max_len,
     )
     if not handle:
         return None
