@@ -1,0 +1,113 @@
+"""A from-scratch DASE engine: entity similarity over word sets.
+
+Demonstrates the controller API without any template: typed params,
+reading aggregated properties from the event store, a jitted compute
+kernel, and a custom Query/PredictedResult pair. See docs/dase.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    FirstServing,
+    HostModelAlgorithm,
+    IdentityPreparator,
+    Params,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    entity: str = ""
+    num: int = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class Neighbor:
+    entity: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    neighbors: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class DSParams(Params):
+    app_name: str = ""
+    entity_type: str = "doc"
+
+
+class WordsDataSource(DataSource):
+    params_class = DSParams
+
+    def read_training(self, ctx):
+        props = ctx.event_store().aggregate_properties(
+            self.params.app_name, self.params.entity_type, required=["words"]
+        )
+        return {
+            entity_id: tuple(pm.get("words", list))
+            for entity_id, pm in sorted(props.items())
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgoParams(Params):
+    pass
+
+
+@dataclasses.dataclass
+class SimilarityModel:
+    entities: list
+    vectors: np.ndarray  # (n, vocab) L2-normalised
+
+
+class CosineAlgorithm(HostModelAlgorithm):
+    params_class = AlgoParams
+    query_class = Query
+
+    def train(self, ctx, td: dict) -> SimilarityModel:
+        import jax.numpy as jnp
+
+        vocab = sorted({w for words in td.values() for w in words})
+        w_ix = {w: i for i, w in enumerate(vocab)}
+        entities = list(td)
+        mat = np.zeros((len(entities), max(len(vocab), 1)), np.float32)
+        for r, e in enumerate(entities):
+            for w in td[e]:
+                mat[r, w_ix[w]] = 1.0
+        norm = np.linalg.norm(mat, axis=1, keepdims=True)
+        mat = mat / np.maximum(norm, 1e-9)
+        return SimilarityModel(entities=entities, vectors=np.asarray(mat))
+
+    def predict(self, model: SimilarityModel, query: Query) -> PredictedResult:
+        import jax
+        import jax.numpy as jnp
+
+        if query.entity not in model.entities:
+            return PredictedResult()
+        row = model.entities.index(query.entity)
+        vecs = jnp.asarray(model.vectors)
+        sims = vecs @ vecs[row]                    # one jitted matmul
+        sims = sims.at[row].set(-1.0)              # exclude self
+        k = min(query.num, len(model.entities) - 1)
+        vals, idxs = jax.lax.top_k(sims, k)
+        return PredictedResult(neighbors=tuple(
+            Neighbor(entity=model.entities[int(i)], score=float(v))
+            for v, i in zip(vals, idxs) if v > -1.0
+        ))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class_map=WordsDataSource,
+        preparator_class_map=IdentityPreparator,
+        algorithm_class_map={"cosine": CosineAlgorithm},
+        serving_class_map=FirstServing,
+    )

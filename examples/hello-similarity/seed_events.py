@@ -1,0 +1,23 @@
+"""Seed $set events for the hello-similarity example."""
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.registry import Storage
+
+DOCS = {
+    "doc1": ["jax", "tpu", "mesh", "sharding"],
+    "doc2": ["jax", "tpu", "pallas", "kernel"],
+    "doc3": ["http", "rest", "server", "events"],
+    "doc4": ["mesh", "sharding", "collective", "tpu"],
+}
+
+storage = Storage.default()
+app = storage.get_meta_data_apps().get_by_name("HelloApp")
+events = storage.get_events()
+for doc, words in DOCS.items():
+    events.insert(
+        Event(event="$set", entity_type="doc", entity_id=doc,
+              properties=DataMap({"words": words})),
+        app.id,
+    )
+print(f"seeded {len(DOCS)} docs into app {app.id}")

@@ -148,6 +148,10 @@ class EngineServerPluginContext:
         }
 
 
+class _HtmlPage(str):
+    """Marker: payload is a rendered HTML page, not JSON."""
+
+
 class _Reject(Exception):
     def __init__(self, status: int, message: str):
         self.status = status
@@ -192,6 +196,8 @@ class EngineService:
     ) -> tuple[int, Any]:
         try:
             if method == "GET" and path == "/":
+                if "text/html" in headers.get("accept", ""):
+                    return (200, _HtmlPage(self.status_html()))
                 return (200, self.status_doc())
             if method == "POST" and path == "/queries.json":
                 return self.handle_query(body)
@@ -232,6 +238,25 @@ class EngineService:
             "avgServingSec": d.avg_serving_sec,
             "lastServingSec": d.last_serving_sec,
         }
+
+    def status_html(self) -> str:
+        """Browser-facing status page — the Twirl html.index render of the
+        reference engine server (core/src/main/twirl/.../index.scala.html,
+        served at CreateServer.scala:442-469)."""
+        import html
+
+        doc = self.status_doc()
+        rows = "".join(
+            f"<tr><th>{html.escape(str(k))}</th>"
+            f"<td>{html.escape(str(v))}</td></tr>"
+            for k, v in doc.items()
+        )
+        return (
+            "<!DOCTYPE html><html><head><title>predictionio_tpu engine "
+            f"server</title></head><body><h1>Engine instance "
+            f"{html.escape(str(doc['engineInstanceId']))}</h1>"
+            f"<table>{rows}</table></body></html>"
+        )
 
     def handle_query(self, body: Any) -> tuple[int, Any]:
         """POST /queries.json (CreateServer.scala:470-621)."""
@@ -346,15 +371,22 @@ class _Handler(BaseHTTPRequestHandler):
                 except json.JSONDecodeError:
                     self._respond(400, {"message": "the request body is not valid JSON"})
                     return
+        # header names are case-insensitive (RFC 9110); normalise once
+        headers = {k.lower(): v for k, v in self.headers.items()}
         status, payload = self.service.handle(
-            method, path, self._params(), dict(self.headers.items()), body
+            method, path, self._params(), headers, body
         )
         self._respond(status, payload)
 
     def _respond(self, status: int, payload: Any) -> None:
-        data = json.dumps(payload).encode()
+        if isinstance(payload, _HtmlPage):
+            data = str(payload).encode()
+            ctype = "text/html; charset=UTF-8"
+        else:
+            data = json.dumps(payload).encode()
+            ctype = "application/json; charset=UTF-8"
         self.send_response(status)
-        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)

@@ -146,13 +146,15 @@ class ALSModel:
         from predictionio_tpu.utils.checkpoint import save_sharded
 
         os.makedirs(directory, exist_ok=True)
-        legacy = os.path.join(directory, "factors.npz")
-        if os.path.exists(legacy):
-            os.remove(legacy)  # a stale legacy file would shadow this save
         save_sharded(directory, {
             "user": self.user_factors,
             "item": self.item_factors,
         })
+        # only after the new checkpoint is fully written: drop a legacy
+        # factors.npz so the directory holds a single source of truth
+        legacy = os.path.join(directory, "factors.npz")
+        if os.path.exists(legacy):
+            os.remove(legacy)
         meta = {
             "rank": self.rank,
             "user_ids": self.user_ids.id_to_ix.to_dict(),

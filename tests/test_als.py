@@ -284,3 +284,19 @@ class TestNativeBucketizer:
         coo = RatingsCOO(np.zeros(0, np.int32), np.zeros(0, np.int32),
                          np.zeros(0, np.float32), 4, 4)
         assert bucket_rows(coo).buckets == ()
+
+
+def test_bf16_matmul_close_to_f32():
+    """als_train(matmul_dtype="bfloat16"): native-MXU-rate normal
+    equations; factor quality must stay within tolerance of f32."""
+    rng = np.random.default_rng(7)
+    nnz = 20_000
+    coo = RatingsCOO(
+        (300 * rng.random(nnz) ** 1.4).astype(np.int32),
+        (200 * rng.random(nnz) ** 1.4).astype(np.int32),
+        (rng.integers(1, 11, nnz) / 2).astype(np.float32), 300, 200,
+    )
+    f32 = als_train(coo, rank=8, iterations=6, lam=0.05, seed=3)
+    bf = als_train(coo, rank=8, iterations=6, lam=0.05, seed=3,
+                   matmul_dtype="bfloat16")
+    assert abs(rmse(f32, coo) - rmse(bf, coo)) < 0.02

@@ -151,6 +151,21 @@ class TestEngineServerRoutes:
         assert doc["algorithms"] == ["SampleAlgorithm"]
         assert doc["requestCount"] == 0
 
+    def test_status_html_negotiation(self, server):
+        """Browsers get the HTML index page (Twirl index parity,
+        CreateServer.scala:442-469); API clients keep JSON."""
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/",
+            headers={"Accept": "text/html,application/xhtml+xml"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.headers["Content-Type"].startswith("text/html")
+            page = r.read().decode()
+        assert "<html>" in page and "Engine instance" in page
+        assert "SampleAlgorithm" in page
+
     def test_query(self, server):
         status, result = _post(
             f"http://127.0.0.1:{server.port}/queries.json", {"x": 3}
