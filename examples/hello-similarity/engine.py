@@ -66,6 +66,15 @@ class AlgoParams(Params):
 class SimilarityModel:
     entities: list
     vectors: np.ndarray  # (n, vocab) L2-normalised
+    # device-resident copy, populated on first predict and dropped from
+    # pickles (the framework's device-weight-cache practice)
+    _device_vectors: object = dataclasses.field(default=None, repr=False,
+                                                compare=False)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_device_vectors"] = None
+        return state
 
 
 class CosineAlgorithm(HostModelAlgorithm):
@@ -73,8 +82,6 @@ class CosineAlgorithm(HostModelAlgorithm):
     query_class = Query
 
     def train(self, ctx, td: dict) -> SimilarityModel:
-        import jax.numpy as jnp
-
         vocab = sorted({w for words in td.values() for w in words})
         w_ix = {w: i for i, w in enumerate(vocab)}
         entities = list(td)
@@ -92,11 +99,15 @@ class CosineAlgorithm(HostModelAlgorithm):
 
         if query.entity not in model.entities:
             return PredictedResult()
+        if model._device_vectors is None:
+            model._device_vectors = jax.device_put(model.vectors)
         row = model.entities.index(query.entity)
-        vecs = jnp.asarray(model.vectors)
-        sims = vecs @ vecs[row]                    # one jitted matmul
-        sims = sims.at[row].set(-1.0)              # exclude self
-        k = min(query.num, len(model.entities) - 1)
+        vecs = model._device_vectors              # HBM-resident between queries
+        sims = vecs @ vecs[row]
+        sims = sims.at[row].set(-1.0)             # exclude self
+        k = max(0, min(query.num, len(model.entities) - 1))
+        if k == 0:
+            return PredictedResult()
         vals, idxs = jax.lax.top_k(sims, k)
         return PredictedResult(neighbors=tuple(
             Neighbor(entity=model.entities[int(i)], score=float(v))

@@ -13,6 +13,8 @@ DOCS = {
 
 storage = Storage.default()
 app = storage.get_meta_data_apps().get_by_name("HelloApp")
+if app is None:
+    raise SystemExit("app 'HelloApp' not found — run: pio app new HelloApp")
 events = storage.get_events()
 for doc, words in DOCS.items():
     events.insert(

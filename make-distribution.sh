@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Build a relocatable distribution tarball (role of the reference's
+# make-distribution.sh): package + bin + conf + docs, versioned from
+# pyproject.toml. Result: dist/predictionio_tpu-<ver>.tar.gz
+set -euo pipefail
+cd "$(dirname "$0")"
+VER=$(python3 -c "
+import tomllib
+print(tomllib.load(open('pyproject.toml','rb'))['project']['version'])")
+NAME="predictionio_tpu-${VER}"
+STAGE="dist/${NAME}"
+rm -rf "$STAGE" && mkdir -p "$STAGE"
+cp -r predictionio_tpu bin conf docs pyproject.toml README.md "$STAGE/"
+find "$STAGE" -name '__pycache__' -type d -exec rm -rf {} + 2>/dev/null || true
+find "$STAGE" -name '*.so' -delete   # natives rebuild on first use
+tar -C dist -czf "dist/${NAME}.tar.gz" "$NAME"
+rm -rf "$STAGE"
+echo "dist/${NAME}.tar.gz"
+tar -tzf "dist/${NAME}.tar.gz" | head -5

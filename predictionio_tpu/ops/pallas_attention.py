@@ -1,0 +1,173 @@
+"""Pallas TPU kernel: flash attention (reference implementation).
+
+Tile-streamed causal attention with the standard flash online softmax:
+for each query tile, K/V tiles stream through the MXU and a running
+(max, denominator, numerator) carry folds each tile — the S x S logits
+matrix never exists in HBM.
+
+**Disabled by default, on measurement.** XLA:TPU already emits a fused
+flash-style attention for ops/attention.full_attention — measured on
+one v5e-class chip (bf16, B=2-4, H=4, D=64): XLA 2.3 ms at S=16384 (≈
+roofline) vs 34.8 ms for this kernel (in-kernel fori over K/V tiles
+pipelines poorly, and small head dims underfill the MXU). Per the
+framework's design rule — don't hand-schedule what the compiler already
+does — auto-dispatch is OFF and every production path
+(models/seqrec, ops/attention.ring_attention local blocks) uses the XLA
+formulation. The kernel stays as a correct, tested baseline for
+backends without the XLA attention fusion and as the starting point for
+future tile-level tuning; opt in with ``force=True``.
+
+Forward-only: no VJP (training always takes the XLA path). Interpret
+mode covers CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from predictionio_tpu.ops.attention import full_attention
+
+_TILE_Q = 128
+_TILE_K = 128
+_NEG = jnp.float32(-1e30)
+#: auto-dispatch is disabled (see module docstring): XLA's fused
+#: attention beat this kernel at every measured shape, so it only runs
+#: when explicitly forced
+_MIN_SEQ = None
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, causal: bool,
+                  seq_len: int, tile_k: int):
+    """Grid: (batch*heads, seq_len // TILE_Q). Blocks:
+    q (TILE_Q, D), k/v (seq_len, D) resident per bh, mask (1, seq_len),
+    o (TILE_Q, D)."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                    # (TQ, D)
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    tq = q.shape[0]
+    q_pos = qi * tq + jax.lax.iota(jnp.int32, tq)       # global query rows
+
+    n_kv = seq_len // tile_k
+
+    def body(t, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(t * tile_k, tile_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(t * tile_k, tile_k), :].astype(jnp.float32)
+        msk = mask_ref[0, pl.ds(t * tile_k, tile_k)]    # (TK,)
+        logits = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                        # (TQ, TK)
+        k_pos = t * tile_k + jax.lax.iota(jnp.int32, tile_k)
+        valid = msk[None, :] > 0
+        if causal:
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        logits = jnp.where(valid, logits, _NEG)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        seen = m_new > _NEG / 2
+        alpha = jnp.where(seen, jnp.exp(m - m_new), 0.0)
+        p = jnp.exp(logits - m_new[:, None])
+        p = jnp.where(valid & seen[:, None], p, 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((tq,), _NEG, dtype=jnp.float32)
+    l0 = jnp.zeros((tq,), dtype=jnp.float32)
+    a0 = jnp.zeros((tq, d), dtype=jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-20)[:, None]
+    out = jnp.where((l > 0)[:, None], out, 0.0)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+@partial(jax.jit, static_argnames=("causal", "interpret"))
+def _flash_call(q, k, v, kv_mask, causal: bool, interpret: bool):
+    B, H, S, D = q.shape
+    bh = B * H
+    qf = q.reshape(bh, S, D)
+    kf = k.reshape(bh, S, D)
+    vf = v.reshape(bh, S, D)
+    maskf = jnp.repeat(kv_mask.astype(jnp.float32), H, axis=0)  # (bh, S)
+    tile_q = min(_TILE_Q, S)
+    tile_k = min(_TILE_K, S)
+    grid = (bh, S // tile_q)
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, seq_len=S, tile_k=tile_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S), lambda b, i: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tile_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, S, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, maskf)
+    return out.reshape(B, H, S, D)
+
+
+@functools.cache
+def _mode() -> str:
+    """'compiled' on a TPU backend, 'interpret' elsewhere, 'off' when
+    pallas is unusable."""
+    try:
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return "off"
+    return "compiled" if on_tpu else "interpret"
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    kv_mask: jax.Array | None = None,
+    force: bool = False,
+) -> jax.Array:
+    """Streaming-tile attention for the serving path.
+
+    The pallas kernel runs only with ``force=True`` (see module
+    docstring — XLA's fused attention wins at every measured shape);
+    otherwise this is exactly ops/attention.full_attention. Forward-only
+    — do not call under jax.grad.
+    """
+    B, H, S, D = q.shape
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, S), dtype=jnp.float32)
+    mode = _mode()
+    eligible = (
+        mode != "off"
+        and force  # auto-dispatch disabled: XLA wins at measured shapes
+        and S % min(_TILE_Q, S) == 0
+    )
+    if not eligible:
+        return full_attention(q, k, v, causal=causal, kv_mask=kv_mask)
+    try:
+        return _flash_call(q, k, v, kv_mask, causal, mode == "interpret")
+    except Exception:
+        if force:
+            raise  # the caller asked for the kernel; surface the failure
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "pallas flash_attention failed to build; using XLA path",
+            exc_info=True,
+        )
+        return full_attention(q, k, v, causal=causal, kv_mask=kv_mask)

@@ -100,3 +100,31 @@ class TestRingAttention:
         g_full = jax.grad(loss_full)(q, k, v)
         np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_full),
                                    atol=1e-4, rtol=1e-4)
+
+
+class TestPallasFlashAttention:
+    """Reference pallas kernel (off by default — ops/pallas_attention
+    docstring records the measurements; force=True exercises it)."""
+
+    def test_matches_full_attention(self):
+        from predictionio_tpu.ops.pallas_attention import flash_attention
+
+        q, k, v = _qkv(6)
+        kv_mask = np.ones((B, S), dtype=np.float32)
+        kv_mask[0, 50:] = 0.0
+        kv_mask = jnp.asarray(kv_mask)
+        for causal in (True, False):
+            exp = full_attention(q, k, v, causal=causal, kv_mask=kv_mask)
+            got = flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
+                                  force=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_default_path_is_xla(self):
+        from predictionio_tpu.ops import pallas_attention
+
+        q, k, v = _qkv(7)
+        got = pallas_attention.flash_attention(q, k, v, causal=True)
+        exp = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                   atol=1e-6, rtol=1e-6)
