@@ -36,7 +36,7 @@ from predictionio_tpu.ops.attention import full_attention
 
 _TILE_Q = 128
 _TILE_K = 128
-_NEG = jnp.float32(-1e30)
+_NEG = -1e30  # python float: jnp scalars would be captured consts in the kernel
 #: auto-dispatch is disabled (see module docstring): XLA's fused
 #: attention beat this kernel at every measured shape, so it only runs
 #: when explicitly forced
