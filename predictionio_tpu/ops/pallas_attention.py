@@ -61,7 +61,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, causal: bool,
         m, l, acc = carry
         k_blk = k_ref[0, pl.ds(t * tile_k, tile_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(t * tile_k, tile_k), :].astype(jnp.float32)
-        msk = mask_ref[0, pl.ds(t * tile_k, tile_k)]    # (TK,)
+        msk = mask_ref[0, 0, pl.ds(t * tile_k, tile_k)]  # (TK,)
         logits = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -99,7 +99,10 @@ def _flash_call(q, k, v, kv_mask, causal: bool, interpret: bool):
     qf = q.reshape(bh, S, D)
     kf = k.reshape(bh, S, D)
     vf = v.reshape(bh, S, D)
-    maskf = jnp.repeat(kv_mask.astype(jnp.float32), H, axis=0)  # (bh, S)
+    # (bh, 1, S): the singleton keeps the block's trailing dims equal to
+    # the array's (TPU lowering requires trailing block dims divisible by
+    # (8, 128) or exactly equal)
+    maskf = jnp.repeat(kv_mask.astype(jnp.float32), H, axis=0)[:, None, :]
     tile_q = min(_TILE_Q, S)
     tile_k = min(_TILE_K, S)
     grid = (bh, S // tile_q)
@@ -112,7 +115,7 @@ def _flash_call(q, k, v, kv_mask, causal: bool, interpret: bool):
             pl.BlockSpec((1, tile_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, tile_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, S, D), q.dtype),
