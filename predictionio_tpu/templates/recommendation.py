@@ -23,6 +23,11 @@ from typing import Sequence
 import numpy as np
 
 from predictionio_tpu.controller import (
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    OptionAverageMetric,
     DataSource,
     Engine,
     FirstServing,
@@ -343,3 +348,66 @@ def engine_factory() -> Engine:
         algorithm_class_map={"als": ALSAlgorithm, "": ALSAlgorithm},
         serving_class_map=FirstServing,
     )
+
+
+# ---------------------------------------------------------------------------
+# Evaluation: Precision@K + params grid (reference: the recommendation
+# template's Evaluation.scala — PrecisionAtK OptionAverageMetric and the
+# rank x numIterations EngineParamsList; tests/pio_tests/engines/
+# recommendation-engine/src/main/scala/Evaluation.scala)
+# ---------------------------------------------------------------------------
+
+
+class PrecisionAtK(OptionAverageMetric):
+    """Fraction of the top-k recommendations that are in the user's
+    held-out item set (read_eval's answer is the tuple of test-fold
+    items). Returns None (excluded from the average) for users with no
+    held-out items — the reference's OptionAverageMetric contract."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"Precision@{self.k}"
+
+    def calculate_qpa(self, q, p, a) -> float | None:
+        relevant = set(a)
+        if not relevant:
+            return None
+        top = [s.item for s in p.item_scores[: self.k]]
+        if not top:
+            return 0.0
+        hits = sum(1 for item in top if item in relevant)
+        # reference parity: tpCount / min(k, |relevant|) (Evaluation.scala)
+        return hits / min(self.k, len(relevant))
+
+
+class RecommendationEvaluation(Evaluation):
+    """`pio eval predictionio_tpu.templates.recommendation.RecommendationEvaluation
+    predictionio_tpu.templates.recommendation.DefaultParamsList`"""
+
+    def __init__(self, k: int = 10, output_path: str | None = "best.json"):
+        super().__init__()
+        self.engine_evaluator = (
+            engine_factory(),
+            MetricEvaluator(PrecisionAtK(k=k), output_path=output_path),
+        )
+
+
+class DefaultParamsList(EngineParamsGenerator):
+    """rank x iterations grid like the reference's EngineParamsList."""
+
+    def __init__(self, app_name: str = "RecApp", eval_k: int = 2):
+        super().__init__([
+            EngineParams.of(
+                data_source=DataSourceParams(app_name=app_name, eval_k=eval_k),
+                algorithms=[(
+                    "als",
+                    ALSAlgorithmParams(rank=rank, num_iterations=it,
+                                       lambda_=0.05, seed=3),
+                )],
+            )
+            for rank in (8, 16)
+            for it in (5, 10)
+        ])

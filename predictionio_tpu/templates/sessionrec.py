@@ -33,9 +33,14 @@ import numpy as np
 from predictionio_tpu.controller import (
     DataSource,
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
     HostModelAlgorithm,
     IdentityPreparator,
+    MetricEvaluator,
+    OptionAverageMetric,
     Params,
     SanityCheck,
 )
@@ -152,6 +157,7 @@ class AlgorithmParams(Params):
     lr: float = 1e-3
     seed: int = 0
     use_mesh: bool = True
+    remat: bool = False  # jax.checkpoint each block (long-context memory)
 
 
 @dataclasses.dataclass
@@ -191,6 +197,7 @@ class SeqRecAlgorithm(HostModelAlgorithm):
             d_model=p.d_model,
             n_heads=p.n_heads,
             n_layers=p.n_layers,
+            remat=p.remat,
         )
         mesh = ctx.mesh_if_parallel if p.use_mesh else None
         if mesh is not None and "seq" in mesh.shape and \
@@ -277,3 +284,56 @@ def engine_factory() -> Engine:
         algorithm_class_map={"seqrec": SeqRecAlgorithm},
         serving_class_map=FirstServing,
     )
+
+
+# ---------------------------------------------------------------------------
+# Evaluation: HitRate@K over leave-one-out folds (the standard
+# sequential-recommendation protocol; read_eval holds out each user's
+# final item). Role of the per-template Evaluation.scala in the
+# reference template families.
+# ---------------------------------------------------------------------------
+
+
+class HitRateAtK(OptionAverageMetric):
+    """1.0 when the held-out next item appears in the top-k, else 0."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"HitRate@{self.k}"
+
+    def calculate_qpa(self, q, p, a) -> float | None:
+        # the held-out item always exists, so an empty prediction is a
+        # miss (0.0), never a skip — None would inflate the average
+        top = [s.item for s in p.item_scores[: self.k]]
+        return 1.0 if a in top else 0.0
+
+
+class SessionRecEvaluation(Evaluation):
+    """`pio eval predictionio_tpu.templates.sessionrec.SessionRecEvaluation
+    predictionio_tpu.templates.sessionrec.DefaultParamsList`"""
+
+    def __init__(self, k: int = 10, output_path: str | None = "best.json"):
+        super().__init__()
+        self.engine_evaluator = (
+            engine_factory(),
+            MetricEvaluator(HitRateAtK(k=k), output_path=output_path),
+        )
+
+
+class DefaultParamsList(EngineParamsGenerator):
+    def __init__(self, app_name: str = "SessApp", eval_k: int = 2):
+        super().__init__([
+            EngineParams.of(
+                data_source=DataSourceParams(app_name=app_name, eval_k=eval_k),
+                algorithms=[(
+                    "seqrec",
+                    AlgorithmParams(d_model=d, n_layers=layers, max_len=32,
+                                    epochs=15, batch_size=32, lr=3e-3),
+                )],
+            )
+            for d in (32, 64)
+            for layers in (1, 2)
+        ])

@@ -290,3 +290,35 @@ class TestECommerce:
         # no history at all -> empty
         r2 = algo.predict(model, Query(user="ghost", num=4))
         assert r2.item_scores == ()
+
+
+class TestTemplateEvaluations:
+    """The per-template Evaluation classes (role of the reference
+    templates' Evaluation.scala) run through the real eval workflow."""
+
+    def test_recommendation_precision_eval(self, storage, tmp_path):
+        from predictionio_tpu.controller import EngineParams, EngineParamsGenerator
+        from predictionio_tpu.templates.recommendation import (
+            ALSAlgorithmParams,
+            DataSourceParams,
+            RecommendationEvaluation,
+        )
+        from predictionio_tpu.workflow.evaluation import run_evaluation
+
+        generator = EngineParamsGenerator([
+            EngineParams.of(
+                data_source=DataSourceParams(app_name="RecApp", eval_k=2),
+                algorithms=[("als", ALSAlgorithmParams(
+                    rank=rank, num_iterations=6, lambda_=0.05, seed=3))],
+            )
+            for rank in (4, 8)
+        ])
+        outcome = run_evaluation(
+            RecommendationEvaluation(k=4, output_path=str(tmp_path / "best.json")),
+            generator, storage=storage)
+        result = outcome.result
+        # even/odd taste clusters are trivially learnable: the best grid
+        # point must beat random (8 of 16 items relevant -> ~0.5)
+        assert result.best_score.score > 0.5
+        assert "Precision@4" in result.metric_header
+        assert len(result.engine_params_scores) == 2

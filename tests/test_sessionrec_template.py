@@ -143,7 +143,7 @@ class TestSessionRec:
         td = SessionDataSource(DataSourceParams(app_name="SessApp")).read_training(ctx)
         algo = SeqRecAlgorithm(AlgorithmParams(
             d_model=32, n_layers=1, n_heads=2, max_len=16, epochs=2,
-            batch_size=16,
+            batch_size=16, remat=True,
         ))
         model = algo.train(ctx, td)
         assert model.params["item_emb"].shape[0] == CYCLE + 1
@@ -166,3 +166,32 @@ class TestSessionRec:
         algo = SeqRecAlgorithm(AlgorithmParams(max_len=16, epochs=1))
         with pytest.raises(ValueError, match="multiple of the seq"):
             algo.train(ctx, td)
+
+
+class TestSessionRecEvaluation:
+    def test_hit_rate_eval(self, storage, tmp_path):
+        from predictionio_tpu.controller import EngineParams, EngineParamsGenerator
+        from predictionio_tpu.templates.sessionrec import (
+            AlgorithmParams,
+            DataSourceParams,
+            SessionRecEvaluation,
+        )
+        from predictionio_tpu.workflow.evaluation import run_evaluation
+
+        generator = EngineParamsGenerator([
+            EngineParams.of(
+                data_source=DataSourceParams(app_name="SessApp", eval_k=2),
+                algorithms=[("seqrec", AlgorithmParams(
+                    d_model=32, n_layers=1, n_heads=2, max_len=16,
+                    epochs=15, batch_size=16, lr=3e-3))],
+            )
+        ])
+        outcome = run_evaluation(
+            SessionRecEvaluation(k=3, output_path=str(tmp_path / "best.json")),
+            generator, storage=storage)
+        assert (tmp_path / "best.json").exists()
+        result = outcome.result
+        # the deterministic item cycle makes next-item prediction easy:
+        # hit rate must be far above the 3/10 random baseline
+        assert result.best_score.score > 0.5
+        assert "HitRate@3" in result.metric_header
