@@ -236,8 +236,17 @@ def train(
     lr: float = 1e-3,
     seed: int = 0,
     mesh: Mesh | None = None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
 ) -> dict:
-    """Full training loop over dense-indexed item sequences (ids >= 1)."""
+    """Full training loop over dense-indexed item sequences (ids >= 1).
+
+    Mid-training checkpoint/resume (beyond the reference, whose
+    persistence is model-level only — SURVEY.md §5): with
+    ``checkpoint_dir`` + ``checkpoint_every`` N, the full training state
+    (params, Adam moments, epoch counter) is written atomically every N
+    epochs, and a later call with the same dir/config resumes from the
+    last completed checkpoint instead of epoch 0."""
     inputs, targets = pad_sequences(sequences, cfg.max_len)
     n = inputs.shape[0]
     # static batch shape: pad the set so every step uses the same compile
@@ -257,11 +266,25 @@ def train(
     params = init_params(key, cfg)
     opt_m = jax.tree.map(jnp.zeros_like, params)
     opt_v = jax.tree.map(jnp.zeros_like, params)
+    start_epoch, it = 0, 0
+    if checkpoint_dir:
+        resumed = _load_train_state(checkpoint_dir, params)
+        if resumed is not None:
+            params, opt_m, opt_v, start_epoch, it = resumed
+            logger.info("seqrec: resumed from %s at epoch %d",
+                        checkpoint_dir, start_epoch)
+            if start_epoch >= epochs:
+                logger.warning(
+                    "seqrec: checkpoint already at epoch %d >= requested "
+                    "epochs %d — returning checkpointed weights with no "
+                    "further training", start_epoch, epochs)
     step = make_train_step(cfg, mesh)
 
     rng = np.random.default_rng(seed)
-    it = 0
     for epoch in range(epochs):
+        if epoch < start_epoch:
+            rng.permutation(n)  # keep the data order stream aligned
+            continue
         order = rng.permutation(n)
         losses = []
         for s in range(0, n, bs):
@@ -276,10 +299,97 @@ def train(
         if epoch == 0 or (epoch + 1) % 5 == 0:
             logger.info("seqrec epoch %d loss %.4f", epoch + 1,
                         float(jnp.mean(jnp.stack(losses))))
+        if checkpoint_dir and checkpoint_every and \
+                (epoch + 1) % checkpoint_every == 0:
+            _save_train_state(checkpoint_dir, params, opt_m, opt_v,
+                              epoch + 1, it)
     return params
 
 
+# ---------------------------------------------------------------------------
+# Mid-training checkpoint state (atomic flat-npz; resume-safe)
+# ---------------------------------------------------------------------------
+
+
+def _flat_paths(tree) -> dict:
+    import jax.tree_util as jtu
+
+    leaves = jtu.tree_flatten_with_path(tree)[0]
+    return {jtu.keystr(path): leaf for path, leaf in leaves}
+
+
+def _save_train_state(directory, params, opt_m, opt_v, epoch, it) -> None:
+    import os as _os
+
+    _os.makedirs(directory, exist_ok=True)
+    arrays = {"__epoch__": np.int64(epoch), "__it__": np.int64(it)}
+    for prefix, tree in (("p", params), ("m", opt_m), ("v", opt_v)):
+        for path, leaf in _flat_paths(tree).items():
+            arrays[f"{prefix}{path}"] = np.asarray(leaf)
+    tmp = _os.path.join(directory, ".train_state.npz.tmp")
+    final = _os.path.join(directory, "train_state.npz")
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    # ONE atomic replace covers params+moments+epoch counter together;
+    # a crash can never leave weights and epoch out of step
+    _os.replace(tmp, final)
+
+
+def _load_train_state(directory, template_params):
+    """(params, opt_m, opt_v, epoch, it) or None when absent/mismatched."""
+    import os as _os
+
+    state_path = _os.path.join(directory, "train_state.npz")
+    if not _os.path.exists(state_path):
+        return None
+    data = np.load(state_path)
+    paths = _flat_paths(template_params)
+    try:
+        import jax.tree_util as jtu
+
+        # key paths AND shapes must match the template — a checkpoint
+        # from a different d_model/vocab/max_len starts fresh
+        for p, leaf in paths.items():
+            if data[f"p{p}"].shape != np.shape(leaf):
+                raise KeyError(p)
+
+        def rebuild(prefix):
+            flat = {p: jnp.asarray(data[f"{prefix}{p}"]) for p in paths}
+            leaves_paths = jtu.tree_flatten_with_path(template_params)[0]
+            treedef = jtu.tree_structure(template_params)
+            return jtu.tree_unflatten(
+                treedef, [flat[jtu.keystr(p)] for p, _ in leaves_paths])
+
+        params = rebuild("p")
+        opt_m = rebuild("m")
+        opt_v = rebuild("v")
+        epoch = int(data["__epoch__"])
+        it = int(data["__it__"])
+    except KeyError:
+        logger.warning("seqrec: checkpoint at %s does not match the model "
+                       "config; starting fresh", directory)
+        return None
+    return params, opt_m, opt_v, epoch, it
+
+
 @partial(jax.jit, static_argnames=("k", "cfg"))
+def predict_topk_batch(
+    params: Mapping, history: jax.Array, k: int, cfg: SeqRecConfig,
+    vocab_masks: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Like :func:`predict_topk` but with a per-query additive logit mask
+    ``vocab_masks`` (B, V) — the batched eval path, where each query
+    carries its own seen/black-list exclusions."""
+    mask = (history != PAD)
+    last = jnp.maximum(jnp.sum(mask, axis=1) - 1, 0)
+    h = forward(params, history, cfg)
+    hl = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
+    logits = jnp.einsum("bd,vd->bv", hl, params["item_emb"].astype(h.dtype),
+                        preferred_element_type=jnp.float32)
+    logits = logits + vocab_masks
+    return jax.lax.top_k(logits, k)
+
+
 def predict_topk(
     params: Mapping, history: jax.Array, k: int, cfg: SeqRecConfig,
     vocab_mask: jax.Array
@@ -287,13 +397,6 @@ def predict_topk(
     """Top-k next items for (B, S) histories (the serving hot path; one
     compile per (shape, k, cfg)). ``vocab_mask`` (V,) f32 is added to
     the logits — 0 for allowed ids, a large negative for pad/seen/
-    disallowed ids."""
-    # hidden state at the last real position of each history
-    mask = (history != PAD)
-    last = jnp.maximum(jnp.sum(mask, axis=1) - 1, 0)   # (B,)
-    h = forward(params, history, cfg)
-    hl = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]  # (B, D)
-    logits = jnp.einsum("bd,vd->bv", hl, params["item_emb"].astype(h.dtype),
-                        preferred_element_type=jnp.float32)
-    logits = logits + vocab_mask[None, :]
-    return jax.lax.top_k(logits, k)
+    disallowed ids. Thin wrapper over :func:`predict_topk_batch` (the
+    (1, V) mask broadcasts), so both paths share one kernel."""
+    return predict_topk_batch(params, history, k, cfg, vocab_mask[None, :])

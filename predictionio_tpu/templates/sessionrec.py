@@ -158,6 +158,10 @@ class AlgorithmParams(Params):
     seed: int = 0
     use_mesh: bool = True
     remat: bool = False  # jax.checkpoint each block (long-context memory)
+    # mid-training checkpoint/resume (models/seqrec): state written every
+    # N epochs to checkpoint_dir; a re-run resumes from the last one
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0
 
 
 @dataclasses.dataclass
@@ -210,6 +214,8 @@ class SeqRecAlgorithm(HostModelAlgorithm):
             list(dense.values()), cfg,
             epochs=p.epochs, batch_size=p.batch_size, lr=p.lr,
             seed=p.seed, mesh=mesh,
+            checkpoint_dir=p.checkpoint_dir or None,
+            checkpoint_every=p.checkpoint_every,
         )
         import jax
 
@@ -232,37 +238,66 @@ class SeqRecAlgorithm(HostModelAlgorithm):
         return model.histories.get(query.user, [])
 
     def predict(self, model: SeqRecEngineModel, query: Query) -> PredictedResult:
+        # single-query serving is the B=1 case of the batched path —
+        # one mask/history implementation keeps the two in lockstep
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model: SeqRecEngineModel, queries):
+        """Batched eval path: power-of-two batch buckets through one
+        jitted forward (seqrec.predict_topk_batch with per-query masks)
+        instead of |queries| B=1 calls — the Engine.eval hot path."""
         import jax.numpy as jnp
 
-        history = self._history_for(model, query)
-        if not history:
-            return PredictedResult()
         S = model.cfg.max_len
-        hist = np.zeros((1, S), np.int32)
-        tail = history[-S:]
-        hist[0, : len(tail)] = tail
-        mask = np.zeros((model.cfg.vocab,), np.float32)
-        mask[seqrec.PAD] = _NEG
-        for dense_id in tail:                       # don't repeat the session
-            mask[dense_id] = _NEG
-        for item in query.black_list:
-            di = model.item_index.get(item)
-            if di is not None:
-                mask[di] = _NEG
-        k = min(query.num, model.cfg.vocab - 1)
-        scores, ids = seqrec.predict_topk(
-            _as_device_tree(model),
-            jnp.asarray(hist), k, model.cfg, jnp.asarray(mask),
-        )
-        inv = model.item_index.inverse
-        out = []
-        for s, i in zip(np.asarray(scores)[0], np.asarray(ids)[0]):
-            if s <= _NEG / 2:
+        base_mask = np.zeros((model.cfg.vocab,), np.float32)
+        base_mask[seqrec.PAD] = _NEG
+        prepared, out = [], []
+        for i, q in queries:
+            history = self._history_for(model, q)
+            if not history:
+                out.append((i, PredictedResult()))
                 continue
-            item = inv.get(int(i))
-            if item is not None:
-                out.append(ItemScore(item=item, score=float(s)))
-        return PredictedResult(item_scores=tuple(out))
+            tail = history[-S:]
+            hist = np.zeros((S,), np.int32)
+            hist[: len(tail)] = tail
+            mask = base_mask.copy()
+            for dense_id in tail:               # don't repeat the session
+                mask[dense_id] = _NEG
+            for item in q.black_list:
+                di = model.item_index.get(item)
+                if di is not None:
+                    mask[di] = _NEG
+            prepared.append((i, q, hist, mask))
+        if not prepared:
+            return out
+
+        k = min(max(q.num for _, q, _, _ in prepared), model.cfg.vocab - 1)
+        inv = model.item_index.inverse
+        pos = 0
+        while pos < len(prepared):
+            remaining = len(prepared) - pos
+            bucket = 1
+            while bucket * 2 <= min(remaining, 256):
+                bucket *= 2
+            chunk = prepared[pos : pos + bucket]
+            pos += bucket
+            scores, ids = seqrec.predict_topk_batch(
+                _as_device_tree(model),
+                jnp.asarray(np.stack([h for _, _, h, _ in chunk])),
+                k, model.cfg,
+                jnp.asarray(np.stack([m for _, _, _, m in chunk])),
+            )
+            for (i, q, _, _), svals, sids in zip(
+                    chunk, np.asarray(scores), np.asarray(ids)):
+                items = []
+                for v, ix in zip(svals[: q.num], sids[: q.num]):
+                    if v <= _NEG / 2:
+                        continue
+                    item = inv.get(int(ix))
+                    if item is not None:
+                        items.append(ItemScore(item=item, score=float(v)))
+                out.append((i, PredictedResult(item_scores=tuple(items))))
+        return out
 
 
 def _as_device_tree(model: SeqRecEngineModel):
@@ -337,3 +372,4 @@ class DefaultParamsList(EngineParamsGenerator):
             for d in (32, 64)
             for layers in (1, 2)
         ])
+

@@ -195,3 +195,49 @@ class TestSessionRecEvaluation:
         # hit rate must be far above the 3/10 random baseline
         assert result.best_score.score > 0.5
         assert "HitRate@3" in result.metric_header
+
+
+def test_batch_predict_matches_predict(storage, monkeypatch, tmp_path):
+    from predictionio_tpu.templates.sessionrec import Query
+
+    monkeypatch.setenv("PIO_MODEL_DIR", str(tmp_path))
+    outcome = run_train(variant=VARIANT, storage=storage)
+    algos, models, _ = _deploy(storage, outcome)
+    algo, model = algos[0], models[0]
+    queries = [
+        (0, Query(items=("i3", "i4", "i5"), num=3)),
+        (1, Query(user="u0", num=2)),
+        (2, Query(user="nobody", num=3)),        # empty result path
+        (3, Query(items=("i1", "i2"), num=3, black_list=("i3",))),
+    ]
+    batched = dict(algo.batch_predict(model, queries))
+    for i, q in queries:
+        single = algo.predict(model, q)
+        assert [s.item for s in batched[i].item_scores] == \
+            [s.item for s in single.item_scores], f"query {i}"
+
+
+def test_mid_training_checkpoint_resume(tmp_path):
+    """seqrec.train resumes exactly from the last epoch checkpoint
+    (beyond-reference: the reference has model-level persistence only)."""
+    import jax
+
+    from predictionio_tpu.models import seqrec
+
+    seqs = [[(s + t) % 9 + 1 for t in range(8)] for s in range(40)]
+    cfg = seqrec.SeqRecConfig(vocab=10, max_len=8, d_model=16, n_heads=2,
+                              n_layers=1)
+    full = seqrec.train(seqs, cfg, epochs=6, batch_size=8, seed=4)
+    d = str(tmp_path / "ckpt")
+    seqrec.train(seqs, cfg, epochs=3, batch_size=8, seed=4,
+                 checkpoint_dir=d, checkpoint_every=1)
+    resumed = seqrec.train(seqs, cfg, epochs=6, batch_size=8, seed=4,
+                           checkpoint_dir=d, checkpoint_every=1)
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(resumed)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # a mismatched config starts fresh instead of crashing
+    other = seqrec.SeqRecConfig(vocab=10, max_len=8, d_model=32, n_heads=2,
+                                n_layers=1)
+    seqrec.train(seqs, other, epochs=1, batch_size=8, seed=4,
+                 checkpoint_dir=d, checkpoint_every=0)
