@@ -267,8 +267,10 @@ def train(
     opt_m = jax.tree.map(jnp.zeros_like, params)
     opt_v = jax.tree.map(jnp.zeros_like, params)
     start_epoch, it = 0, 0
+    fingerprint = None
     if checkpoint_dir:
-        resumed = _load_train_state(checkpoint_dir, params)
+        fingerprint = _train_fingerprint(cfg, inputs, targets, lr, seed)
+        resumed = _load_train_state(checkpoint_dir, params, fingerprint)
         if resumed is not None:
             params, opt_m, opt_v, start_epoch, it = resumed
             logger.info("seqrec: resumed from %s at epoch %d",
@@ -302,7 +304,7 @@ def train(
         if checkpoint_dir and checkpoint_every and \
                 (epoch + 1) % checkpoint_every == 0:
             _save_train_state(checkpoint_dir, params, opt_m, opt_v,
-                              epoch + 1, it)
+                              epoch + 1, it, fingerprint)
     return params
 
 
@@ -318,11 +320,30 @@ def _flat_paths(tree) -> dict:
     return {jtu.keystr(path): leaf for path, leaf in leaves}
 
 
-def _save_train_state(directory, params, opt_m, opt_v, epoch, it) -> None:
+def _train_fingerprint(cfg, inputs, targets, lr, seed) -> str:
+    """Identity of a training run: config (incl. n_heads/remat, which leaf
+    shapes can't distinguish) + the exact dataset + lr/seed. A checkpoint
+    only resumes a run with the same fingerprint — a new fold split,
+    fresh events, or changed architecture starts fresh instead of
+    silently reusing stale weights."""
+    import hashlib
+
+    h = hashlib.sha1()
+    h.update(repr(dataclasses.asdict(cfg)).encode())
+    h.update(np.ascontiguousarray(inputs).tobytes())
+    h.update(np.ascontiguousarray(targets).tobytes())
+    h.update(np.float64(lr).tobytes())
+    h.update(np.int64(seed).tobytes())
+    return h.hexdigest()
+
+
+def _save_train_state(directory, params, opt_m, opt_v, epoch, it,
+                      fingerprint) -> None:
     import os as _os
 
     _os.makedirs(directory, exist_ok=True)
-    arrays = {"__epoch__": np.int64(epoch), "__it__": np.int64(it)}
+    arrays = {"__epoch__": np.int64(epoch), "__it__": np.int64(it),
+              "__fingerprint__": np.bytes_(fingerprint.encode())}
     for prefix, tree in (("p", params), ("m", opt_m), ("v", opt_v)):
         for path, leaf in _flat_paths(tree).items():
             arrays[f"{prefix}{path}"] = np.asarray(leaf)
@@ -335,7 +356,7 @@ def _save_train_state(directory, params, opt_m, opt_v, epoch, it) -> None:
     _os.replace(tmp, final)
 
 
-def _load_train_state(directory, template_params):
+def _load_train_state(directory, template_params, fingerprint):
     """(params, opt_m, opt_v, epoch, it) or None when absent/mismatched."""
     import os as _os
 
@@ -347,8 +368,11 @@ def _load_train_state(directory, template_params):
     try:
         import jax.tree_util as jtu
 
-        # key paths AND shapes must match the template — a checkpoint
-        # from a different d_model/vocab/max_len starts fresh
+        saved_fp = bytes(data["__fingerprint__"]).decode()
+        if saved_fp != fingerprint:
+            raise KeyError("__fingerprint__")
+        # key paths AND shapes must match the template — belt and braces
+        # on top of the fingerprint
         for p, leaf in paths.items():
             if data[f"p{p}"].shape != np.shape(leaf):
                 raise KeyError(p)
@@ -366,8 +390,9 @@ def _load_train_state(directory, template_params):
         epoch = int(data["__epoch__"])
         it = int(data["__it__"])
     except KeyError:
-        logger.warning("seqrec: checkpoint at %s does not match the model "
-                       "config; starting fresh", directory)
+        logger.warning("seqrec: checkpoint at %s is from a different "
+                       "run (config, dataset, lr, or seed changed); "
+                       "starting fresh", directory)
         return None
     return params, opt_m, opt_v, epoch, it
 

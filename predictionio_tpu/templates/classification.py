@@ -24,11 +24,16 @@ from typing import Sequence
 import numpy as np
 
 from predictionio_tpu.controller import (
+    AverageMetric,
     DataSource,
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
     HostModelAlgorithm,
     IdentityPreparator,
+    MetricEvaluator,
     Params,
     SanityCheck,
 )
@@ -201,3 +206,45 @@ def engine_factory() -> Engine:
         algorithm_class_map={"naive": NaiveBayesAlgorithm, "": NaiveBayesAlgorithm},
         serving_class_map=FirstServing,
     )
+
+
+# ---------------------------------------------------------------------------
+# Evaluation: Accuracy over k-fold splits (role of the reference
+# classification template's AccuracyEvaluation in
+# examples/scala-parallel-classification/.../Evaluation.scala)
+# ---------------------------------------------------------------------------
+
+
+class Accuracy(AverageMetric):
+    """1.0 when the predicted label equals the held-out label."""
+
+    def calculate_qpa(self, q, p, a) -> float:
+        return 1.0 if p.label == a else 0.0
+
+
+class ClassificationEvaluation(Evaluation):
+    """`pio eval predictionio_tpu.templates.classification.ClassificationEvaluation
+    predictionio_tpu.templates.classification.DefaultParamsList`"""
+
+    def __init__(self, output_path: str | None = "best.json"):
+        super().__init__()
+        self.engine_evaluator = (
+            engine_factory(),
+            MetricEvaluator(Accuracy(), output_path=output_path),
+        )
+
+
+class DefaultParamsList(EngineParamsGenerator):
+    """Smoothing grid like the reference's EngineParamsList."""
+
+    def __init__(self, app_name: str = "ClassApp", eval_k: int = 3,
+                 attrs: tuple = ("attr0", "attr1", "attr2"),
+                 label: str = "plan"):
+        super().__init__([
+            EngineParams.of(
+                data_source=DataSourceParams(app_name=app_name, attrs=attrs,
+                                             label=label, eval_k=eval_k),
+                algorithms=[("naive", AlgorithmParams(smoothing=s))],
+            )
+            for s in (0.5, 1.0, 2.0)
+        ])

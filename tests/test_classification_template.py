@@ -113,3 +113,23 @@ def test_empty_app_fails_sanity(storage_with_events):
     }
     with pytest.raises(ValueError, match="empty"):
         run_train(variant=variant, storage=storage)
+
+
+def test_accuracy_eval(storage_with_events, tmp_path):
+    from predictionio_tpu.templates.classification import (
+        ClassificationEvaluation,
+        DefaultParamsList,
+    )
+    from predictionio_tpu.workflow.evaluation import run_evaluation
+
+    outcome = run_evaluation(
+        ClassificationEvaluation(output_path=str(tmp_path / "best.json")),
+        DefaultParamsList(eval_k=2),
+        storage=storage_with_events,
+    )
+    result = outcome.result
+    # the fixture's classes are linearly separable; NB must beat chance
+    assert result.best_score.score > 0.6
+    assert "Accuracy" in result.metric_header
+    assert (tmp_path / "best.json").exists()
+    assert len(result.engine_params_scores) == 3
