@@ -249,6 +249,12 @@ def train(
     last completed checkpoint instead of epoch 0."""
     inputs, targets = pad_sequences(sequences, cfg.max_len)
     n = inputs.shape[0]
+    # checkpoint identity from the PRE-batch-padding arrays: resume must
+    # survive a batch_size or mesh-topology change after preemption
+    fingerprint = (
+        _train_fingerprint(cfg, inputs, targets, lr, seed)
+        if checkpoint_dir else None
+    )
     # static batch shape: pad the set so every step uses the same compile
     bs = min(batch_size, n)
     if mesh is not None:
@@ -267,9 +273,7 @@ def train(
     opt_m = jax.tree.map(jnp.zeros_like, params)
     opt_v = jax.tree.map(jnp.zeros_like, params)
     start_epoch, it = 0, 0
-    fingerprint = None
     if checkpoint_dir:
-        fingerprint = _train_fingerprint(cfg, inputs, targets, lr, seed)
         resumed = _load_train_state(checkpoint_dir, params, fingerprint)
         if resumed is not None:
             params, opt_m, opt_v, start_epoch, it = resumed

@@ -402,12 +402,20 @@ def solve_half(
     mesh: Mesh | None = None,
     max_slab_elems: int = 1 << 24,
     matmul_dtype: str = "float32",
+    shard_factors: bool = False,
 ) -> jax.Array:
     """One ALS half-step: solve all row factors given opposite factors V.
 
     Returns a (num_rows, K) factor table (replicated under ``mesh``);
     rows with no ratings get zero factors, matching MLlib which simply
     omits them from the factor RDD.
+
+    ``shard_factors=True`` (with a mesh that has a "model" axis) keeps
+    the opposite factor table V row-sharded over that axis — the
+    tensor-parallel layout for catalog-scale tables that exceed one
+    device's HBM. XLA inserts the gathers for the slab lookups over ICI;
+    with ``False`` (default) V is replicated, which is faster whenever
+    it fits.
 
     Pass a :class:`DeviceBucketedRatings` (from :func:`stage_buckets`)
     when calling repeatedly — a host ``BucketedRatings`` is streamed one
@@ -425,7 +433,18 @@ def solve_half(
     out = jnp.zeros((bucketed.num_rows, rank), dtype=V.dtype)
     if mesh is not None:
         rep = NamedSharding(mesh, P())
-        V = jax.device_put(V, rep)
+        if shard_factors and "model" in mesh.shape and \
+                int(mesh.shape["model"]) > 1:
+            axis = int(mesh.shape["model"])
+            pad = (-V.shape[0]) % axis
+            if pad:
+                # zero rows: never indexed by any slab (col ids are
+                # < num_cols) and contribute nothing to the gramian
+                V = jnp.concatenate(
+                    [V, jnp.zeros((pad, V.shape[1]), dtype=V.dtype)])
+            V = jax.device_put(V, NamedSharding(mesh, P("model", None)))
+        else:
+            V = jax.device_put(V, rep)
         out = jax.device_put(out, rep)
 
     streaming = isinstance(bucketed, BucketedRatings)

@@ -300,3 +300,27 @@ def test_bf16_matmul_close_to_f32():
     bf = als_train(coo, rank=8, iterations=6, lam=0.05, seed=3,
                    matmul_dtype="bfloat16")
     assert abs(rmse(f32, coo) - rmse(bf, coo)) < 0.02
+
+
+def test_sharded_factor_table_matches_replicated():
+    """Tensor-parallel layout: V row-sharded over the "model" axis must
+    give the same solution as replicated V (XLA inserts the gathers)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    rng = np.random.default_rng(5)
+    nnz = 8_000
+    coo = RatingsCOO(
+        (64 * rng.random(nnz)).astype(np.int32),
+        (48 * rng.random(nnz)).astype(np.int32),
+        rng.random(nnz).astype(np.float32) * 5, 64, 48,
+    )
+    b = bucket_rows(coo, min_len=8)
+    V = jnp.asarray(rng.standard_normal((48, 8)).astype(np.float32))
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("data", "model"))
+    rep = np.asarray(solve_half(V, b, 8, 0.05, mesh=mesh))
+    tp = np.asarray(solve_half(V, b, 8, 0.05, mesh=mesh, shard_factors=True))
+    np.testing.assert_allclose(rep, tp, atol=1e-5)
