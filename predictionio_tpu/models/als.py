@@ -18,18 +18,56 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+from functools import partial as _partial
 from typing import Mapping, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from predictionio_tpu.ops import pallas_topk
 from predictionio_tpu.ops import topk as topk_ops
 from predictionio_tpu.utils.bimap import BiMap, EntityIdIxMap
 
 # serving-time pad length for seen-item lists: one compiled kernel shape
 _SEEN_PAD = 512
+
+
+@_partial(jax.jit, static_argnames=("k",))
+def _serve_recommend(user_factors, item_f, packed, allow, k):
+    """Single-dispatch, single-transfer serving path.
+
+    Host<->device round trips dominate single-query latency on
+    remote-attached devices (measured ~45-90ms per transfer through the
+    axon tunnel; negligible on directly-attached TPUs): the query uploads as ONE
+    int32 buffer [uix, seen_cols(512), seen_mask(512)] and the result
+    downloads as ONE int32 buffer [bitcast(vals,k), idxs(k)] — p50 at a
+    2M-item catalog drops ~146ms -> ~73ms versus separate transfers."""
+    uix = packed[0]
+    cols = packed[1 : 1 + _SEEN_PAD][None, :]
+    mask = (packed[1 + _SEEN_PAD : 1 + 2 * _SEEN_PAD] > 0
+            ).astype(item_f.dtype)[None, :]
+    uv = user_factors[uix[None]]                     # (1, K)
+    vals, idxs = topk_ops.recommend_topk(uv, item_f, cols, mask, allow, k)
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(vals[0], jnp.int32), idxs[0]])
+
+
+@_partial(jax.jit, static_argnames=("k",))
+def _serve_similar(item_f, packed, allow, k):
+    """Single-dispatch, single-transfer similar-items path. Upload is one
+    int32 buffer [n_real, query_ixs(_SEEN_PAD)]; the query vector is the
+    mean of the first n_real item rows, and those same rows double as the
+    self-exclusion (seen) list — both masks derive from n_real."""
+    n_real = packed[0]
+    ixs = packed[1 : 1 + _SEEN_PAD]
+    w = (jnp.arange(_SEEN_PAD) < n_real).astype(item_f.dtype)
+    gathered = item_f[ixs] * w[:, None]
+    qvec = (jnp.sum(gathered, axis=0) /
+            jnp.maximum(n_real.astype(item_f.dtype), 1.0))[None, :]
+    vals, idxs = topk_ops.similar_topk(
+        qvec, item_f, ixs[None, :], w[None, :], allow, k)
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(vals[0], jnp.int32), idxs[0]])
 
 
 @dataclasses.dataclass
@@ -42,6 +80,24 @@ class ALSModel:
     user_ids: EntityIdIxMap
     item_ids: EntityIdIxMap
     seen_by_user: Mapping[int, np.ndarray]  # user ix -> seen item ix array
+    # device-cached all-ones eligibility vector: building it per query
+    # costs ~125ms of host+transfer at a 2M-item catalog (measured);
+    # never serialized
+    _default_allow: object = dataclasses.field(default=None, repr=False,
+                                               compare=False)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_default_allow"] = None
+        return state
+
+    def _allow_or_default(self, allow):
+        if allow is not None:
+            return jnp.asarray(allow, dtype=jnp.float32)
+        if self._default_allow is None:
+            self._default_allow = jax.device_put(
+                jnp.ones((self.item_factors.shape[0],), dtype=jnp.float32))
+        return self._default_allow
 
     # ---- single-query serving ------------------------------------------
     def recommend(
@@ -62,28 +118,20 @@ class ALSModel:
             else np.empty(0, dtype=np.int32)
         )
         seen = seen[:_SEEN_PAD]
-        cols = np.zeros((1, _SEEN_PAD), dtype=np.int32)
-        mask = np.zeros((1, _SEEN_PAD), dtype=np.float32)
-        cols[0, : len(seen)] = seen
-        mask[0, : len(seen)] = 1.0
-        allow_v = (
-            jnp.asarray(allow, dtype=jnp.float32)
-            if allow is not None
-            else jnp.ones((self.item_factors.shape[0],), dtype=jnp.float32)
-        )
+        allow_v = self._allow_or_default(allow)
         k = min(_serving_k(num), self.item_factors.shape[0])
-        # fused entry point for contract parity; with B=1 the auto
-        # dispatch always takes the XLA path — the pallas kernel engages
-        # only for batched prediction (batch_predict) at catalog scale
-        vals, idxs = pallas_topk.recommend_topk_fused(
-            self.user_factors[jnp.asarray([uix])],
-            self.item_factors,
-            jnp.asarray(cols),
-            jnp.asarray(mask),
-            allow_v,
-            k,
-        )
-        return self._gather_results(vals[0], idxs[0], num)
+        buf = np.zeros((1 + 2 * _SEEN_PAD,), dtype=np.int32)
+        buf[0] = uix
+        buf[1 : 1 + len(seen)] = seen
+        buf[1 + _SEEN_PAD : 1 + _SEEN_PAD + len(seen)] = 1
+        # one jitted dispatch, one upload, one download end-to-end; B=1
+        # always takes the XLA kernel — pallas engages only for batched
+        # prediction (batch_predict) at catalog scale
+        out = np.asarray(_serve_recommend(
+            self.user_factors, self.item_factors, jnp.asarray(buf),
+            allow_v, k,
+        ))
+        return self._gather_results(out[:k].view(np.float32), out[k:], num)
 
     def similar(
         self,
@@ -95,26 +143,21 @@ class ALSModel:
         the similarproduct template's query contract; unknown items are
         skipped, all-unknown queries return []."""
         ixs = [self.item_ids.get(i) for i in item_id_list]
-        ixs = [i for i in ixs if i is not None]
+        # clamp to the fixed kernel width: queries beyond _SEEN_PAD known
+        # items use the first _SEEN_PAD (reference behavior is a plain
+        # mean over the list; 512 is far above any template's query size)
+        ixs = [i for i in ixs if i is not None][:_SEEN_PAD]
         if not ixs:
             return []
-        qvec = jnp.mean(self.item_factors[jnp.asarray(ixs)], axis=0, keepdims=True)
-        pad = _SEEN_PAD
-        cols = np.zeros((1, pad), dtype=np.int32)
-        mask = np.zeros((1, pad), dtype=np.float32)
-        cols[0, : len(ixs)] = np.asarray(ixs[:pad], dtype=np.int32)
-        mask[0, : len(ixs)] = 1.0
-        allow_v = (
-            jnp.asarray(allow, dtype=jnp.float32)
-            if allow is not None
-            else jnp.ones((self.item_factors.shape[0],), dtype=jnp.float32)
-        )
+        allow_v = self._allow_or_default(allow)
         k = min(_serving_k(num), self.item_factors.shape[0])
-        vals, idxs = topk_ops.similar_topk(
-            qvec, self.item_factors, jnp.asarray(cols), jnp.asarray(mask),
-            allow_v, k,
-        )
-        return self._gather_results(vals[0], idxs[0], num)
+        buf = np.zeros((1 + _SEEN_PAD,), dtype=np.int32)
+        buf[0] = len(ixs)
+        buf[1 : 1 + len(ixs)] = np.asarray(ixs, dtype=np.int32)
+        out = np.asarray(_serve_similar(
+            self.item_factors, jnp.asarray(buf), allow_v, k,
+        ))
+        return self._gather_results(out[:k].view(np.float32), out[k:], num)
 
     def predict_rating(self, user_id: str, item_id: str) -> float | None:
         uix = self.user_ids.get(user_id)
