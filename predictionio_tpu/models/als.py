@@ -143,21 +143,35 @@ class ALSModel:
         the similarproduct template's query contract; unknown items are
         skipped, all-unknown queries return []."""
         ixs = [self.item_ids.get(i) for i in item_id_list]
-        # clamp to the fixed kernel width: queries beyond _SEEN_PAD known
-        # items use the first _SEEN_PAD (reference behavior is a plain
-        # mean over the list; 512 is far above any template's query size)
-        ixs = [i for i in ixs if i is not None][:_SEEN_PAD]
+        ixs = [i for i in ixs if i is not None]
         if not ixs:
             return []
         allow_v = self._allow_or_default(allow)
         k = min(_serving_k(num), self.item_factors.shape[0])
-        buf = np.zeros((1 + _SEEN_PAD,), dtype=np.int32)
-        buf[0] = len(ixs)
-        buf[1 : 1 + len(ixs)] = np.asarray(ixs, dtype=np.int32)
-        out = np.asarray(_serve_similar(
-            self.item_factors, jnp.asarray(buf), allow_v, k,
-        ))
-        return self._gather_results(out[:k].view(np.float32), out[k:], num)
+        if len(ixs) <= _SEEN_PAD:
+            # fast path: one packed upload, mean + exclusion in-kernel
+            buf = np.zeros((1 + _SEEN_PAD,), dtype=np.int32)
+            buf[0] = len(ixs)
+            buf[1 : 1 + len(ixs)] = np.asarray(ixs, dtype=np.int32)
+            out = np.asarray(_serve_similar(
+                self.item_factors, jnp.asarray(buf), allow_v, k,
+            ))
+            return self._gather_results(
+                out[:k].view(np.float32), out[k:], num)
+        # rare giant queries: mean over the FULL list (reference contract);
+        # the exclusion list clips to the kernel width like before
+        qvec = jnp.mean(self.item_factors[jnp.asarray(ixs)], axis=0,
+                        keepdims=True)
+        cols = np.zeros((1, _SEEN_PAD), dtype=np.int32)
+        mask = np.zeros((1, _SEEN_PAD), dtype=np.float32)
+        cols[0] = np.asarray(ixs[:_SEEN_PAD], dtype=np.int32)
+        mask[0] = 1.0
+        vals, idxs = topk_ops.similar_topk(
+            qvec, self.item_factors, jnp.asarray(cols), jnp.asarray(mask),
+            allow_v, k,
+        )
+        return self._gather_results(
+            np.asarray(vals)[0], np.asarray(idxs)[0], num)
 
     def predict_rating(self, user_id: str, item_id: str) -> float | None:
         uix = self.user_ids.get(user_id)
