@@ -383,6 +383,32 @@ class PrecisionAtK(OptionAverageMetric):
         return hits / min(self.k, len(relevant))
 
 
+class MAPAtK(OptionAverageMetric):
+    """Mean Average Precision at k — the BASELINE.md north-star quality
+    gate ("matching MAP@10"). Average of precision@i over the ranks i of
+    relevant items inside the top-k, divided by min(k, |relevant|);
+    None (skip) for users with no held-out items."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"MAP@{self.k}"
+
+    def calculate_qpa(self, q, p, a) -> float | None:
+        relevant = set(a)
+        if not relevant:
+            return None
+        top = [s.item for s in p.item_scores[: self.k]]
+        hits, precision_sum = 0, 0.0
+        for rank, item in enumerate(top, start=1):
+            if item in relevant:
+                hits += 1
+                precision_sum += hits / rank
+        return precision_sum / min(self.k, len(relevant))
+
+
 class RecommendationEvaluation(Evaluation):
     """`pio eval predictionio_tpu.templates.recommendation.RecommendationEvaluation
     predictionio_tpu.templates.recommendation.DefaultParamsList`"""
@@ -391,7 +417,9 @@ class RecommendationEvaluation(Evaluation):
         super().__init__()
         self.engine_evaluator = (
             engine_factory(),
-            MetricEvaluator(PrecisionAtK(k=k), output_path=output_path),
+            MetricEvaluator(PrecisionAtK(k=k),
+                            other_metrics=[MAPAtK(k=k)],
+                            output_path=output_path),
         )
 
 

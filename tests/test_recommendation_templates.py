@@ -322,3 +322,25 @@ class TestTemplateEvaluations:
         assert result.best_score.score > 0.5
         assert "Precision@4" in result.metric_header
         assert len(result.engine_params_scores) == 2
+
+
+def test_map_at_k_metric():
+    """MAP@K math on hand-checked cases."""
+    from predictionio_tpu.templates.recommendation import (
+        ItemScore, MAPAtK, PredictedResult,
+    )
+
+    m = MAPAtK(k=3)
+    pr = lambda *items: PredictedResult(
+        item_scores=tuple(ItemScore(item=i, score=1.0) for i in items))
+    # perfect ranking of 2 relevant in top-3: (1/1 + 2/2) / 2 = 1.0
+    assert m.calculate_qpa(None, pr("a", "b", "x"), ("a", "b")) == 1.0
+    # relevant at ranks 1 and 3: (1/1 + 2/3) / 2 = 0.8333...
+    v = m.calculate_qpa(None, pr("a", "x", "b"), ("a", "b"))
+    assert abs(v - (1 + 2 / 3) / 2) < 1e-9
+    # nothing relevant retrieved -> 0; no ground truth -> None (skip)
+    assert m.calculate_qpa(None, pr("x", "y", "z"), ("a",)) == 0.0
+    assert m.calculate_qpa(None, pr("a"), ()) is None
+    # more relevant than k: denominator is k
+    v = m.calculate_qpa(None, pr("a", "b", "c"), ("a", "b", "c", "d", "e"))
+    assert v == 1.0
