@@ -64,6 +64,11 @@ def from_wire(cls: Type[T], obj: Any) -> T:
     json4s strict-extraction behavior the event API also follows)."""
     cls = _unwrap_optional(cls)
     if not (isinstance(cls, type) and dataclasses.is_dataclass(cls)):
+        # bare `tuple` annotations (no type params) still coerce JSON
+        # lists — frozen Query dataclasses rely on tuple fields for
+        # hashability
+        if cls is tuple and isinstance(obj, list):
+            return tuple(obj)
         origin = typing.get_origin(cls)
         if origin in (list, tuple) and isinstance(obj, list):
             args = typing.get_args(cls)

@@ -256,6 +256,46 @@ class ALSModel:
         )
 
 
+def build_allow_vector(
+    item_ids,
+    *,
+    categories=None,
+    category_map=None,
+    white_list=None,
+    black_list=None,
+) -> np.ndarray | None:
+    """Dense 0/1 eligibility vector from the template business rules
+    (shared by recommendation/similarproduct/ecommerce — one place for
+    the Option[Set] semantics: None = no restriction; an EMPTY white
+    list or category set means nothing is eligible)."""
+    n = len(item_ids)
+    if categories is None and white_list is None and not black_list:
+        return None
+    allow = None  # built in one buffer; all-ones only if no positive rule
+    if categories is not None:
+        wanted = set(categories)
+        allow = np.zeros(n, dtype=np.float32)
+        # no category map known -> nothing can match the restriction
+        for item_id, cats in (category_map or {}).items():
+            ix = item_ids.get(item_id)
+            if ix is not None and wanted & set(cats):
+                allow[ix] = 1.0
+    if white_list is not None:
+        wl = np.zeros(n, dtype=np.float32)
+        for item_id in white_list:
+            ix = item_ids.get(item_id)
+            if ix is not None:
+                wl[ix] = 1.0
+        allow = wl if allow is None else allow * wl
+    if allow is None:
+        allow = np.ones(n, dtype=np.float32)
+    for item_id in black_list or ():
+        ix = item_ids.get(item_id)
+        if ix is not None:
+            allow[ix] = 0.0
+    return allow
+
+
 def _serving_k(k: int) -> int:
     """Round k up to a small fixed menu so serving never retraces on a new
     ``num`` (SURVEY.md §7 hard-parts: fixed top-k buckets)."""

@@ -29,7 +29,7 @@ from predictionio_tpu.controller import (
     ShardedAlgorithm,
 )
 from predictionio_tpu.controller.base import PersistentModelManifest
-from predictionio_tpu.models.als import ALSModel
+from predictionio_tpu.models.als import ALSModel, build_allow_vector
 from predictionio_tpu.ops.als import RatingsCOO, als_train
 from predictionio_tpu.templates.recommendation import ALSPreparator, TrainingData
 from predictionio_tpu.utils.bimap import EntityIdIxMap
@@ -251,26 +251,15 @@ class ECommAlgorithm(ShardedAlgorithm):
     def _allow_vector(self, model: ECommModel, query: Query) -> np.ndarray:
         item_ids = model.als.item_ids
         n = len(item_ids)
-        allow = np.ones(n, dtype=np.float32)
-        if query.categories is not None:
-            wanted = set(query.categories)
-            cat_ok = np.zeros(n, dtype=np.float32)
-            for item_id, cats in model.categories.items():
-                ix = item_ids.get(item_id)
-                if ix is not None and wanted & set(cats):
-                    cat_ok[ix] = 1.0
-            allow *= cat_ok
-        if query.white_list is not None:
-            wl = np.zeros(n, dtype=np.float32)
-            for item_id in query.white_list:
-                ix = item_ids.get(item_id)
-                if ix is not None:
-                    wl[ix] = 1.0
-            allow *= wl
-        for item_id in query.black_list or ():
-            ix = item_ids.get(item_id)
-            if ix is not None:
-                allow[ix] = 0.0
+        allow = build_allow_vector(
+            item_ids,
+            categories=query.categories,
+            category_map=model.categories,
+            white_list=query.white_list,
+            black_list=query.black_list,
+        )
+        if allow is None:  # no query rules; availability applies below
+            allow = np.ones(n, dtype=np.float32)
         for item_id in self._unavailable_items():
             ix = item_ids.get(item_id)
             if ix is not None:

@@ -37,7 +37,7 @@ from predictionio_tpu.controller import (
     ShardedAlgorithm,
 )
 from predictionio_tpu.controller.base import PersistentModelManifest
-from predictionio_tpu.models.als import ALSModel
+from predictionio_tpu.models.als import ALSModel, build_allow_vector
 from predictionio_tpu.ops import pallas_topk
 from predictionio_tpu.ops import topk as topk_ops
 from predictionio_tpu.ops.als import RatingsCOO, als_train
@@ -51,8 +51,15 @@ from predictionio_tpu.utils.bimap import EntityIdIxMap
 
 @dataclasses.dataclass(frozen=True)
 class Query:
+    """{user, num} plus the custom-query variant's optional id filters
+    (reference: examples/scala-parallel-recommendation/custom-query —
+    whiteList/blackList narrowing; category-based filtering is the
+    ecommerce template's role)."""
+
     user: str
     num: int = 10
+    white_list: tuple | None = None  # None = no restriction; [] = none eligible
+    black_list: tuple | None = None  # always excluded
 
 
 @dataclasses.dataclass(frozen=True)
@@ -268,7 +275,11 @@ class ALSAlgorithm(ShardedAlgorithm):
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         recs = model.recommend(
-            query.user, query.num, exclude_seen=self.params.exclude_seen
+            query.user, query.num,
+            allow=build_allow_vector(model.item_ids,
+                                     white_list=query.white_list,
+                                     black_list=query.black_list),
+            exclude_seen=self.params.exclude_seen,
         )
         return PredictedResult(
             item_scores=tuple(ItemScore(item=i, score=s) for i, s in recs)
@@ -276,18 +287,24 @@ class ALSAlgorithm(ShardedAlgorithm):
 
     def batch_predict(self, model: ALSModel, queries):
         """All queries scored in one matmul + top_k — the RDD-join
-        analogue (ALSAlgorithm batchPredict path)."""
+        analogue (ALSAlgorithm batchPredict path). Queries carrying
+        white/black-list filters need a per-query eligibility vector, so
+        they take the single-query path; the unfiltered rest batch."""
         import jax.numpy as jnp
 
         if not queries:
             return []
+        out = [(qi, self.predict(model, q)) for qi, q in queries
+               if q.white_list is not None or q.black_list]
+        queries = [(qi, q) for qi, q in queries
+                   if not (q.white_list is not None or q.black_list)]
         known = [
             (qi, model.user_ids[q.user], q.num)
             for qi, q in queries
             if q.user in model.user_ids
         ]
-        out = [(qi, PredictedResult()) for qi, q in queries
-               if q.user not in model.user_ids]
+        out += [(qi, PredictedResult()) for qi, q in queries
+                if q.user not in model.user_ids]
         if not known:
             return out
         uixs = np.asarray([u for _, u, _ in known], dtype=np.int32)

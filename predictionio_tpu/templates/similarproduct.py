@@ -26,7 +26,7 @@ from predictionio_tpu.controller import (
     ShardedAlgorithm,
 )
 from predictionio_tpu.controller.base import PersistentModelManifest
-from predictionio_tpu.models.als import ALSModel
+from predictionio_tpu.models.als import ALSModel, build_allow_vector
 from predictionio_tpu.ops.als import RatingsCOO, als_train
 from predictionio_tpu.templates.recommendation import ALSPreparator, TrainingData
 from predictionio_tpu.utils.bimap import EntityIdIxMap
@@ -198,32 +198,13 @@ class SimilarALSAlgorithm(ShardedAlgorithm):
     def _allow_vector(self, model: SimilarModel, query: Query) -> np.ndarray | None:
         """Business-rule eligibility as a dense 0/1 vector (fused into the
         scoring kernel, ops/topk)."""
-        item_ids = model.als.item_ids
-        n = len(item_ids)
-        if query.categories is None and query.white_list is None and query.black_list is None:
-            return None
-        allow = np.ones(n, dtype=np.float32)
-        if query.categories is not None:
-            wanted = set(query.categories)
-            cat_ok = np.zeros(n, dtype=np.float32)
-            for item_id, cats in model.categories.items():
-                ix = item_ids.get(item_id)
-                if ix is not None and wanted & set(cats):
-                    cat_ok[ix] = 1.0
-            allow *= cat_ok
-        if query.white_list is not None:
-            wl = np.zeros(n, dtype=np.float32)
-            for item_id in query.white_list:
-                ix = item_ids.get(item_id)
-                if ix is not None:
-                    wl[ix] = 1.0
-            allow *= wl
-        if query.black_list is not None:
-            for item_id in query.black_list:
-                ix = item_ids.get(item_id)
-                if ix is not None:
-                    allow[ix] = 0.0
-        return allow
+        return build_allow_vector(
+            model.als.item_ids,
+            categories=query.categories,
+            category_map=model.categories,
+            white_list=query.white_list,
+            black_list=query.black_list,
+        )
 
     def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
         allow = self._allow_vector(model, query)

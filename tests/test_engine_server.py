@@ -342,3 +342,15 @@ class TestFeedbackLoop:
         finally:
             server.stop()
             es.stop()
+
+
+def test_wire_bare_tuple_coercion():
+    """Bare-``tuple`` dataclass fields coerce JSON lists (frozen Query
+    hashability depends on it)."""
+    from predictionio_tpu.core.wire import from_wire
+    from predictionio_tpu.templates.recommendation import Query
+
+    q = from_wire(Query, {"user": "u0", "whiteList": ["i1"], "blackList": []})
+    assert q.white_list == ("i1",)
+    assert q.black_list == ()
+    hash(q)  # frozen dataclass stays hashable

@@ -344,3 +344,37 @@ def test_map_at_k_metric():
     # more relevant than k: denominator is k
     v = m.calculate_qpa(None, pr("a", "b", "c"), ("a", "b", "c", "d", "e"))
     assert v == 1.0
+
+
+def test_custom_query_white_black_lists(storage, monkeypatch, tmp_path):
+    """Reference custom-query variant parity: whiteList restricts the
+    candidate set, blackList excludes from it."""
+    from predictionio_tpu.templates.recommendation import Query, engine_factory
+
+    monkeypatch.setenv("PIO_MODEL_DIR", str(tmp_path))
+    outcome = run_train(variant=REC_VARIANT, storage=storage)
+    engine = engine_factory()
+    inst = storage.get_meta_data_engine_instances().get(outcome.instance_id)
+    ep = engine.params_from_instance_json(
+        inst.data_source_params, inst.preparator_params,
+        inst.algorithms_params, inst.serving_params,
+    )
+    ctx = EngineContext(storage=storage)
+    models = engine.prepare_deploy(
+        ctx, ep, load_models(storage, outcome.instance_id))
+    _, _, algos, serving = engine.make_components(ep)
+    algo, model = algos[0], models[0]
+
+    q = Query(user="u0", num=4, white_list=("i3", "i7"))
+    r = serving.serve(q, [algo.predict(model, q)])
+    assert {s.item for s in r.item_scores} <= {"i3", "i7"}
+    assert r.item_scores  # at least one candidate survives
+
+    full = serving.serve(
+        Query(user="u0", num=4),
+        [algo.predict(model, Query(user="u0", num=4))])
+    top = full.item_scores[0].item
+    qb = Query(user="u0", num=4, black_list=(top,))
+    rb = serving.serve(qb, [algo.predict(model, qb)])
+    assert all(s.item != top for s in rb.item_scores)
+    assert rb.item_scores
