@@ -176,21 +176,22 @@ def recommend_topk_fused(
     restricted to 1-D ``allow``; dispatches between the streaming pallas
     kernel and the XLA path.
 
-    ``use_pallas=None`` picks by measured v5e crossover: the kernel's
-    VPU-bound selection only beats XLA's materialize+top_k once the
-    score matrix stops fitting cheaply — wins observed at I>=~1M items
-    with B>=~32 queries (6.3 ms vs 7.8 ms at I=1M/B=32; loses below,
-    e.g. 1.3 ms vs 0.8 ms at the MovieLens-scale I=27k). The auto
-    dispatch also stays inside the kernel's envelope (B<=512 for VMEM,
-    k<=32 for the unrolled selection loop). Forcing ``use_pallas=True``
-    is exact (bit-identical indices on chip) at any size. Any failure to
-    build/run the kernel falls back to the XLA path."""
+    ``use_pallas=None`` resolves to False: re-measured with chained,
+    fully-blocked timing (this chip, f32, K=32, k=10), XLA wins at every
+    point — 21 ms vs 129 ms at I=1M/B=32, 47 ms vs 144 ms at I=2M/B=64,
+    147 ms vs 218 ms at I=4M/B=128. The gap narrows with scale (XLA's
+    advantage is its fused materialize+top_k; the kernel's per-tile VPU
+    selection loop dominates below ~10M items) but no crossover was
+    reached inside the kernel's VMEM envelope, so auto-dispatch is OFF —
+    the per-design-rule call ("don't hand-schedule what the compiler
+    already does"). The kernel remains exact (bit-identical indices on
+    chip) under ``use_pallas=True`` for backends without the XLA fusion
+    and as the base for future tile tuning; the earlier envelope
+    constants (_MIN_ITEMS/_MIN_BATCH/_MAX_BATCH/_MAX_K) are retained as
+    the validity bounds for forced use. Any failure to build/run the
+    kernel falls back to the XLA path."""
     if use_pallas is None:
-        use_pallas = (
-            item_f.shape[0] >= _MIN_ITEMS
-            and _MIN_BATCH <= user_vecs.shape[0] <= _MAX_BATCH
-            and k <= _MAX_K
-        )
+        use_pallas = False  # measured: XLA wins everywhere (docstring)
     # probe (a real Mosaic compile) only when the kernel would be used
     if not use_pallas or allow.ndim != 1 or (mode := _kernel_mode()) is None:
         from predictionio_tpu.ops.topk import recommend_topk

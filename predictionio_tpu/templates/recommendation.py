@@ -319,7 +319,8 @@ class ALSAlgorithm(ShardedAlgorithm):
                 mask[j, : len(s)] = 1.0
         allow = jnp.ones((model.item_factors.shape[0],), dtype=jnp.float32)
         k = min(max_num, model.item_factors.shape[0])
-        # auto-dispatches to the pallas streaming kernel at catalog scale
+        # fused entry point (XLA path by measurement; ops/pallas_topk
+        # docstring records the numbers)
         vals, idxs = pallas_topk.recommend_topk_fused(
             model.user_factors[jnp.asarray(uixs)],
             model.item_factors,
