@@ -192,6 +192,14 @@ def recommend_topk_fused(
     kernel falls back to the XLA path."""
     if use_pallas is None:
         use_pallas = False  # measured: XLA wins everywhere (docstring)
+    elif use_pallas:
+        # forced use must stay inside the kernel's validity bounds —
+        # outside them the kernel over-fills VMEM or unrolls pathologically
+        if not (user_vecs.shape[0] <= _MAX_BATCH and k <= _MAX_K):
+            raise ValueError(
+                f"use_pallas=True outside the kernel envelope "
+                f"(B={user_vecs.shape[0]} <= {_MAX_BATCH}, k={k} <= {_MAX_K})"
+            )
     # probe (a real Mosaic compile) only when the kernel would be used
     if not use_pallas or allow.ndim != 1 or (mode := _kernel_mode()) is None:
         from predictionio_tpu.ops.topk import recommend_topk

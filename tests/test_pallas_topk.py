@@ -67,24 +67,38 @@ def test_masks_are_respected():
             assert (r, int(c)) not in seen
 
 
-def test_auto_dispatch_envelope():
-    """Auto dispatch stays inside the kernel's VMEM/unroll envelope:
-    out-of-envelope shapes must take the XLA path, not crash."""
+def test_dispatch_contract():
+    """Auto dispatch (use_pallas=None) always takes the XLA path (measured
+    loser everywhere — ops/pallas_topk docstring); forced use outside the
+    kernel's validity bounds is rejected instead of silently degrading."""
+    import pytest as _pytest
+
     from predictionio_tpu.ops import pallas_topk as ptk
 
-    ok = dict(item_f=ptk._MIN_ITEMS, b=ptk._MIN_BATCH, k=10)
+    rng = np.random.default_rng(0)
+    uf = jnp.asarray(rng.standard_normal((4, 8)).astype(np.float32))
+    itf = jnp.asarray(rng.standard_normal((64, 8)).astype(np.float32))
+    cols = jnp.zeros((4, 8), jnp.int32)
+    mask = jnp.zeros((4, 8), jnp.float32)
+    allow = jnp.ones((64,), jnp.float32)
 
-    def decided(items, b, k):
-        # replicate the use_pallas=None decision without running anything
-        return (items >= ptk._MIN_ITEMS
-                and ptk._MIN_BATCH <= b <= ptk._MAX_BATCH
-                and k <= ptk._MAX_K)
+    # auto path == XLA result at any shape
+    from predictionio_tpu.ops.topk import recommend_topk
 
-    assert decided(ok["item_f"], ok["b"], ok["k"])
-    assert not decided(ok["item_f"] - 1, ok["b"], ok["k"])      # small catalog
-    assert not decided(ok["item_f"], ptk._MIN_BATCH - 1, ok["k"])  # tiny batch
-    assert not decided(ok["item_f"], ptk._MAX_BATCH + 1, ok["k"])  # VMEM blowup
-    assert not decided(ok["item_f"], ok["b"], ptk._MAX_K + 1)      # huge k
+    v1, i1 = ptk.recommend_topk_fused(uf, itf, cols, mask, allow, 5)
+    v2, i2 = recommend_topk(uf, itf, cols, mask, allow, 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    # forced out-of-envelope -> explicit error, not a silent fallback
+    with _pytest.raises(ValueError, match="envelope"):
+        ptk.recommend_topk_fused(uf, itf, cols, mask, allow,
+                                 ptk._MAX_K + 1, use_pallas=True)
+    big = jnp.zeros((ptk._MAX_BATCH + 1, 8), jnp.float32)
+    with _pytest.raises(ValueError, match="envelope"):
+        ptk.recommend_topk_fused(
+            big, itf, jnp.zeros((ptk._MAX_BATCH + 1, 8), jnp.int32),
+            jnp.zeros((ptk._MAX_BATCH + 1, 8), jnp.float32), allow, 5,
+            use_pallas=True)
 
 
 def test_seen_trim_respects_unpacked_entries():
