@@ -31,11 +31,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _TILE_I = 512
-#: auto-dispatch envelope, from measured v5e crossovers and VMEM budget:
-#: the kernel wins at catalog scale with enough queries to amortize the
-#: per-tile VPU selection, loses (or over-fills VMEM) outside it.
+#: chunked-vs-flat XLA dispatch thresholds (recommend_topk_fused auto
+#: path): the chunked-scan merge wins from ~1M items with batched
+#: queries; below, the flat materialize+top_k is faster.
 _MIN_ITEMS = 786_432
 _MIN_BATCH = 24
+#: validity bounds for FORCED pallas-kernel use (use_pallas=True):
 _MAX_BATCH = 512   # (B, S) seen arrays + (B, tile) scores must fit VMEM
 _MAX_K = 32        # selection loop unrolls k times per tile
 #: static menu of seen-pad widths; callers pad to 512, real per-batch
@@ -177,19 +178,18 @@ def recommend_topk_fused(
     kernel and the XLA path.
 
     ``use_pallas=None`` resolves to False: re-measured with chained,
-    fully-blocked timing (this chip, f32, K=32, k=10), XLA wins at every
-    point — 21 ms vs 129 ms at I=1M/B=32, 47 ms vs 144 ms at I=2M/B=64,
-    147 ms vs 218 ms at I=4M/B=128. The gap narrows with scale (XLA's
-    advantage is its fused materialize+top_k; the kernel's per-tile VPU
-    selection loop dominates below ~10M items) but no crossover was
-    reached inside the kernel's VMEM envelope, so auto-dispatch is OFF —
-    the per-design-rule call ("don't hand-schedule what the compiler
-    already does"). The kernel remains exact (bit-identical indices on
-    chip) under ``use_pallas=True`` for backends without the XLA fusion
-    and as the base for future tile tuning; the earlier envelope
-    constants (_MIN_ITEMS/_MIN_BATCH/_MAX_BATCH/_MAX_K) are retained as
-    the validity bounds for forced use. Any failure to build/run the
-    kernel falls back to the XLA path."""
+    fully-blocked timing (this chip, f32, K=32, k=10), the pallas kernel
+    loses at every point (129 ms vs XLA's 21 ms at I=1M/B=32) — its
+    per-tile VPU selection loop can't match XLA's fused paths, so it
+    stays available only under ``use_pallas=True`` (exact, bit-identical
+    indices; for backends without the XLA fusion). The auto path instead
+    picks between two XLA formulations: the flat materialize+top_k
+    (ops/topk.recommend_topk, best for small catalogs and B=1 serving)
+    and the chunked-scan merge (ops/topk.recommend_topk_chunked,
+    O(B x chunk) memory; measured 1.2-1.75x faster from ~1M items with
+    batched queries). The envelope constants (_MAX_BATCH/_MAX_K) are the
+    validity bounds enforced on forced pallas use. Any failure to
+    build/run the kernel falls back to the XLA path."""
     if use_pallas is None:
         use_pallas = False  # measured: XLA wins everywhere (docstring)
     elif use_pallas:
@@ -202,8 +202,12 @@ def recommend_topk_fused(
             )
     # probe (a real Mosaic compile) only when the kernel would be used
     if not use_pallas or allow.ndim != 1 or (mode := _kernel_mode()) is None:
-        from predictionio_tpu.ops.topk import recommend_topk
+        from predictionio_tpu.ops.topk import recommend_topk, recommend_topk_chunked
 
+        if (allow.ndim == 1 and item_f.shape[0] >= _MIN_ITEMS
+                and user_vecs.shape[0] >= _MIN_BATCH):
+            return recommend_topk_chunked(
+                user_vecs, item_f, seen_cols, seen_mask, allow, k)
         return recommend_topk(user_vecs, item_f, seen_cols, seen_mask, allow, k)
     seen_cols, seen_mask = _trim_seen(seen_cols, seen_mask)
     tile_i = min(tile_i, max(128, pl.cdiv(item_f.shape[0], 128) * 128))

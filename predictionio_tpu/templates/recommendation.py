@@ -309,7 +309,18 @@ class ALSAlgorithm(ShardedAlgorithm):
             return out
         uixs = np.asarray([u for _, u, _ in known], dtype=np.int32)
         max_num = max(n for _, _, n in known)
-        pad = 512
+        # right-size the seen arrays to the smallest menu width covering
+        # the real counts (smaller uploads; the top-k paths accept any S)
+        pad = 8
+        if self.params.exclude_seen:
+            widest = max(
+                (len(model.seen_by_user.get(int(u), ())) for _, u, _ in known),
+                default=0,
+            )
+            for cap in (8, 64, 512):
+                pad = cap
+                if widest <= cap:
+                    break
         cols = np.zeros((len(known), pad), dtype=np.int32)
         mask = np.zeros((len(known), pad), dtype=np.float32)
         if self.params.exclude_seen:

@@ -142,3 +142,81 @@ def test_fewer_eligible_than_k_pads_with_neg_inf():
     assert np.isfinite(vals[:, :2]).all()
     assert np.isneginf(vals[:, 2:]).all()
     assert set(np.asarray(idx)[:, :2].ravel()) <= {3, 7}
+
+
+def test_chunked_topk_matches_flat():
+    """recommend_topk_chunked: identical results to the flat path with
+    seen masks, allow vectors, and non-divisible catalog sizes."""
+    from predictionio_tpu.ops.topk import recommend_topk, recommend_topk_chunked
+
+    rng = np.random.default_rng(11)
+    B, I, K, S, k = 6, 1000, 8, 16, 7   # I not a multiple of the chunk
+    uf = jnp.asarray(rng.standard_normal((B, K)).astype(np.float32))
+    itf = jnp.asarray(rng.standard_normal((I, K)).astype(np.float32))
+    cols = np.zeros((B, S), np.int32)
+    mask = np.zeros((B, S), np.float32)
+    for b in range(B):
+        seen = rng.choice(I, size=5, replace=False)
+        cols[b, :5] = seen
+        mask[b, :5] = 1.0
+    allow = np.ones((I,), np.float32)
+    allow[rng.choice(I, size=50, replace=False)] = 0.0
+
+    v1, i1 = recommend_topk(uf, itf, jnp.asarray(cols), jnp.asarray(mask),
+                            jnp.asarray(allow), k)
+    v2, i2 = recommend_topk_chunked(uf, itf, jnp.asarray(cols),
+                                    jnp.asarray(mask), jnp.asarray(allow), k,
+                                    chunk=256)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1e-5)
+    # no seen or disallowed item leaks through
+    for b in range(B):
+        got = set(np.asarray(i2)[b].tolist())
+        assert not (got & set(cols[b][:5].tolist()))
+        assert all(allow[i] > 0 for i in got)
+
+
+def test_fused_auto_uses_chunked_at_scale():
+    """The auto path dispatches to the chunked formulation at catalog
+    scale and stays equal to the flat path."""
+    from predictionio_tpu.ops import pallas_topk as ptk
+    from predictionio_tpu.ops.topk import recommend_topk
+
+    rng = np.random.default_rng(12)
+    B, K, k = max(ptk._MIN_BATCH, 4), 8, 5
+    I = ptk._MIN_ITEMS
+    uf = jnp.asarray(rng.standard_normal((B, K)).astype(np.float32))
+    itf = jnp.asarray(rng.standard_normal((I, K)).astype(np.float32))
+    cols = jnp.zeros((B, 8), jnp.int32)
+    mask = jnp.zeros((B, 8), jnp.float32)
+    allow = jnp.ones((I,), jnp.float32)
+    import predictionio_tpu.ops.topk as topk_mod
+
+    calls = []
+    orig = topk_mod.recommend_topk_chunked
+    topk_mod.recommend_topk_chunked = (
+        lambda *a, **kw: (calls.append(1), orig(*a, **kw))[1])
+    try:
+        v1, i1 = ptk.recommend_topk_fused(uf, itf, cols, mask, allow, k)
+    finally:
+        topk_mod.recommend_topk_chunked = orig
+    assert calls, "auto path should take the chunked formulation at scale"
+    v2, i2 = recommend_topk(uf, itf, cols, mask, allow, k)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_chunked_degenerate_all_masked_matches_flat():
+    """All items seen/disallowed: indices must match the flat path
+    (0..k-1 at -inf), not duplicated carry slots."""
+    from predictionio_tpu.ops.topk import recommend_topk, recommend_topk_chunked
+
+    B, I, K, k = 2, 600, 4, 5
+    uf = jnp.ones((B, K), jnp.float32)
+    itf = jnp.ones((I, K), jnp.float32)
+    cols = jnp.zeros((B, 8), jnp.int32)
+    mask = jnp.zeros((B, 8), jnp.float32)
+    allow = jnp.zeros((I,), jnp.float32)   # nothing eligible
+    v1, i1 = recommend_topk(uf, itf, cols, mask, allow, k)
+    v2, i2 = recommend_topk_chunked(uf, itf, cols, mask, allow, k, chunk=256)
+    assert not np.isfinite(np.asarray(v2)).any()
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
