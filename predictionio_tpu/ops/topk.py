@@ -67,10 +67,12 @@ def recommend_topk_chunked(
     to tile-local coordinates. A non-divisible catalog is covered by a
     final overlapping tile whose already-scored prefix is masked out.
 
-    Matches the flat path's indices, including the degenerate
-    all-masked case (the merge carry is initialised with 0..k-1, the
-    indices flat ``top_k`` yields over constant scores). Restricted to
-    1-D ``allow``; measured 1.6-2.3x faster than the flat path from
+    Matches the flat path's indices on every finite-score slot. Slots
+    beyond the eligible-item count carry -inf values and out-of-range
+    sentinel indices (>= I, never colliding with a real pick) — callers
+    must treat non-finite slots as absent, which both in-repo consumers
+    (ALSModel._gather_results, batch_predict) already do. Restricted to
+    1-D ``allow``; measured 1.6-2.5x faster than the flat path from
     ~1M items with batched queries (peak memory O(B x chunk)); the
     flat path stays better for small catalogs and B=1 serving."""
     B = user_vecs.shape[0]
@@ -116,7 +118,10 @@ def recommend_topk_chunked(
 
     init = (
         jnp.full((B, k), NEG_INF),
-        jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (B, k)),
+        # out-of-range sentinels: a -inf carry slot must never share an
+        # index with a real (finite) pick, or a caller ignoring score
+        # finiteness would serve duplicates
+        jnp.broadcast_to(I + jnp.arange(k, dtype=jnp.int32), (B, k)),
     )
     (v, i), _ = jax.lax.scan(body, init, (starts, valid_from))
     return v, i

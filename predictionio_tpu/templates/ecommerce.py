@@ -21,6 +21,7 @@ import dataclasses
 import numpy as np
 
 from predictionio_tpu.controller import (
+    Algorithm,
     DataSource,
     Engine,
     FirstServing,
@@ -265,6 +266,13 @@ class ECommAlgorithm(ShardedAlgorithm):
             if ix is not None:
                 allow[ix] = 0.0
         return allow
+
+    def batch_predict(self, model: ECommModel, queries):
+        """Per-query business rules (categories/lists/availability) need a
+        per-query allow vector, so each query takes the single-query
+        path: the base map-over-predict is the right implementation,
+        re-exposed past ShardedAlgorithm's must-override guard."""
+        return Algorithm.batch_predict(self, model, queries)
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         allow = self._allow_vector(model, query)

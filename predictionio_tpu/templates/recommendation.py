@@ -310,14 +310,15 @@ class ALSAlgorithm(ShardedAlgorithm):
         uixs = np.asarray([u for _, u, _ in known], dtype=np.int32)
         max_num = max(n for _, _, n in known)
         # right-size the seen arrays to the smallest menu width covering
-        # the real counts (smaller uploads; the top-k paths accept any S)
-        pad = 8
+        # the real counts (smaller uploads; widths shared with the pallas
+        # kernel's static menu so forced-kernel runs stay on-menu)
+        pad = pallas_topk._SEEN_WIDTHS[0]
         if self.params.exclude_seen:
             widest = max(
                 (len(model.seen_by_user.get(int(u), ())) for _, u, _ in known),
                 default=0,
             )
-            for cap in (8, 64, 512):
+            for cap in pallas_topk._SEEN_WIDTHS:
                 pad = cap
                 if widest <= cap:
                     break

@@ -18,6 +18,7 @@ import dataclasses
 import numpy as np
 
 from predictionio_tpu.controller import (
+    Algorithm,
     DataSource,
     Engine,
     FirstServing,
@@ -205,6 +206,14 @@ class SimilarALSAlgorithm(ShardedAlgorithm):
             white_list=query.white_list,
             black_list=query.black_list,
         )
+
+    def batch_predict(self, model: SimilarModel, queries):
+        """Queries carry heterogeneous item lists and per-query business
+        rules, so each takes the single-query kernel (already one jitted
+        dispatch per query): the base map-over-predict is the right
+        implementation, re-exposed past ShardedAlgorithm's must-override
+        guard."""
+        return Algorithm.batch_predict(self, model, queries)
 
     def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
         allow = self._allow_vector(model, query)

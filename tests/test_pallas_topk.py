@@ -205,18 +205,34 @@ def test_fused_auto_uses_chunked_at_scale():
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
 
-def test_chunked_degenerate_all_masked_matches_flat():
-    """All items seen/disallowed: indices must match the flat path
-    (0..k-1 at -inf), not duplicated carry slots."""
+def test_chunked_underfilled_slots_never_collide():
+    """Fewer eligible items than k: non-finite slots carry out-of-range
+    sentinels so no index ever duplicates a real pick (the flat path
+    guarantees distinctness via full-width top_k)."""
     from predictionio_tpu.ops.topk import recommend_topk, recommend_topk_chunked
 
     B, I, K, k = 2, 600, 4, 5
-    uf = jnp.ones((B, K), jnp.float32)
-    itf = jnp.ones((I, K), jnp.float32)
+    rng = np.random.default_rng(0)
+    uf = jnp.asarray(rng.standard_normal((B, K)).astype(np.float32))
+    itf = jnp.asarray(rng.standard_normal((I, K)).astype(np.float32))
     cols = jnp.zeros((B, 8), jnp.int32)
     mask = jnp.zeros((B, 8), jnp.float32)
-    allow = jnp.zeros((I,), jnp.float32)   # nothing eligible
+    allow = np.zeros((I,), np.float32)
+    allow[0] = allow[1] = 1.0              # only 2 eligible, both ix < k
     v1, i1 = recommend_topk(uf, itf, cols, mask, allow, k)
-    v2, i2 = recommend_topk_chunked(uf, itf, cols, mask, allow, k, chunk=256)
-    assert not np.isfinite(np.asarray(v2)).any()
-    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    v2, i2 = recommend_topk_chunked(uf, itf, cols, mask,
+                                    jnp.asarray(allow), k, chunk=256)
+    # finite slots agree with the flat path
+    fin = np.isfinite(np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(i1)[fin], np.asarray(i2)[fin])
+    # every row's indices are distinct (no real pick duplicated)
+    for b in range(B):
+        row = np.asarray(i2)[b]
+        assert len(set(row.tolist())) == k
+        assert all(ix >= I for ix in row[~fin[b]])
+
+    # fully-masked case: all sentinels, all -inf
+    v3, i3 = recommend_topk_chunked(uf, itf, cols, mask,
+                                    jnp.zeros((I,), jnp.float32), k, chunk=256)
+    assert not np.isfinite(np.asarray(v3)).any()
+    assert (np.asarray(i3) >= I).all()

@@ -378,3 +378,48 @@ def test_custom_query_white_black_lists(storage, monkeypatch, tmp_path):
     rb = serving.serve(qb, [algo.predict(model, qb)])
     assert all(s.item != top for s in rb.item_scores)
     assert rb.item_scores
+
+
+def test_similarproduct_and_ecommerce_batch_predict(storage):
+    """ShardedAlgorithm contract: every template algorithm must serve
+    batch_predict (the eval path) — heterogeneous queries included."""
+    from predictionio_tpu.templates import ecommerce, similarproduct
+    from predictionio_tpu.workflow.train import run_train
+    from predictionio_tpu.workflow.persistence import load_models
+
+    for module, variant, queries in (
+        (similarproduct,
+         {"id": "sim", "engineFactory":
+              "predictionio_tpu.templates.similarproduct.engine_factory",
+          "datasource": {"params": {"app_name": "RecApp"}},
+          "algorithms": [{"name": "als", "params": {"rank": 8,
+                                                    "num_iterations": 5}}]},
+         [similarproduct.Query(items=("i1",), num=3),
+          similarproduct.Query(items=("i2", "i4"), num=2)]),
+        (ecommerce,
+         {"id": "ec", "engineFactory":
+              "predictionio_tpu.templates.ecommerce.engine_factory",
+          "datasource": {"params": {"app_name": "RecApp"}},
+          "algorithms": [{"name": "ecomm", "params": {"rank": 8,
+                                                      "num_iterations": 5}}]},
+         [ecommerce.Query(user="u0", num=3),
+          ecommerce.Query(user="u1", num=2, categories=("alpha",))]),
+    ):
+        outcome = run_train(variant=variant, storage=storage)
+        assert outcome.status == "COMPLETED"
+        engine = module.engine_factory()
+        inst = storage.get_meta_data_engine_instances().get(outcome.instance_id)
+        ep = engine.params_from_instance_json(
+            inst.data_source_params, inst.preparator_params,
+            inst.algorithms_params, inst.serving_params)
+        ctx = EngineContext(storage=storage)
+        models = engine.prepare_deploy(
+            ctx, ep, load_models(storage, outcome.instance_id))
+        _, _, algos, _ = engine.make_components(ep)
+        results = dict(algos[0].batch_predict(models[0],
+                                              list(enumerate(queries))))
+        assert set(results) == set(range(len(queries)))
+        for qi, q in enumerate(queries):
+            single = algos[0].predict(models[0], q)
+            assert [s.item for s in results[qi].item_scores] == \
+                [s.item for s in single.item_scores]
