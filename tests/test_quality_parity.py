@@ -1,0 +1,239 @@
+"""Quality parity: the device-path ALS must match the reference-math
+NumPy ALS-WR (the MLlib `ALS.train` estimator) on MovieLens-class data.
+
+The north-star gate (BASELINE.md) is throughput *at matching MAP@10*;
+Spark/MLlib cannot run here (no JVM), so the anchor is an independent
+NumPy implementation of the identical estimator — different data layout
+(segment reductions vs padded slabs), different RNG stream — evaluated
+under the reference's Evaluation.scala protocol (k-fold, Precision@K /
+MAP@K with rating threshold, exclude-seen top-k). RMSE on held-out
+ratings is the sharp check: same math + same hyperparameters must land
+within seed-level noise. Ranking metrics are confirmed to sit inside
+the reference implementation's own seed spread.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.movielens import (
+    RatingsDataset,
+    load_ratings_file,
+    synthesize_ml100k,
+)
+from predictionio_tpu.e2 import quality
+
+DATA = os.path.join(
+    os.path.dirname(__file__), "..", "examples", "data", "sample_movielens.txt"
+)
+
+
+def small_ds(seed=3):
+    """ML-100k-statistics reconstruction scaled down for CPU test speed."""
+    return synthesize_ml100k(
+        seed=seed, num_users=200, num_items=400, num_ratings=12_000
+    )
+
+
+class TestDataset:
+    def test_generator_marginals(self):
+        ds = synthesize_ml100k()
+        assert (ds.num_users, ds.num_items, ds.nnz) == (943, 1682, 100_000)
+        deg = np.bincount(ds.users, minlength=ds.num_users)
+        assert deg.min() >= 20  # every ML-100k user has >=20 ratings
+        assert 3.2 < ds.ratings.mean() < 3.8
+        assert set(np.unique(ds.ratings)) <= {1.0, 2.0, 3.0, 4.0, 5.0}
+        # deterministic across calls
+        ds2 = synthesize_ml100k()
+        assert np.array_equal(ds.items, ds2.items)
+        assert np.array_equal(ds.ratings, ds2.ratings)
+        # popularity skew: top 10% of items carry a large share
+        item_deg = np.sort(np.bincount(ds.items, minlength=ds.num_items))[::-1]
+        assert item_deg[: ds.num_items // 10].sum() > 0.3 * ds.nnz
+
+    def test_vendored_sample_file(self):
+        ds = load_ratings_file(DATA)
+        # the Spark sample_movielens_data.txt shape
+        assert ds.num_users == 30
+        assert ds.num_items == 100
+        assert ds.nnz == 1501
+        assert ds.ratings.min() >= 1.0 and ds.ratings.max() <= 5.0
+
+    def test_kfold_split_partitions(self):
+        ds = small_ds()
+        train, test = quality.kfold_split(ds, k_fold=5, fold=0)
+        n_test = sum(len(v) for v in test.values())
+        assert train.nnz + n_test == ds.nnz
+        assert abs(n_test - ds.nnz / 5) < ds.nnz * 0.02
+
+
+class TestParity:
+    @pytest.fixture(scope="class")
+    def result(self):
+        return quality.compare_quality(
+            small_ds(), rank=8, iterations=8, lam=0.05, k_fold=5
+        )
+
+    def test_rmse_matches_reference(self, result):
+        """Sharp gate: same estimator => same held-out RMSE (seed noise
+        on this config measured < 0.02)."""
+        assert result["rmse_tpu"] == pytest.approx(result["rmse_ref"], abs=0.05)
+
+    def test_rmse_beats_global_mean(self, result):
+        """Both factorizations must explain real variance, i.e. beat the
+        non-personalized global-mean predictor on the same split."""
+        ds = small_ds()
+        train, test = quality.kfold_split(ds, k_fold=5)
+        mu = float(train.ratings.mean())
+        vals = np.asarray(
+            [r for lst in test.values() for _, r in lst], dtype=np.float64
+        )
+        baseline = float(np.sqrt(np.mean((vals - mu) ** 2)))
+        assert result["rmse_tpu"] < baseline
+        assert result["rmse_ref"] < baseline
+
+    def test_map_within_reference_seed_spread(self, result):
+        """MAP@10 of the device path must sit inside the band the
+        reference implementation itself spans across seeds (explicit ALS
+        is a weak top-N ranker — MLlib included — so the band is low and
+        wide in relative terms; parity means landing in the same band,
+        which we widen by its own width on each side)."""
+        ds = small_ds()
+        train, test = quality.kfold_split(ds, k_fold=5)
+        maps = []
+        for seed in (11, 12, 13):
+            U, V = quality.numpy_als_wr(
+                train, rank=8, iterations=8, lam=0.05, seed=seed
+            )
+            maps.append(
+                quality.ranking_eval(
+                    quality.factor_score_fn(U, V), train, test
+                )["map@10"]
+            )
+        lo, hi = min(maps), max(maps)
+        width = max(hi - lo, 1e-4)
+        assert lo - width <= result["map10_tpu"] <= hi + width, (
+            f"tpu MAP@10 {result['map10_tpu']} outside reference seed band "
+            f"[{lo}, {hi}] ± {width}"
+        )
+
+    def test_factors_beat_popularity_on_learnable_signal(self):
+        """On strongly-clustered preferences (the regime where top-N from
+        explicit ALS is informative), the factor model must beat the
+        popularity baseline — i.e. it learned personalization."""
+        rng = np.random.default_rng(0)
+        n_u, n_i, per = 120, 60, 24
+        users, items, vals = [], [], []
+        for u in range(n_u):
+            liked = np.arange(u % 2, n_i, 2)
+            pick = rng.choice(liked, size=per // 2, replace=False)
+            other = rng.choice(
+                np.arange((u + 1) % 2, n_i, 2), size=per // 2, replace=False
+            )
+            for i in pick:
+                users.append(u), items.append(i), vals.append(5.0)
+            for i in other:
+                users.append(u), items.append(i), vals.append(1.0)
+        ds = RatingsDataset(
+            users=np.asarray(users, np.int32),
+            items=np.asarray(items, np.int32),
+            ratings=np.asarray(vals, np.float32),
+            num_users=n_u,
+            num_items=n_i,
+        )
+        train, test = quality.kfold_split(ds, k_fold=5)
+        U, V = quality.numpy_als_wr(train, rank=8, iterations=10, lam=0.05)
+        als = quality.ranking_eval(
+            quality.factor_score_fn(U, V), train, test, threshold=4.0
+        )
+        pop = quality.ranking_eval(
+            quality.popularity_score_fn(train), train, test, threshold=4.0
+        )
+        assert als["map@10"] > 2 * pop["map@10"]
+
+
+class TestRealSampleThroughFramework:
+    """The vendored real dataset driven through the actual template
+    components (event store -> DataSource -> Preparator -> ALSAlgorithm),
+    mirroring the reference quickstart's data flow, with the framework's
+    own MAP@10 metric agreeing with the harness metric."""
+
+    def test_end_to_end_map_agreement(self, storage):
+        from predictionio_tpu.core.datamap import DataMap
+        from predictionio_tpu.core.event import Event
+        from predictionio_tpu.storage.base import App
+        from predictionio_tpu.templates import recommendation as rec
+        from predictionio_tpu.workflow.context import EngineContext
+
+        ds = load_ratings_file(DATA)
+        app_id = storage.get_meta_data_apps().insert(App(0, "QualityApp"))
+        events = storage.get_events()
+        events.init(app_id)
+        for u, i, r in zip(ds.user_ids(), ds.item_ids(), ds.ratings):
+            events.insert(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=str(u),
+                    target_entity_type="item",
+                    target_entity_id=str(i),
+                    properties=DataMap({"rating": float(r)}),
+                ),
+                app_id,
+            )
+
+        ctx = EngineContext(storage=storage)
+        source = rec.RecommendationDataSource(
+            rec.DataSourceParams(app_name="QualityApp", eval_k=3)
+        )
+        folds = source.read_eval(ctx)
+        td, _info, qa = folds[0]
+        prep = rec.ALSPreparator()
+        pd = prep.prepare(ctx, td)
+        algo = rec.ALSAlgorithm(
+            rec.ALSAlgorithmParams(rank=8, num_iterations=10, lambda_=0.05,
+                                   use_mesh=False)
+        )
+        model = algo.train(ctx, pd)
+
+        # framework metric over the fold's (query, actual) pairs
+        metric = rec.MAPAtK(k=10)
+        preds = algo.batch_predict(model, list(enumerate(q for q, _ in qa)))
+        preds = [p for _, p in sorted(preds)]
+        vals = [
+            metric.calculate_qpa(q, p, a)
+            for (q, a), p in zip(qa, preds)
+        ]
+        vals = [v for v in vals if v is not None]
+        framework_map = float(np.mean(vals)) if vals else 0.0
+
+        # harness metric from the model's raw factors on the same split
+        train_ds = RatingsDataset(
+            users=pd.coo.rows,
+            items=pd.coo.cols,
+            ratings=pd.coo.vals,
+            num_users=pd.coo.num_rows,
+            num_items=pd.coo.num_cols,
+        )
+        test_by_user = {}
+        for q, actual in qa:
+            if q.user not in pd.user_ids:
+                continue
+            u = pd.user_ids[q.user]
+            test_by_user[int(u)] = [
+                (int(pd.item_ids[i]), 5.0)
+                for i in actual
+                if i in pd.item_ids
+            ]
+        test_by_user = {u: v for u, v in test_by_user.items() if v}
+        harness = quality.ranking_eval(
+            quality.factor_score_fn(model.user_factors, model.item_factors),
+            train_ds,
+            test_by_user,
+            threshold=0.0,
+        )
+        # protocols differ slightly (threshold semantics on actuals carry
+        # no rating in read_eval: all held-out items count as relevant) —
+        # the two computations must agree to rounding
+        assert framework_map == pytest.approx(harness["map@10"], abs=0.02)
