@@ -1,0 +1,42 @@
+"""Child process for the two-process jax.distributed test.
+
+Each invocation is one "host": it initializes the runtime through the
+PIO_* env contract (parallel/distributed.py), contributes a local shard
+of a global array, and reduces across hosts. The parent asserts on the
+RESULT lines. Run only via test_distributed_multihost.py.
+"""
+
+import sys
+
+import numpy as np
+
+from predictionio_tpu.utils.testing import force_cpu_devices
+
+force_cpu_devices(2)  # two virtual CPU devices per "host"
+
+from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
+
+active = maybe_initialize_distributed()
+assert active, "PIO_NUM_HOSTS>1 must activate multi-host mode"
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.local_device_count() == 2
+assert jax.device_count() == 4
+
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+sharding = NamedSharding(mesh, P("data"))
+
+# every host contributes (process_index + 1) per local device row
+local = np.full((2, 4), float(jax.process_index() + 1), dtype=np.float32)
+arr = jax.make_array_from_process_local_data(sharding, local, (4, 4))
+
+# cross-host reduction: sum over the sharded axis => psum over DCN
+total = jax.jit(lambda x: jnp.sum(x, axis=0))(arr)
+np.testing.assert_allclose(np.asarray(total), np.full((4,), 6.0))
+
+print(f"RESULT host={jax.process_index()} total={float(total[0]):.1f}", flush=True)
+sys.exit(0)
