@@ -1,0 +1,131 @@
+"""Multinomial logistic regression on the MXU — the template's second
+classifier.
+
+Role parity: the reference's add-algorithm classification variant adds a
+second MLlib learner beside NaiveBayes (reference:
+examples/scala-parallel-classification/add-algorithm/src/main/scala/
+RandomForestAlgorithm.scala) to demonstrate heterogeneous multi-algorithm
+engines. A random forest is scalar-branchy and maps poorly to the MXU, so
+the TPU-native second learner is full-batch softmax regression: the
+entire optimization is one jitted `lax.scan` of Adam steps whose cost is
+two matmuls per step (logits X·W and gradient Xᵀ·residual), with rows
+sharded over the mesh "data" axis — XLA inserts the gradient psum, the
+ICI analogue of MLlib's tree aggregation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from predictionio_tpu.parallel.mesh import data_sharding, replicated, shard_batch
+
+
+@dataclasses.dataclass
+class LogRegModel:
+    """weights [F+1, C]; the final row is the bias."""
+
+    weights: jax.Array
+
+
+def _add_bias(features: jax.Array) -> jax.Array:
+    ones = jnp.ones((features.shape[0], 1), dtype=features.dtype)
+    return jnp.concatenate([features, ones], axis=1)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "iterations"))
+def _fit(features, labels, sample_mask, num_classes: int, iterations: int,
+         lr, l2):
+    """Full-batch Adam on masked softmax cross-entropy + L2 (bias exempt)."""
+    X = _add_bias(features)                      # [N, F+1]
+    n_real = jnp.maximum(jnp.sum(sample_mask), 1.0)
+    one_hot = jax.nn.one_hot(labels, num_classes, dtype=X.dtype)
+
+    def loss_fn(W):
+        logits = X @ W                           # [N, C]  (MXU)
+        logp = jax.nn.log_softmax(logits, axis=1)
+        ce = -jnp.sum(one_hot * logp, axis=1) * sample_mask
+        reg = l2 * jnp.sum(W[:-1] ** 2)
+        return jnp.sum(ce) / n_real + reg
+
+    opt = optax.adam(lr)
+    W0 = jnp.zeros((X.shape[1], num_classes), dtype=X.dtype)
+
+    def step(carry, _):
+        W, opt_state = carry
+        grads = jax.grad(loss_fn)(W)
+        updates, opt_state = opt.update(grads, opt_state, W)
+        return (optax.apply_updates(W, updates), opt_state), None
+
+    (W, _), _ = jax.lax.scan(step, (W0, opt.init(W0)), None, length=iterations)
+    return W
+
+
+# per-mesh jit cache (same rationale as models/naive_bayes._SHARDED_FN_CACHE:
+# rebuilding the wrapper would recompile per training call)
+_SHARDED_FIT_CACHE: dict = {}
+
+
+def _sharded_fit(mesh: Mesh):
+    if mesh not in _SHARDED_FIT_CACHE:
+        _SHARDED_FIT_CACHE[mesh] = jax.jit(
+            _fit.__wrapped__,
+            static_argnames=("num_classes", "iterations"),
+            in_shardings=(
+                data_sharding(mesh, 2),
+                data_sharding(mesh, 1),
+                data_sharding(mesh, 1),
+                replicated(mesh),   # lr
+                replicated(mesh),   # l2
+            ),
+            out_shardings=replicated(mesh),
+        )
+    return _SHARDED_FIT_CACHE[mesh]
+
+
+def train_logreg(
+    features: np.ndarray,
+    labels: np.ndarray,
+    num_classes: int,
+    l2: float = 1e-4,
+    iterations: int = 300,
+    lr: float = 0.1,
+    mesh: Mesh | None = None,
+) -> LogRegModel:
+    """Train softmax regression; with a mesh, rows are padded + sharded
+    over the "data" axis (padding rows carry zero mask)."""
+    if mesh is not None:
+        features = np.asarray(features, dtype=np.float32)
+        labels = np.asarray(labels, dtype=np.int32)
+        mask_host = np.ones(len(labels), dtype=np.float32)
+        arrays, _ = shard_batch([features, labels, mask_host], mesh)
+        f, l, mask = arrays
+        W = _sharded_fit(mesh)(f, l, mask, num_classes, iterations,
+                               jnp.float32(lr), jnp.float32(l2))
+    else:
+        f = jnp.asarray(features, dtype=jnp.float32)
+        l = jnp.asarray(labels, dtype=jnp.int32)
+        mask = jnp.ones(l.shape, dtype=jnp.float32)
+        W = _fit(f, l, mask, num_classes, iterations,
+                 jnp.float32(lr), jnp.float32(l2))
+    return LogRegModel(weights=W)
+
+
+@jax.jit
+def predict_logreg_scores(weights, features):
+    """Per-class log probabilities: log_softmax(X·W) (one matmul)."""
+    logits = _add_bias(jnp.asarray(features, dtype=weights.dtype)) @ weights
+    return jax.nn.log_softmax(logits, axis=1)
+
+
+def predict_logreg(model: LogRegModel, features: np.ndarray) -> np.ndarray:
+    scores = predict_logreg_scores(
+        model.weights, jnp.asarray(features, dtype=jnp.float32)
+    )
+    return np.asarray(jnp.argmax(scores, axis=1))

@@ -37,7 +37,7 @@ from predictionio_tpu.controller import (
     Params,
     SanityCheck,
 )
-from predictionio_tpu.models import naive_bayes
+from predictionio_tpu.models import logreg, naive_bayes
 from predictionio_tpu.utils.bimap import BiMap
 
 
@@ -143,6 +143,27 @@ class NBModel:
     label_map: BiMap
 
 
+def _results_from_log_probs(queries, log_probs, label_map: BiMap):
+    """Shared (index, PredictedResult) assembly from a [N, C] score
+    matrix of per-label log probabilities."""
+    rows = np.asarray(log_probs)
+    best = rows.argmax(axis=1)
+    inv = label_map.inverse
+    return [
+        (i, PredictedResult(
+            label=inv[int(b)],
+            scores={inv[int(c)]: float(s) for c, s in enumerate(row)},
+        ))
+        for (i, _), b, row in zip(queries, best, rows)
+    ]
+
+
+def _query_features(queries):
+    import jax.numpy as jnp
+
+    return jnp.asarray([list(q.attrs) for _, q in queries], dtype=jnp.float32)
+
+
 class NaiveBayesAlgorithm(HostModelAlgorithm):
     """Parity: NaiveBayesAlgorithm.scala:33-43 (MLlib NaiveBayes.train ->
     models/naive_bayes.train_multinomial on the mesh)."""
@@ -162,49 +183,115 @@ class NaiveBayesAlgorithm(HostModelAlgorithm):
         return NBModel(nb=nb, label_map=pd.label_map)
 
     def predict(self, model: NBModel, query: Query) -> PredictedResult:
-        import jax.numpy as jnp
-
-        features = jnp.asarray([query.attrs], dtype=jnp.float32)
-        scores = naive_bayes.predict_multinomial_scores(
-            model.nb.log_prior, model.nb.log_theta, features
-        )[0]
-        best = int(scores.argmax())
-        inv = model.label_map.inverse
-        return PredictedResult(
-            label=inv[best],
-            scores={inv[int(i)]: float(s) for i, s in enumerate(scores)},
-        )
+        return self.batch_predict(model, [(0, query)])[0][1]
 
     def batch_predict(self, model: NBModel, queries):
-        import jax.numpy as jnp
+        import jax.nn
 
         if not queries:
             return []
-        features = jnp.asarray(
-            [list(q.attrs) for _, q in queries], dtype=jnp.float32
-        )
         scores = naive_bayes.predict_multinomial_scores(
-            model.nb.log_prior, model.nb.log_theta, features
+            model.nb.log_prior, model.nb.log_theta, _query_features(queries)
         )
-        best = np.asarray(scores.argmax(axis=1))
-        inv = model.label_map.inverse
-        out = []
-        for (i, _), b, row in zip(queries, best, np.asarray(scores)):
-            out.append(
-                (i, PredictedResult(
-                    label=inv[int(b)],
-                    scores={inv[int(c)]: float(s) for c, s in enumerate(row)},
-                ))
-            )
-        return out
+        # normalize the log-joint to per-label log posteriors so scores
+        # are comparable across algorithms (BlendedServing averages them
+        # with logreg's log_softmax outputs; argmax is unchanged)
+        return _results_from_log_probs(
+            queries, jax.nn.log_softmax(scores, axis=1), model.label_map
+        )
+
+
+# ---------------------------------------------------------------------------
+# Second algorithm: logistic regression (the add-algorithm variant).
+# Role parity: examples/scala-parallel-classification/add-algorithm adds
+# MLlib RandomForest beside NaiveBayes to demonstrate heterogeneous
+# multi-algorithm engines; the TPU-native second learner is softmax
+# regression (models/logreg — random forests are scalar-branchy and map
+# poorly to the MXU).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LogRegAlgorithmParams(Params):
+    iterations: int = 300
+    lr: float = 0.1
+    l2: float = 1e-4
+    use_mesh: bool = True
+
+
+@dataclasses.dataclass
+class LRModel:
+    lr: logreg.LogRegModel
+    label_map: BiMap
+
+
+class LogisticRegressionAlgorithm(HostModelAlgorithm):
+    """Parity role: RandomForestAlgorithm.scala (the second learner in the
+    add-algorithm variant); same Query/PredictedResult contract as
+    NaiveBayesAlgorithm so both can serve in one engine."""
+
+    params_class = LogRegAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, pd: TrainingData) -> LRModel:
+        p = self.params
+        mesh = ctx.mesh_if_parallel if p.use_mesh else None
+        model = logreg.train_logreg(
+            pd.features,
+            pd.labels,
+            num_classes=len(pd.label_map),
+            l2=p.l2,
+            iterations=p.iterations,
+            lr=p.lr,
+            mesh=mesh,
+        )
+        return LRModel(lr=model, label_map=pd.label_map)
+
+    def predict(self, model: LRModel, query: Query) -> PredictedResult:
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model: LRModel, queries):
+        if not queries:
+            return []
+        scores = logreg.predict_logreg_scores(
+            model.lr.weights, _query_features(queries)
+        )
+        return _results_from_log_probs(queries, scores, model.label_map)
+
+
+class BlendedServing(FirstServing):
+    """Average per-label scores across algorithms and re-argmax — a
+    blended multi-algorithm result (the reference's add-algorithm Serving
+    keeps `predictedResults.head`; blending is the natural upgrade once
+    both learners emit per-label log scores)."""
+
+    def serve(self, query: Query, predictions) -> PredictedResult:
+        if len(predictions) == 1:
+            return predictions[0]
+        blended: dict[str, float] = {}
+        for pred in predictions:
+            for label, score in pred.scores.items():
+                blended[label] = blended.get(label, 0.0) + score / len(predictions)
+        if not blended:
+            return predictions[0]
+        best = max(blended, key=blended.get)
+        return PredictedResult(label=best, scores=blended)
 
 
 def engine_factory() -> Engine:
     return Engine(
         data_source_class_map=ClassificationDataSource,
         preparator_class_map=IdentityPreparator,
-        algorithm_class_map={"naive": NaiveBayesAlgorithm, "": NaiveBayesAlgorithm},
-        serving_class_map=FirstServing,
+        algorithm_class_map={
+            "naive": NaiveBayesAlgorithm,
+            "logreg": LogisticRegressionAlgorithm,
+            "": NaiveBayesAlgorithm,
+        },
+        serving_class_map={
+            "": FirstServing,
+            "first": FirstServing,
+            "blended": BlendedServing,
+        },
     )
 
 

@@ -133,3 +133,101 @@ def test_accuracy_eval(storage_with_events, tmp_path):
     assert "Accuracy" in result.metric_header
     assert (tmp_path / "best.json").exists()
     assert len(result.engine_params_scores) == 3
+
+
+# ---------------------------------------------------------------------------
+# Add-algorithm variant: NaiveBayes + LogisticRegression in ONE engine
+# (role of examples/scala-parallel-classification/add-algorithm, which adds
+# RandomForest beside NaiveBayes; heterogeneous multi-algo serving)
+# ---------------------------------------------------------------------------
+
+ADD_ALGO_VARIANT = {
+    "id": "classification-add-algorithm",
+    "engineFactory": "predictionio_tpu.templates.classification.engine_factory",
+    "datasource": {
+        "params": {"app_name": "ClassApp", "attrs": ["attr0", "attr1", "attr2"],
+                   "label": "plan"}
+    },
+    "algorithms": [
+        {"name": "naive", "params": {"smoothing": 1.0, "use_mesh": True}},
+        {"name": "logreg", "params": {"iterations": 200, "lr": 0.1,
+                                      "use_mesh": True}},
+    ],
+    "serving": {"name": "blended"},
+}
+
+
+def test_add_algorithm_trains_both_and_blends(storage_with_events):
+    """Both learners train in one engine run and the blended serving
+    aggregates their per-label scores."""
+    from predictionio_tpu.models.logreg import LogRegModel
+    from predictionio_tpu.models.naive_bayes import MultinomialNBModel
+    from predictionio_tpu.templates.classification import BlendedServing
+
+    storage = storage_with_events
+    outcome = run_train(variant=ADD_ALGO_VARIANT, storage=storage)
+    assert outcome.status == "COMPLETED"
+
+    engine = engine_factory()
+    ep = engine.params_from_variant_json(ADD_ALGO_VARIANT)
+    ctx = EngineContext(storage=storage)
+    models = engine.prepare_deploy(
+        ctx, ep, load_models(storage, outcome.instance_id)
+    )
+    _, _, algos, serving = engine.make_components(ep)
+    assert isinstance(serving, BlendedServing)
+    assert isinstance(models[0].nb, MultinomialNBModel)
+    assert isinstance(models[1].lr, LogRegModel)
+
+    for attrs, expect in (((9.0, 3.0, 0.0), "premium"),
+                          ((0.0, 3.0, 9.0), "free")):
+        q = serving.supplement(Query(attrs=attrs))
+        preds = [a.predict(m, q) for a, m in zip(algos, models)]
+        served = serving.serve(q, preds)
+        assert served.label == expect
+        # the blended scores are averages of the per-algo scores
+        for label in served.scores:
+            expected = sum(p.scores[label] for p in preds) / len(preds)
+            assert served.scores[label] == pytest.approx(expected)
+
+
+def test_add_algorithm_eval_both_accurate(storage_with_events):
+    """Through the eval workflow, each algorithm's predictions feed the
+    blended serving; the blend must stay accurate on separable classes."""
+    engine = engine_factory()
+    variant = {
+        **ADD_ALGO_VARIANT,
+        "datasource": {
+            "params": {**ADD_ALGO_VARIANT["datasource"]["params"], "eval_k": 2}
+        },
+    }
+    ep = engine.params_from_variant_json(variant)
+    ctx = EngineContext(storage=storage_with_events)
+    results = engine.eval(ctx, ep)
+    correct = total = 0
+    for ei, fold in results:
+        for q, p, a in fold:
+            total += 1
+            correct += int(p.label == a)
+    assert total == 60
+    assert correct / total > 0.85
+
+
+def test_logreg_alone_separates(storage_with_events):
+    """The second learner must stand on its own as well."""
+    variant = {
+        **ADD_ALGO_VARIANT,
+        "algorithms": [{"name": "logreg", "params": {"iterations": 300}}],
+        "serving": {"name": "first"},
+    }
+    storage = storage_with_events
+    outcome = run_train(variant=variant, storage=storage)
+    engine = engine_factory()
+    ep = engine.params_from_variant_json(variant)
+    ctx = EngineContext(storage=storage)
+    models = engine.prepare_deploy(
+        ctx, ep, load_models(storage, outcome.instance_id)
+    )
+    _, _, algos, serving = engine.make_components(ep)
+    q = Query(attrs=(9.0, 3.0, 0.0))
+    assert serving.serve(q, [algos[0].predict(models[0], q)]).label == "premium"
