@@ -225,7 +225,11 @@ class ALSModel:
     def load(directory: str, shardings: dict | None = None) -> "ALSModel":
         """``shardings`` optionally maps "user"/"item" to target
         ``NamedSharding``s so factors restore straight onto a mesh."""
-        has_new = os.path.exists(os.path.join(directory, "checkpoint_meta.json"))
+        # an orbax dir without meta means a crash interrupted save() after
+        # the checkpoint write — still newer than any legacy factors.npz
+        has_new = os.path.exists(
+            os.path.join(directory, "checkpoint_meta.json")
+        ) or os.path.isdir(os.path.join(directory, "orbax"))
         if not has_new and os.path.exists(os.path.join(directory, "factors.npz")):
             # legacy single-file layout
             legacy = np.load(os.path.join(directory, "factors.npz"))

@@ -249,8 +249,12 @@ def train(
     last completed checkpoint instead of epoch 0."""
     inputs, targets = pad_sequences(sequences, cfg.max_len)
     n = inputs.shape[0]
-    # checkpoint identity from the PRE-batch-padding arrays: resume must
-    # survive a batch_size or mesh-topology change after preemption
+    # checkpoint identity from the PRE-batch-padding arrays, so a resume
+    # after a batch_size or mesh-topology change still *loads* (the
+    # fingerprint matches). The continuation is exact only for unchanged
+    # batch/mesh: the replayed rng.permutation stream and the restored
+    # Adam step counter are batch-size-dependent, so a changed batch_size
+    # yields valid training but a different data order/step alignment
     fingerprint = (
         _train_fingerprint(cfg, inputs, targets, lr, seed)
         if checkpoint_dir else None

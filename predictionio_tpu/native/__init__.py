@@ -39,15 +39,25 @@ def _build(src: str, so: str) -> str | None:
             return so
     except OSError:
         pass
+    # compile to a per-pid temp path, then atomically rename into place:
+    # two processes racing on first use must never dlopen a partially
+    # written .so (rename is atomic within the directory)
+    tmp = f"{so}.tmp.{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so, src],
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, so)
         return so
     except (OSError, subprocess.SubprocessError):
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
         return None
 
 

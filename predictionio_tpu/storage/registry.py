@@ -151,6 +151,28 @@ class Storage:
             if k.startswith(_SOURCES_PREFIX + "_") and k.endswith("_TYPE")
             and len(k) > len(_SOURCES_PREFIX) + 1 + len("_TYPE")
         }
+        # a key like PIO_STORAGE_SOURCES_X_FOO_TYPE is ambiguous: source
+        # "X_FOO"'s type, or property "FOO_TYPE" of source "X". When a
+        # shorter source X exists, resolve by the TYPE value: registered
+        # backend types declare a source, anything else stays X's property
+        # (warned, so a typo'd backend name is visible). Names with no
+        # shorter source are kept even when unregistered — external
+        # backends may register after Storage() but before first use.
+        _builtin_backends()
+        for name in sorted(names):
+            shorter = [o for o in names if o != name and name.startswith(o + "_")]
+            if not shorter:
+                continue
+            type_val = self._env[f"{_SOURCES_PREFIX}_{name}_TYPE"]
+            if type_val not in _BACKENDS:
+                logger.warning(
+                    "PIO_STORAGE_SOURCES_%s_TYPE=%r is not a registered "
+                    "backend type; treating it as property %s_TYPE of "
+                    "source %s (registered types: %s)",
+                    name, type_val, name[len(shorter[0]) + 1:], shorter[0],
+                    ", ".join(sorted(_BACKENDS)),
+                )
+                names.discard(name)
         for name in names:
             type_key = f"{_SOURCES_PREFIX}_{name}_TYPE"
             prefix = f"{_SOURCES_PREFIX}_{name}_"

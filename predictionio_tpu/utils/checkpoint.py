@@ -107,14 +107,24 @@ def load_sharded(
 
 
 def _write_meta(directory: str, backend: str) -> None:
-    with open(os.path.join(directory, _META_FILE), "w") as f:
+    # atomic: a crash between the checkpoint write and the meta landing
+    # must never leave a readable-but-stale meta; os.replace is atomic so
+    # readers see either the old complete meta or the new one
+    path = os.path.join(directory, _META_FILE)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump({"backend": backend, "version": 1}, f)
+    os.replace(tmp, path)
 
 
 def _read_meta(directory: str) -> str:
     meta_path = os.path.join(directory, _META_FILE)
     if not os.path.exists(meta_path):
-        # legacy layout (np.savez only)
+        # no meta: prefer a complete orbax checkpoint over legacy npz (a
+        # crash after the orbax write but before the meta landed must not
+        # silently resurrect a stale npz from an earlier save)
+        if os.path.isdir(os.path.join(directory, _ORBAX_SUBDIR)):
+            return "orbax"
         return "npz"
     with open(meta_path) as f:
         return json.load(f).get("backend", "npz")

@@ -88,3 +88,50 @@ def test_memory_backend_registration():
     storage = Storage(env)
     storage.verify_all_data_objects()
     storage.close()
+
+
+def test_type_suffixed_property_demoted_to_shorter_source(tmp_path, caplog):
+    """A property whose name ends in _TYPE (here FOO_TYPE of source MEM)
+    must not spawn a bogus source MEM_FOO when its value is not a
+    registered backend type; it stays MEM's property, with a warning."""
+    import logging
+
+    env = {
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_MEM_FOO_TYPE": "not-a-backend",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    }
+    with caplog.at_level(logging.WARNING, "predictionio_tpu.storage.registry"):
+        storage = Storage(env)
+    assert "not-a-backend" in caplog.text
+    with pytest.raises(StorageError):
+        storage.client_for_source("MEM_FOO")
+    client = storage.client_for_source("MEM")
+    assert client.config.properties.get("FOO_TYPE") == "not-a-backend"
+    storage.close()
+
+
+def test_underscored_source_with_registered_type_still_parses(tmp_path):
+    """A genuinely underscored source name whose TYPE is a registered
+    backend keeps working even when a shorter source shares its prefix."""
+    env = {
+        "PIO_STORAGE_SOURCES_PIO_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_PIO_SQLITE_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_PIO_SQLITE_PATH": str(tmp_path / "db.sqlite"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "PIO_SQLITE",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PIO",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "PIO",
+    }
+    storage = Storage(env)
+    storage.verify_all_data_objects()
+    # PIO must not have swallowed PIO_SQLITE's keys as properties
+    assert "SQLITE_TYPE" not in storage.client_for_source("PIO").config.properties
+    storage.close()
