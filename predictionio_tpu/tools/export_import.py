@@ -1,10 +1,17 @@
-"""Event export/import: event store ↔ JSON-lines files.
+"""Event export/import: event store ↔ JSON-lines or Parquet files.
 
 Parity: tools/src/main/scala/.../tools/{export/EventsToFile.scala:43-108,
 imprt/FileToEvents.scala:43-106} — the reference ran these as Spark
-drivers writing/reading RDDs; here they stream through the host in
-batches (storage I/O is the bound, not compute). File format: one API
-JSON event per line, identical to the reference's json output mode.
+drivers writing/reading RDDs with a json-or-parquet format option
+(EventsToFile.scala:97-105); here they stream through the host in
+batches (storage I/O is the bound, not compute). The json format is one
+API JSON event per line, identical to the reference's json output mode.
+The parquet format is one row per event with the API JSON field names as
+columns; divergence from the reference (documented): `properties` is a
+JSON-encoded string column rather than a Spark-inferred struct — the
+event schema is open, so a string column is the faithful self-describing
+encoding (and round-trips schemalessly), while Spark's struct inference
+could silently widen/conflict across exports.
 """
 
 from __future__ import annotations
@@ -78,4 +85,94 @@ def import_events(
         events_dao.insert_batch(batch, app_id, channel_id)
         n += len(batch)
     logger.info("imported %d events (app %s)", n, app_id)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Parquet format (EventsToFile.scala:97-105 `--format parquet`)
+# ---------------------------------------------------------------------------
+
+# API JSON field name -> column; all strings except tags (list<string>).
+_PARQUET_FIELDS = (
+    "eventId", "event", "entityType", "entityId", "targetEntityType",
+    "targetEntityId", "properties", "eventTime", "tags", "prId",
+    "creationTime",
+)
+
+
+def _parquet_schema():
+    import pyarrow as pa
+
+    return pa.schema(
+        [
+            (name, pa.list_(pa.string()) if name == "tags" else pa.string())
+            for name in _PARQUET_FIELDS
+        ]
+    )
+
+
+def export_events_parquet(
+    storage: Storage,
+    app_id: int,
+    path: str,
+    channel_id: int | None = None,
+) -> int:
+    """Write every event of (app, channel) to one Parquet file; returns
+    count. Batches rows so memory stays flat on large apps."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    schema = _parquet_schema()
+    n = 0
+    with pq.ParquetWriter(path, schema) as writer:
+        rows: list[dict] = []
+
+        def flush():
+            nonlocal n
+            if rows:
+                writer.write_table(pa.Table.from_pylist(rows, schema=schema))
+                n += len(rows)
+                rows.clear()
+
+        for event in storage.get_events().find(app_id, channel_id, EventFilter()):
+            obj = event_to_json(event)
+            obj["properties"] = json.dumps(obj.get("properties", {}))
+            rows.append({f: obj.get(f) for f in _PARQUET_FIELDS})
+            if len(rows) >= _BATCH:
+                flush()
+        flush()
+    logger.info("exported %d events to parquet (app %s)", n, app_id)
+    return n
+
+
+def import_events_parquet(
+    storage: Storage,
+    app_id: int,
+    path: str,
+    channel_id: int | None = None,
+) -> int:
+    """Read a Parquet event file (as written by export_events_parquet)
+    and batch-insert; returns count."""
+    import pyarrow.parquet as pq
+
+    events_dao = storage.get_events()
+    try:
+        pf = pq.ParquetFile(path)
+    except Exception as e:  # ArrowInvalid on non-parquet input
+        raise ImportFormatError(0, f"not a parquet file: {e}", 0)
+    n = 0
+    for rb in pf.iter_batches(batch_size=_BATCH):
+        batch = []
+        for row in rb.to_pylist():
+            obj = {k: v for k, v in row.items() if v is not None}
+            try:
+                if "properties" in obj:
+                    obj["properties"] = json.loads(obj["properties"])
+                batch.append(event_from_json(obj))
+            except Exception as e:
+                raise ImportFormatError(n + len(batch) + 1, str(e), n)
+        if batch:
+            events_dao.insert_batch(batch, app_id, channel_id)
+            n += len(batch)
+    logger.info("imported %d events from parquet (app %s)", n, app_id)
     return n

@@ -278,10 +278,14 @@ def _cmd_adminserver(args, storage) -> int:
 # ---------------------------------------------------------------------------
 
 def _configure_export(sub) -> None:
-    p = sub.add_parser("export", help="export an app's events to a JSON-lines file")
+    p = sub.add_parser(
+        "export", help="export an app's events to a JSON-lines or Parquet file"
+    )
     p.add_argument("--appid", type=int, required=True)
     p.add_argument("--output", required=True)
     p.add_argument("--channel", default=None)
+    # EventsToFile.scala:97-105 format option
+    p.add_argument("--format", choices=("json", "parquet"), default="json")
 
 
 def _resolve_app_channel(storage, app_id: int, channel_name: str | None):
@@ -301,26 +305,45 @@ def _resolve_app_channel(storage, app_id: int, channel_name: str | None):
 
 
 def _cmd_export(args, storage) -> int:
-    from predictionio_tpu.tools.export_import import export_events
+    from predictionio_tpu.tools.export_import import (
+        export_events,
+        export_events_parquet,
+    )
 
     ok, channel_id = _resolve_app_channel(storage, args.appid, args.channel)
     if not ok:
         return 1
-    with open(args.output, "w") as f:
-        n = export_events(storage, args.appid, f, channel_id)
+    if getattr(args, "format", "json") == "parquet":
+        try:
+            n = export_events_parquet(storage, args.appid, args.output, channel_id)
+        except ImportError:
+            print("[ERROR] Parquet support requires pyarrow "
+                  "(pip install 'predictionio-tpu[parquet]').")
+            return 1
+    else:
+        with open(args.output, "w") as f:
+            n = export_events(storage, args.appid, f, channel_id)
     print(f"[INFO] Exported {n} events to {args.output}")
     return 0
 
 
 def _configure_import(sub) -> None:
-    p = sub.add_parser("import", help="import events from a JSON-lines file")
+    p = sub.add_parser(
+        "import", help="import events from a JSON-lines or Parquet file"
+    )
     p.add_argument("--appid", type=int, required=True)
     p.add_argument("--input", required=True)
     p.add_argument("--channel", default=None)
+    p.add_argument("--format", choices=("json", "parquet"), default=None,
+                   help="default: parquet for .parquet files, else json")
 
 
 def _cmd_import(args, storage) -> int:
-    from predictionio_tpu.tools.export_import import ImportFormatError, import_events
+    from predictionio_tpu.tools.export_import import (
+        ImportFormatError,
+        import_events,
+        import_events_parquet,
+    )
 
     ok, channel_id = _resolve_app_channel(storage, args.appid, args.channel)
     if not ok:
@@ -328,11 +351,21 @@ def _cmd_import(args, storage) -> int:
     if not os.path.exists(args.input):
         print(f"[ERROR] {args.input} not found.")
         return 1
+    fmt = getattr(args, "format", None) or (
+        "parquet" if args.input.endswith(".parquet") else "json"
+    )
     try:
-        with open(args.input) as f:
-            n = import_events(storage, args.appid, f, channel_id)
+        if fmt == "parquet":
+            n = import_events_parquet(storage, args.appid, args.input, channel_id)
+        else:
+            with open(args.input) as f:
+                n = import_events(storage, args.appid, f, channel_id)
     except ImportFormatError as e:
         print(f"[ERROR] {args.input}: {e}")
+        return 1
+    except ImportError:
+        print("[ERROR] Parquet support requires pyarrow "
+              "(pip install 'predictionio-tpu[parquet]').")
         return 1
     print(f"[INFO] Imported {n} events from {args.input}")
     return 0
