@@ -9,6 +9,8 @@ replacement for the reference's json4s/Gson JsonExtractor duality).
 from __future__ import annotations
 
 import dataclasses
+import keyword
+import re
 from typing import Any, Sequence, Type, TypeVar
 
 P = TypeVar("P")
@@ -25,17 +27,38 @@ class EmptyParams(Params):
     """Parity: EmptyParams (Params.scala:35-37)."""
 
 
+def _snake(name: str) -> str:
+    """camelCase -> snake_case; appends "_" when the result is a Python
+    keyword ("lambda" -> "lambda_", matching the reference templates'
+    ALS params)."""
+    out = re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", name).lower()
+    return out + "_" if keyword.iskeyword(out) else out
+
+
 def params_from_json(params_class: Type[P], obj: dict[str, Any] | None) -> P:
     """Bind a JSON object to a Params dataclass by field name.
 
-    Unknown JSON keys are rejected (catching typos in engine.json — the
-    reference got this from json4s strict extraction); missing keys fall
-    back to dataclass defaults.
+    Reference engine.json files use camelCase keys ("numIterations",
+    "appName", "lambda"); fields here are snake_case — camelCase keys
+    bind through a snake_case conversion so existing variant files work
+    unchanged. Genuinely unknown keys are rejected (catching typos in
+    engine.json — the reference got this from json4s strict extraction);
+    missing keys fall back to dataclass defaults.
     """
     obj = obj or {}
     if not dataclasses.is_dataclass(params_class):
         raise TypeError(f"{params_class} must be a dataclass")
     field_names = {f.name for f in dataclasses.fields(params_class)}
+    renamed = {}
+    for k, v in obj.items():
+        key = k if k in field_names else _snake(k)
+        if key in renamed:
+            raise ValueError(
+                f"Duplicate parameter {key!r} for {params_class.__name__} "
+                f"(camelCase and snake_case forms both present)"
+            )
+        renamed[key] = v
+    obj = renamed
     unknown = set(obj) - field_names
     if unknown:
         raise ValueError(

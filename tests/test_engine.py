@@ -120,6 +120,28 @@ def test_params_from_json_binding():
     assert params_from_json(DSParams, None) == DSParams()
 
 
+def test_params_from_json_camel_case():
+    """Reference engine.json files use camelCase keys (numIterations,
+    lambda, appName) — they must bind to the snake_case fields."""
+    from predictionio_tpu.templates.recommendation import ALSAlgorithmParams
+
+    p = params_from_json(
+        ALSAlgorithmParams,
+        {"rank": 5, "numIterations": 7, "lambda": 0.25, "implicitPrefs": True},
+    )
+    assert (p.rank, p.num_iterations, p.lambda_, p.implicit_prefs) == (
+        5, 7, 0.25, True,
+    )
+    # camelCase typos still rejected
+    with pytest.raises(ValueError, match="num_iteratons"):
+        params_from_json(ALSAlgorithmParams, {"numIteratons": 3})
+    # both spellings of one field at once is ambiguous
+    with pytest.raises(ValueError, match="Duplicate"):
+        params_from_json(
+            ALSAlgorithmParams, {"numIterations": 3, "num_iterations": 4}
+        )
+
+
 def test_variant_json_to_engine_params(ctx):
     engine = make_engine()
     variant = {
