@@ -10,15 +10,37 @@ have a drop-in, but new code should use EventStore + the Preparator.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import inspect
+import logging
+import os
 import warnings
 from datetime import datetime
 from typing import Any, Callable, Iterable, TypeVar
 
 from predictionio_tpu.core.aggregation import aggregate_properties
-from predictionio_tpu.core.datamap import PropertyMap
+from predictionio_tpu.core.datamap import DataMap, PropertyMap
 from predictionio_tpu.core.event import Event
 
 T = TypeVar("T")
+logger = logging.getLogger(__name__)
+
+
+def data_map_aggregator() -> Callable[[DataMap | None, Event], DataMap | None]:
+    """The $set/$unset/$delete step function over an optional DataMap —
+    ViewAggregators.getDataMapAggregator (LBatchView.scala:77-101)."""
+
+    def op(acc: DataMap | None, e: Event) -> DataMap | None:
+        if e.event == "$set":
+            return e.properties if acc is None else acc + e.properties
+        if e.event == "$unset":
+            return None if acc is None else acc - e.properties.keys()
+        if e.event == "$delete":
+            return None
+        return acc
+
+    return op
 
 
 class BatchView:
@@ -40,6 +62,23 @@ class BatchView:
     # -- predicates (ViewPredicates parity) ---------------------------------
     def filter(self, predicate: Callable[[Event], bool]) -> "BatchView":
         return BatchView((e for e in self._events if predicate(e)), _warned=True)
+
+    def filter_by(
+        self,
+        event: str | None = None,
+        entity_type: str | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+    ) -> "BatchView":
+        """Keyword-predicate filter — EventSeq.filter(eventOpt,
+        entityTypeOpt, startTimeOpt, untilTimeOpt) (LBatchView.scala:
+        117-128); ``None`` matches everything, times are [start, until)."""
+        return self.filter(
+            lambda e: (event is None or e.event == event)
+            and (entity_type is None or e.entity_type == entity_type)
+            and (start_time is None or e.event_time >= start_time)
+            and (until_time is None or e.event_time < until_time)
+        )
 
     def event_name(self, name: str) -> "BatchView":
         return self.filter(lambda e: e.event == name)
@@ -83,3 +122,96 @@ class BatchView:
         for e in self._events:
             acc = op(acc, e)
         return acc
+
+    def aggregate_by_entity_ordered(
+        self, init: T, op: Callable[[T, Event], T]
+    ) -> dict[str, T]:
+        """Per-entityId time-ordered fold — EventSeq.
+        aggregateByEntityOrdered (LBatchView.scala:134-140): group by
+        entity id, sort each group by event time, foldLeft with ``op``."""
+        groups: dict[str, list[Event]] = {}
+        for e in self._events:
+            groups.setdefault(e.entity_id, []).append(e)
+        out: dict[str, T] = {}
+        for entity_id, evs in groups.items():
+            acc = init
+            for e in sorted(evs, key=lambda e: e.event_time):
+                acc = op(acc, e)
+            out[entity_id] = acc
+        return out
+
+
+def create_data_view(
+    app_name: str,
+    conversion: Callable[[Event], Any | None],
+    *,
+    name: str = "",
+    version: str = "",
+    channel_name: str | None = None,
+    start_time: datetime | None = None,
+    until_time: datetime | None = None,
+    storage=None,
+    base_dir: str | None = None,
+):
+    """Cached columnar view of converted events — DataView.create
+    (DataView.scala:61-112): read events, map each through
+    ``conversion`` (``None`` results are dropped), persist the result as
+    a Parquet file fingerprinted by (time range, ``version``, and the
+    conversion function's source), and return the cached
+    ``pyarrow.Table`` on later calls.
+
+    ``conversion`` may return a dataclass, mapping, or tuple; rows must
+    be homogeneous. Divergence from the reference: DataView.scala keys
+    the cache on ``DateTime.now()`` when ``untilTime`` is absent, so its
+    cache can never hit; here an absent ``until_time`` simply bypasses
+    the cache (fresh read every call) and caching requires an explicit,
+    stable ``until_time``. The conversion fingerprint uses the
+    function's source text (via inspect) where Scala used the case
+    class serialVersionUID."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from predictionio_tpu.data.store import EventStore
+
+    store = EventStore(storage) if storage is not None else EventStore()
+
+    cache_path = None
+    if until_time is not None:
+        try:
+            src = inspect.getsource(conversion)
+        except (OSError, TypeError):
+            # source unavailable (REPL/stdin/builtin): key on the stable
+            # qualified name — never repr(), whose memory address would
+            # defeat the cache across processes
+            src = (f"{getattr(conversion, '__module__', '?')}."
+                   f"{getattr(conversion, '__qualname__', repr(type(conversion)))}")
+        key = hashlib.md5(
+            f"{channel_name}-{start_time}-{until_time}-{version}-{src}".encode()
+        ).hexdigest()[:16]
+        base = base_dir or os.path.join(
+            os.environ.get("PIO_FS_BASEDIR",
+                           os.path.expanduser("~/.pio_store")), "view")
+        cache_path = os.path.join(base, f"{name}-{app_name}-{key}.parquet")
+        if os.path.exists(cache_path):
+            return pq.read_table(cache_path)
+        logger.info("cached copy not found, reading from the event store")
+
+    rows = []
+    for e in store.find(app_name, channel_name=channel_name,
+                        start_time=start_time, until_time=until_time):
+        row = conversion(e)
+        if row is None:
+            continue
+        if dataclasses.is_dataclass(row):
+            row = dataclasses.asdict(row)
+        elif not isinstance(row, dict):
+            row = {f"f{i}": v for i, v in enumerate(row)}
+        rows.append(row)
+    table = pa.Table.from_pylist(rows)
+    if cache_path is not None:
+        os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+        tmp = f"{cache_path}.tmp.{os.getpid()}"
+        pq.write_table(table, tmp)
+        os.replace(tmp, cache_path)
+        return pq.read_table(cache_path)
+    return table

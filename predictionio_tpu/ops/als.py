@@ -208,6 +208,35 @@ def _bucket_rows_native(
         lib.pio_bucketize_free(handle)
 
 
+def half_step_flops(
+    bucketed: BucketedRatings,
+    rank: int,
+    data_axis: int = 1,
+    max_slab_elems: int = 1 << 24,
+) -> dict[str, float]:
+    """Useful vs executed FLOPs for one ALS half-step on this layout.
+
+    Useful work per *real* rating entry: the normal-equation build costs
+    ``2K²`` FLOPs (outer-product accumulate into A) plus ``2K`` (rhs);
+    per active row the solve costs ``K³/3`` (Cholesky) + ``2K²`` (two
+    triangular solves). Executed work replaces real entries with padded
+    slab entries (row padding to ``pad_len`` and slab-shape rounding from
+    :func:`_slab_shape`), which is what the MXU actually runs. The ratio
+    ``executed / useful`` is the padding overhead of the bucket layout —
+    the quantity the bucket-config sweep (bench.py --sweep) minimises
+    against raw throughput."""
+    k = float(rank)
+    per_entry = 2.0 * k * k + 2.0 * k
+    per_solve = (k ** 3) / 3.0 + 2.0 * k * k
+    useful = executed = 0.0
+    for b in bucketed.buckets:
+        n = int(b.row_ids.shape[0])
+        useful += float(b.deg.sum()) * per_entry + n * per_solve
+        s, rows = _slab_shape(n, b.pad_len, rank, data_axis, max_slab_elems)
+        executed += float(s * rows) * (b.pad_len * per_entry + per_solve)
+    return {"useful_flops": useful, "executed_flops": executed}
+
+
 # ---------------------------------------------------------------------------
 # Device staging: pad buckets into slabs ONCE, keep them HBM-resident
 # ---------------------------------------------------------------------------

@@ -13,6 +13,7 @@ from predictionio_tpu.ops.als import (
     RatingsCOO,
     als_train,
     bucket_rows,
+    half_step_flops,
     predict_ratings,
     rmse,
     solve_half,
@@ -76,6 +77,27 @@ class TestBucketing:
         b = bucketed.buckets[0]
         kept = set(b.cols[0][b.mask[0] > 0].tolist())
         assert kept == {6, 7, 8, 9}
+
+    def test_half_step_flops_accounting(self):
+        # two rows of degree 3 and 5 pad to lengths 4 and 8 (growth 2)
+        rows = np.repeat(np.array([0, 1], dtype=np.int32), [3, 5])
+        cols = np.arange(8, dtype=np.int32)
+        vals = np.ones(8, dtype=np.float32)
+        coo = RatingsCOO(rows, cols, vals, 2, 8)
+        bucketed = bucket_rows(coo, min_len=4, growth=2)
+        K = 4
+        fl = half_step_flops(bucketed, K)
+        per_entry = 2 * K * K + 2 * K
+        per_solve = K**3 / 3 + 2 * K * K
+        assert fl["useful_flops"] == pytest.approx(
+            8 * per_entry + 2 * per_solve
+        )
+        assert fl["executed_flops"] == pytest.approx(
+            (4 + 8) * per_entry + 2 * per_solve
+        )
+        # padding overhead strictly bounded by the growth factor on the
+        # matmul term; executed >= useful always
+        assert fl["executed_flops"] >= fl["useful_flops"]
 
 
 class TestSolve:

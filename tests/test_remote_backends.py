@@ -7,6 +7,15 @@ the ``client``/``events_client`` fixtures against an in-process fake ES
 server that implements the document-CRUD subset of the ES 5.x REST API
 the client speaks. S3 is tested against a fake object-store HTTP server
 that checks SigV4 headers are present; hdfs against tmp_path.
+
+LIMITATION: the fakes implement exactly the protocol subset the clients
+emit, so they prove client-side logic (routing, serialization, scroll
+paging, SigV4 shape) but cannot catch drift against a *real* ES 5.x or
+S3 endpoint (e.g. server-side validation, pagination corner cases,
+error bodies). This environment has no network egress and no dockerized
+services; run the same conformance suite against live services before
+relying on these backends in production (the suite takes real endpoints
+via PIO_STORAGE_SOURCES_* env, storage/registry.py).
 """
 
 from __future__ import annotations

@@ -61,6 +61,101 @@ class TestBatchView:
         count = v.fold(0, lambda acc, e: acc + 1)
         assert count == 5
 
+    def test_filter_by_keywords(self):
+        v = self._view()
+        assert len(v.filter_by(event="buy")) == 2
+        assert len(v.filter_by(event="buy", until_time=T0 + timedelta(minutes=15))) == 1
+        assert len(v.filter_by(entity_type="user")) == 5
+        assert len(v.filter_by(start_time=T0 + timedelta(minutes=10))) == 3
+
+    def test_aggregate_by_entity_ordered(self):
+        # fold arrives time-ordered even when the view is unordered
+        with pytest.warns(DeprecationWarning):
+            v = BatchView([
+                _ev("buy", "u1", 30, {"n": 3}),
+                _ev("buy", "u1", 10, {"n": 1}),
+                _ev("buy", "u2", 5, {"n": 9}),
+                _ev("buy", "u1", 20, {"n": 2}),
+            ])
+        seqs = v.aggregate_by_entity_ordered(
+            (), lambda acc, e: acc + (e.properties["n"],)
+        )
+        assert seqs == {"u1": (1, 2, 3), "u2": (9,)}
+
+    def test_data_map_aggregator_steps(self):
+        from predictionio_tpu.data.view import data_map_aggregator
+
+        op = data_map_aggregator()
+        acc = op(None, _ev("$set", "u", 0, {"a": 1, "b": 2}))
+        acc = op(acc, _ev("$set", "u", 1, {"a": 5}))
+        assert dict(acc) == {"a": 5, "b": 2}
+        acc = op(acc, _ev("$unset", "u", 2, {"b": 0}))
+        assert dict(acc) == {"a": 5}
+        assert op(acc, _ev("$delete", "u", 3)) is None
+        assert op(None, _ev("buy", "u", 4)) is None
+
+
+class TestDataView:
+    """create_data_view: conversion + parquet cache (DataView.scala:61-112)."""
+
+    @pytest.fixture
+    def app_events(self, storage):
+        from predictionio_tpu.storage.base import App
+
+        app_id = storage.get_meta_data_apps().insert(App(0, "ViewApp"))
+        events = storage.get_events()
+        events.init(app_id)
+        for j, (u, r) in enumerate([("u1", 4.0), ("u2", 2.0), ("u3", 5.0)]):
+            events.insert(
+                Event(
+                    event="rate", entity_type="user", entity_id=u,
+                    target_entity_type="item", target_entity_id=f"i{j}",
+                    properties=DataMap({"rating": r}),
+                    event_time=T0 + timedelta(minutes=j),
+                ),
+                app_id,
+            )
+        return storage
+
+    def test_conversion_drop_and_cache(self, app_events, tmp_path):
+        from predictionio_tpu.data.view import create_data_view
+
+        def conv(e):
+            r = e.properties.get("rating")
+            return {"user": e.entity_id, "rating": r} if r >= 3.0 else None
+
+        until = T0 + timedelta(hours=1)
+        kw = dict(storage=app_events, base_dir=str(tmp_path), name="rates",
+                  version="1", until_time=until)
+        t = create_data_view("ViewApp", conv, **kw)
+        assert t.num_rows == 2
+        assert sorted(t.column("user").to_pylist()) == ["u1", "u3"]
+        cached = list(tmp_path.iterdir())
+        assert len(cached) == 1 and cached[0].suffix == ".parquet"
+
+        # second call is served from the cache: new events don't appear
+        app = app_events.get_meta_data_apps().get_by_name("ViewApp")
+        app_events.get_events().insert(
+            Event(event="rate", entity_type="user", entity_id="u9",
+                  properties=DataMap({"rating": 5.0}), event_time=T0),
+            app.id,
+        )
+        t2 = create_data_view("ViewApp", conv, **kw)
+        assert t2.num_rows == 2
+        # a changed version busts the cache
+        t3 = create_data_view("ViewApp", conv, **{**kw, "version": "2"})
+        assert t3.num_rows == 3
+
+    def test_no_until_time_bypasses_cache(self, app_events, tmp_path):
+        from predictionio_tpu.data.view import create_data_view
+
+        t = create_data_view(
+            "ViewApp", lambda e: {"u": e.entity_id},
+            storage=app_events, base_dir=str(tmp_path),
+        )
+        assert t.num_rows == 3
+        assert list(tmp_path.iterdir()) == []
+
 
 class TestDistributedInit:
     def test_noop_single_host(self, monkeypatch):
