@@ -9,7 +9,7 @@ round is comparable on all axes (VERDICT r1 items 1, 2, 7, 10):
   rank 32, full alternating iterations, min-of-N over ``REPS`` timed
   repeats with the relative spread reported (this host's load varies).
 - ``mfu_pct``/``useful_tflops``/``padding_x`` — useful-FLOP model
-  utilisation and the bucket-padding overhead (ops/als.half_step_flops);
+  utilisation and the layout-padding overhead (ops/als.half_step_flops);
   "useful" counts only real rating entries, so padding work earns no
   credit. MFU is quoted against the chip's headline dense bf16 peak
   even though the normal equations run f32-HIGHEST (which cannot reach
@@ -30,15 +30,16 @@ round is comparable on all axes (VERDICT r1 items 1, 2, 7, 10):
   bf16) so its perf claims are measured round-over-round.
 
 Baseline (``vs_baseline``): Spark/MLlib cannot run here (no JVM), so
-the Spark-on-CPU comparable is a measured proxy: the identical bucketed
-solves in single-process NumPy on a subsample (size-normalised rate),
-scaled by this host's core count as if Spark local[N] scaled perfectly
-with zero overhead — strictly generous to Spark, so ``vs_baseline`` is
-a lower bound on the real ratio. The BASELINE.md gate is >=10x.
+the Spark-on-CPU comparable is a measured proxy: a single-process NumPy
+ALS-WR iteration (segment reductions — pure useful work) on a
+subsample (size-normalised rate), scaled by this host's core count as
+if Spark local[N] scaled perfectly with zero overhead — strictly
+generous to Spark, so ``vs_baseline`` is a lower bound on the real
+ratio. The BASELINE.md gate is >=10x.
 
-``--sweep`` re-measures the bucket-layout grid (growth x min_len x cap)
-and prints one JSON line per config (throughput, padding overhead,
-MFU) — the data behind the README bucket table.
+``--sweep`` re-measures the chunk-layout grid and prints one JSON line
+per config (throughput, padding overhead, MFU) — the data behind the
+README layout table.
 """
 
 from __future__ import annotations
@@ -58,15 +59,22 @@ NNZ = 20_000_000
 RANK = 32
 LAM = 0.08
 REPS = 5
-ITERS = 2
 SUB_NNZ = 500_000   # numpy-baseline subsample (rate is size-normalised)
 SERVE_QUERIES = 500
 SERVE_WARMUP = 20
 
-# Chosen by `bench.py --sweep` on TPU v5e (see README bucket table):
-# growth=2 bounds padding at <2x; uncapped rows keep every rating (a
-# 1024 cap silently drops 14% of the item half at this skew).
-BUCKET_KW = dict(min_len=16, growth=2, max_len=None)
+# Chosen by `bench.py --sweep` on TPU v5e (see README layout table):
+# fixed-size chunks, MXU-width contraction, zero dropped ratings.
+CHUNK_SIZES = (512, 128)
+
+# MEASUREMENT PROTOCOL (critical on remote-attached devices): on the
+# axon tunnel, jax.block_until_ready can return before the computation
+# actually executes — chained f32 matmuls "measured" 20 PFLOP/s that
+# way. Every timing below therefore forces real execution by fetching a
+# scalar reduction of the full result (float(jnp.sum(...))), and
+# per-iteration time comes from the difference of a long and a short
+# chain, which cancels the fetch's round-trip latency.
+N_SHORT, N_LONG = 2, 10
 
 # headline dense bf16 peak per chip (MFU denominator)
 _PEAK_BF16 = {
@@ -101,27 +109,21 @@ def _device_peak():
 # ---------------------------------------------------------------------------
 
 
-def bench_als(users, items, vals, bucket_kw=BUCKET_KW, reps=REPS, iters=ITERS):
+def bench_als(users, items, vals, chunk_sizes=CHUNK_SIZES, reps=REPS):
     import jax
     import jax.numpy as jnp
 
     from predictionio_tpu.ops.als import (
         RatingsCOO,
-        bucket_rows,
+        chunk_rows,
         half_step_flops,
         solve_half,
-        stage_buckets,
+        stage_chunks,
     )
 
     coo = RatingsCOO(users, items, vals, USERS, ITEMS)
-    by_user = bucket_rows(coo, **bucket_kw)
-    by_item = bucket_rows(coo.transpose(), **bucket_kw)
-
-    # ratings actually processed per full iteration (capped configs drop
-    # tail entries of heavy rows; the rate must not credit dropped work)
-    proc_user = sum(int(b.deg.sum()) for b in by_user.buckets)
-    proc_item = sum(int(b.deg.sum()) for b in by_item.buckets)
-    effective_nnz = (proc_user + proc_item) / 2.0
+    by_user = chunk_rows(coo, chunk_sizes)
+    by_item = chunk_rows(coo.transpose(), chunk_sizes)
 
     fl_u = half_step_flops(by_user, RANK)
     fl_i = half_step_flops(by_item, RANK)
@@ -133,26 +135,27 @@ def bench_als(users, items, vals, bucket_kw=BUCKET_KW, reps=REPS, iters=ITERS):
         np.float32
     )
     item_f = jax.device_put(jnp.asarray(item_f0))
-    dev_user = stage_buckets(by_user, RANK)
-    dev_item = stage_buckets(by_item, RANK)
+    dev_user = stage_chunks(by_user, RANK)
+    dev_item = stage_chunks(by_item, RANK)
 
-    def iteration(item_f):
-        user_f = solve_half(item_f, dev_user, RANK, LAM)
-        item_f = solve_half(user_f, dev_item, RANK, LAM)
-        return user_f, item_f
+    def run(n):
+        """n chained full iterations ending in a forcing scalar fetch."""
+        cur = item_f
+        for _ in range(n):
+            user_f = solve_half(cur, dev_user, RANK, LAM)
+            cur = solve_half(user_f, dev_item, RANK, LAM)
+        return float(jnp.sum(jnp.abs(cur))), user_f, cur
 
-    # warm-up compiles every bucket-shape kernel
-    user_f, item_w = iteration(item_f)
-    jax.block_until_ready(item_w)
-
+    run(1)  # compile warm-up
     iter_times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        cur = item_f
-        for _ in range(iters):
-            user_f, cur = iteration(cur)
-        jax.block_until_ready(cur)
-        iter_times.append((time.perf_counter() - t0) / iters)
+        run(N_SHORT)
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _, user_f, cur = run(N_LONG)
+        t_long = time.perf_counter() - t0
+        iter_times.append((t_long - t_short) / (N_LONG - N_SHORT))
     best = min(iter_times)
     mean = statistics.fmean(iter_times)
     stdev_pct = (
@@ -161,11 +164,10 @@ def bench_als(users, items, vals, bucket_kw=BUCKET_KW, reps=REPS, iters=ITERS):
 
     kind, peak = _device_peak()
     result = {
-        "rate": effective_nnz / best,
+        "rate": NNZ / best,
         "iter_ms": round(best * 1e3, 3),
         "stdev_pct": round(stdev_pct, 1),
         "reps": reps,
-        "effective_nnz": int(effective_nnz),
         "useful_tflops": round(useful / best / 1e12, 2),
         "padding_x": round(executed / useful, 2),
         "device": kind,
@@ -181,36 +183,19 @@ def bench_als(users, items, vals, bucket_kw=BUCKET_KW, reps=REPS, iters=ITERS):
 # ---------------------------------------------------------------------------
 
 
-def numpy_half_solve(V, bucketed, rank, lam):
-    """The same bucketed ALS-WR half-step in single-process NumPy."""
-    out = np.zeros((bucketed.num_rows, rank), dtype=np.float32)
-    eye = np.eye(rank, dtype=np.float32)
-    for b in bucketed.buckets:
-        F = V[b.cols]                        # (n, L, K)
-        Fm = F * b.mask[..., None]
-        A = np.einsum("blk,blm->bkm", Fm, F)
-        n_u = b.mask.sum(axis=1)
-        A = A + (lam * n_u)[:, None, None] * eye
-        rhs = np.einsum("bl,blk->bk", b.vals * b.mask, F)
-        A[n_u == 0] = eye
-        x = np.linalg.solve(A, rhs[..., None])[..., 0]
-        x[n_u == 0] = 0.0
-        out[b.row_ids] = x
-    return out
+def bench_numpy_baseline(users, items, vals):
+    """Single-core NumPy ALS-WR iteration (segment reductions, zero
+    padding — the useful work a CPU executor actually does), scaled by
+    core count as a Spark local[N] perfect-scaling proxy."""
+    from predictionio_tpu.e2.quality import _segment_half_solve
 
-
-def bench_numpy_baseline(users, items, vals, bucket_kw=BUCKET_KW):
-    from predictionio_tpu.ops.als import RatingsCOO, bucket_rows
-
-    sub = RatingsCOO(users[:SUB_NNZ], items[:SUB_NNZ], vals[:SUB_NNZ],
-                     USERS, ITEMS)
-    sub_user = bucket_rows(sub, **bucket_kw)
-    sub_item = bucket_rows(sub.transpose(), **bucket_kw)
+    s_users, s_items, s_vals = (users[:SUB_NNZ], items[:SUB_NNZ],
+                                vals[:SUB_NNZ])
     rng = np.random.default_rng(1)
     V0 = (rng.standard_normal((ITEMS, RANK)) / np.sqrt(RANK)).astype(np.float32)
     t0 = time.perf_counter()
-    uf = numpy_half_solve(V0, sub_user, RANK, LAM)
-    numpy_half_solve(uf, sub_item, RANK, LAM)
+    uf = _segment_half_solve(V0, s_users, s_items, s_vals, USERS, LAM)
+    _segment_half_solve(uf, s_items, s_users, s_vals, ITEMS, LAM)
     one_core_rate = SUB_NNZ / (time.perf_counter() - t0)
     cores = os.cpu_count() or 1
     return {
@@ -218,8 +203,9 @@ def bench_numpy_baseline(users, items, vals, bucket_kw=BUCKET_KW):
         "baseline_rate": round(one_core_rate * cores, 1),
         "baseline_cores": cores,
         "baseline": (
-            f"single-process NumPy of the same solves x {cores} cores "
-            "(Spark local[N] perfect-scaling proxy; generous to Spark)"
+            f"single-process NumPy ALS-WR (segment reductions) x {cores} "
+            "core(s) (Spark local[N] perfect-scaling proxy; generous to "
+            "Spark)"
         ),
     }
 
@@ -232,6 +218,9 @@ def bench_numpy_baseline(users, items, vals, bucket_kw=BUCKET_KW):
 def bench_serving(user_f, item_f, users, items, n_queries=SERVE_QUERIES):
     import datetime
     import urllib.request
+
+    import jax
+    import jax.numpy as jnp
 
     from predictionio_tpu.api.engine_server import EngineServer
     from predictionio_tpu.controller.base import FirstServing
@@ -258,8 +247,9 @@ def bench_serving(user_f, item_f, users, items, n_queries=SERVE_QUERIES):
 
     model = ALSModel(
         rank=RANK,
-        user_factors=user_f,
-        item_factors=item_f,
+        # device-resident factors: np arrays would re-upload per query
+        user_factors=jax.device_put(jnp.asarray(user_f)),
+        item_factors=jax.device_put(jnp.asarray(item_f)),
         user_ids=user_ids,
         item_ids=item_ids,
         seen_by_user=seen_by_user,
@@ -343,20 +333,27 @@ def bench_seqrec(steps: int = 20, batch: int = 64):
     seqs = rng.integers(1, v, size=(batch, s), dtype=np.int64).astype(np.int32)
     targets = rng.integers(1, v, size=(batch, s), dtype=np.int64).astype(np.int32)
 
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    opt_m = jax.tree.map(jnp.zeros_like, params)
-    opt_v = jax.tree.map(jnp.zeros_like, params)
+    params0 = init_params(jax.random.PRNGKey(0), cfg)
+    opt_m0 = jax.tree.map(jnp.zeros_like, params0)
+    opt_v0 = jax.tree.map(jnp.zeros_like, params0)
     step_fn = make_train_step(cfg)
 
-    params, opt_m, opt_v, loss = step_fn(
-        params, opt_m, opt_v, 1, seqs, targets, 1e-3)
-    jax.block_until_ready(loss)
+    def run(n):
+        """n chained steps; the final loss fetch forces the whole chain
+        (see the measurement-protocol note at the top)."""
+        params, opt_m, opt_v = params0, opt_m0, opt_v0
+        for i in range(n):
+            params, opt_m, opt_v, loss = step_fn(
+                params, opt_m, opt_v, i + 1, seqs, targets, 1e-3)
+        return float(loss)
+
+    run(1)  # compile
     t0 = time.perf_counter()
-    for i in range(steps):
-        params, opt_m, opt_v, loss = step_fn(
-            params, opt_m, opt_v, i + 2, seqs, targets, 1e-3)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    run(2)
+    t_short = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    loss = run(2 + steps)
+    dt = (time.perf_counter() - t0) - t_short
 
     tokens = batch * s * steps
     # fwd FLOPs/token: per layer qkv 6d^2 + wo 2d^2 + mlp 16d^2 (mult 4)
@@ -374,24 +371,16 @@ def bench_seqrec(steps: int = 20, batch: int = 64):
 
 
 # ---------------------------------------------------------------------------
-# Bucket-layout sweep (README table; VERDICT r1 item 3)
+# Chunk-layout sweep (README table; VERDICT r1 item 3)
 # ---------------------------------------------------------------------------
 
 
 def sweep():
     users, items, vals = make_ratings(NNZ)
-    configs = [
-        dict(min_len=8, growth=2, max_len=None),
-        dict(min_len=16, growth=2, max_len=None),
-        dict(min_len=64, growth=2, max_len=None),
-        dict(min_len=16, growth=4, max_len=None),
-        dict(min_len=64, growth=4, max_len=None),
-        dict(min_len=128, growth=8, max_len=None),
-        dict(min_len=128, growth=8, max_len=1024),  # round-1 config
-    ]
-    for kw in configs:
-        res, _, _ = bench_als(users, items, vals, bucket_kw=kw, reps=3)
-        print(json.dumps({"config": kw, **res}), flush=True)
+    for sizes in [(1024, 128), (2048, 256), (512, 128), (1024, 256),
+                  (4096, 512, 128)]:
+        res, _, _ = bench_als(users, items, vals, chunk_sizes=sizes, reps=3)
+        print(json.dumps({"chunk_sizes": sizes, **res}), flush=True)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,35 @@
+"""Seed CustomPreparatorApp with rate events (two taste clusters) through
+the storage API. Run after `pio app new CustomPreparatorApp`."""
+
+import sys
+
+import numpy as np
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.registry import Storage
+
+storage = Storage.default()
+app = storage.get_meta_data_apps().get_by_name("CustomPreparatorApp")
+if app is None:
+    sys.exit("app 'CustomPreparatorApp' not found — run `pio app new CustomPreparatorApp` first")
+
+events = storage.get_events()
+rng = np.random.default_rng(5)
+n = 0
+for u in range(16):
+    for i in range(12):
+        if i % 2 == u % 2 and rng.random() < 0.9:
+            events.insert(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5.0}),
+                ),
+                app.id,
+            )
+            n += 1
+print(f"seeded {n} rate events into CustomPreparatorApp (app id {app.id})")

@@ -125,8 +125,8 @@ class ALSModel:
         buf[1 : 1 + len(seen)] = seen
         buf[1 + _SEEN_PAD : 1 + _SEEN_PAD + len(seen)] = 1
         # one jitted dispatch, one upload, one download end-to-end; B=1
-        # always takes the XLA kernel — pallas engages only for batched
-        # prediction (batch_predict) at catalog scale
+        # always takes the flat XLA kernel — the chunked-scan dispatch
+        # engages only for batched prediction (batch_predict) at scale
         out = np.asarray(_serve_recommend(
             self.user_factors, self.item_factors, jnp.asarray(buf),
             allow_v, k,

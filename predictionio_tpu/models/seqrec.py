@@ -98,9 +98,15 @@ def forward(
     cfg: SeqRecConfig,
     mesh: Mesh | None = None,
     seq_axis: str = "seq",
+    inference: bool = False,
 ) -> jax.Array:
     """Hidden states (B, S, D) in cfg.dtype. When ``mesh`` has a
-    ``seq_axis``, attention runs as ring attention over it."""
+    ``seq_axis``, attention runs as ring attention over it.
+
+    ``inference=True`` routes single-device attention through the
+    pallas flash kernel's auto-dispatch (ops/pallas_attention — wins
+    from S=2048, the only path at S=16384; forward-only, so training
+    keeps the XLA formulation)."""
     B, S = seqs.shape
     d, H = cfg.d_model, cfg.n_heads
     hd = d // H
@@ -125,6 +131,10 @@ def forward(
         if use_ring:
             att = ring_attention(q, k, v, mesh, seq_axis=seq_axis,
                                  causal=True, kv_mask=mask)
+        elif inference:
+            from predictionio_tpu.ops.pallas_attention import flash_attention
+
+            att = flash_attention(q, k, v, causal=True, kv_mask=mask)
         else:
             att = full_attention(q, k, v, causal=True, kv_mask=mask)
         att = att.transpose(0, 2, 1, 3).reshape(B, S, d)
@@ -415,7 +425,7 @@ def predict_topk_batch(
     carries its own seen/black-list exclusions."""
     mask = (history != PAD)
     last = jnp.maximum(jnp.sum(mask, axis=1) - 1, 0)
-    h = forward(params, history, cfg)
+    h = forward(params, history, cfg, inference=True)
     hl = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
     logits = jnp.einsum("bd,vd->bv", hl, params["item_emb"].astype(h.dtype),
                         preferred_element_type=jnp.float32)

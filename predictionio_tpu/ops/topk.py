@@ -127,6 +127,65 @@ def recommend_topk_chunked(
     return v, i
 
 
+#: static seen-array widths shared by batch_predict's menu — a small
+#: fixed set keeps the number of compiled kernel shapes bounded
+_SEEN_WIDTHS = (8, 32, 128, 512)
+
+#: catalog/batch envelope where the chunked-scan formulation beats the
+#: flat materialize+top_k (measured with the forcing protocol:
+#: B=256 x I=2M, chunked 73ms vs flat 141ms; at B=32 x I=1M the flat
+#: path wins, 8ms vs ~1ms-level noise either way)
+_MIN_ITEMS = 786_432
+_MIN_BATCH = 24
+
+
+def _trim_seen(seen_cols: jax.Array, seen_mask: jax.Array):
+    """Shrink the seen-item pad to the smallest static width covering
+    the batch's real max seen count (concrete arrays only — under a
+    tracer the caller's pad stands). Smaller uploads, same masking."""
+    if isinstance(seen_mask, jax.core.Tracer) or seen_mask.ndim != 2:
+        return seen_cols, seen_mask
+    # bound by the last occupied slot (not the count): entries need not
+    # be left-packed
+    occupied = jnp.where(
+        seen_mask > 0,
+        jnp.arange(1, seen_mask.shape[1] + 1)[None, :],
+        0,
+    )
+    real = int(jnp.max(occupied))
+    for width in _SEEN_WIDTHS:
+        if real <= width < seen_mask.shape[1]:
+            return seen_cols[:, :width], seen_mask[:, :width]
+    return seen_cols, seen_mask
+
+
+def recommend_topk_fused(
+    user_vecs: jax.Array,    # (B, K)
+    item_f: jax.Array,       # (I, K)
+    seen_cols: jax.Array,    # (B, S) int32, padded
+    seen_mask: jax.Array,    # (B, S) 1=real, 0=pad
+    allow: jax.Array,        # (I,) eligibility (0/1); (B, I) -> flat path
+    k: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k recommendation dispatcher: picks between the two XLA
+    formulations — flat materialize+top_k (:func:`recommend_topk`, best
+    for small catalogs and B=1 serving) and the chunked-scan merge
+    (:func:`recommend_topk_chunked`, O(B x chunk) memory, faster from
+    ~1M items with batched queries).
+
+    A pallas streaming-select kernel used to sit behind this dispatch;
+    it was deleted after re-measurement with the forcing protocol
+    (bench.py header): 168ms vs the flat path's 8ms at B=32 x I=1M and
+    188ms vs the chunked path's 73ms at B=256 x I=2M — its per-tile VPU
+    selection loop loses to ``lax.top_k`` at every envelope point."""
+    if allow.ndim == 1 and item_f.shape[0] >= _MIN_ITEMS \
+            and user_vecs.shape[0] >= _MIN_BATCH:
+        seen_cols, seen_mask = _trim_seen(seen_cols, seen_mask)
+        return recommend_topk_chunked(
+            user_vecs, item_f, seen_cols, seen_mask, allow, k)
+    return recommend_topk(user_vecs, item_f, seen_cols, seen_mask, allow, k)
+
+
 @partial(jax.jit, static_argnames=("k",))
 def similar_topk(
     query_vecs: jax.Array,   # (B, K) query item factors

@@ -38,7 +38,6 @@ from predictionio_tpu.controller import (
 )
 from predictionio_tpu.controller.base import PersistentModelManifest
 from predictionio_tpu.models.als import ALSModel, build_allow_vector
-from predictionio_tpu.ops import pallas_topk
 from predictionio_tpu.ops import topk as topk_ops
 from predictionio_tpu.ops.als import RatingsCOO, als_train
 from predictionio_tpu.utils.bimap import EntityIdIxMap
@@ -310,15 +309,14 @@ class ALSAlgorithm(ShardedAlgorithm):
         uixs = np.asarray([u for _, u, _ in known], dtype=np.int32)
         max_num = max(n for _, _, n in known)
         # right-size the seen arrays to the smallest menu width covering
-        # the real counts (smaller uploads; widths shared with the pallas
-        # kernel's static menu so forced-kernel runs stay on-menu)
-        pad = pallas_topk._SEEN_WIDTHS[0]
+        # the real counts (smaller uploads, bounded compile-shape menu)
+        pad = topk_ops._SEEN_WIDTHS[0]
         if self.params.exclude_seen:
             widest = max(
                 (len(model.seen_by_user.get(int(u), ())) for _, u, _ in known),
                 default=0,
             )
-            for cap in pallas_topk._SEEN_WIDTHS:
+            for cap in topk_ops._SEEN_WIDTHS:
                 pad = cap
                 if widest <= cap:
                     break
@@ -331,9 +329,9 @@ class ALSAlgorithm(ShardedAlgorithm):
                 mask[j, : len(s)] = 1.0
         allow = jnp.ones((model.item_factors.shape[0],), dtype=jnp.float32)
         k = min(max_num, model.item_factors.shape[0])
-        # fused entry point (XLA path by measurement; ops/pallas_topk
-        # docstring records the numbers)
-        vals, idxs = pallas_topk.recommend_topk_fused(
+        # dispatcher picks flat vs chunked-scan (ops/topk docstring
+        # records the measurements)
+        vals, idxs = topk_ops.recommend_topk_fused(
             model.user_factors[jnp.asarray(uixs)],
             model.item_factors,
             jnp.asarray(cols),

@@ -13,6 +13,7 @@ from predictionio_tpu.ops.als import (
     RatingsCOO,
     als_train,
     bucket_rows,
+    chunk_rows,
     half_step_flops,
     predict_ratings,
     rmse,
@@ -100,6 +101,64 @@ class TestBucketing:
         assert fl["executed_flops"] >= fl["useful_flops"]
 
 
+class TestChunking:
+    def test_chunk_decomposition_covers_every_rating(self):
+        rng = np.random.default_rng(4)
+        # heavy rows force multi-chunk decomposition
+        rows = np.concatenate([
+            np.repeat(0, 37), np.repeat(1, 9), np.repeat(2, 3),
+            np.repeat(3, 16),
+        ]).astype(np.int32)
+        n = len(rows)
+        cols = rng.integers(0, 50, n).astype(np.int32)
+        vals = rng.uniform(1, 5, n).astype(np.float32)
+        coo = RatingsCOO(rows, cols, vals, 5, 50)
+        chunked = chunk_rows(coo, sizes=(16, 4))
+        # every rating appears exactly once across chunk slabs
+        total = sum(int(s.deg.sum()) for s in chunked.slabs)
+        assert total == n
+        # row 0 (deg 37): two full 16-chunks + one padded 4-chunk + 1 left
+        got = {}
+        for s in chunked.slabs:
+            L = s.cols.shape[1]
+            for j, rid in enumerate(s.row_ids):
+                got.setdefault(int(rid), []).append(int(s.deg[j]))
+                assert s.deg[j] <= L
+                # padding slots hold zero values
+                assert (s.vals[j, s.deg[j]:] == 0).all()
+        assert sorted(got[0], reverse=True) == [16, 16, 4, 1]
+        assert sum(got[1]) == 9 and sum(got[3]) == 16
+
+    def test_chunk_value_multiset_preserved(self):
+        rng = np.random.default_rng(8)
+        coo = _random_coo(rng, users=12, items=40, density=0.6)
+        chunked = chunk_rows(coo, sizes=(8,))
+        for u in range(coo.num_rows):
+            want = sorted(coo.vals[coo.rows == u].tolist())
+            have = sorted(
+                v
+                for s in chunked.slabs
+                for j, rid in enumerate(s.row_ids)
+                if rid == u
+                for v in s.vals[j, : s.deg[j]].tolist()
+            )
+            assert have == pytest.approx(want)
+
+    def test_chunked_flops_accounting(self):
+        rows = np.repeat(np.array([0, 1], dtype=np.int32), [10, 3])
+        coo = RatingsCOO(rows, np.arange(13, dtype=np.int32),
+                         np.ones(13, dtype=np.float32), 2, 13)
+        K = 4
+        fl = half_step_flops(chunk_rows(coo, sizes=(8, 4)), K)
+        per_entry = 2 * K * K + 2 * K
+        per_solve = K**3 / 3 + 2 * K * K
+        # row0: one 8-chunk + one 4-chunk (deg 2); row1: one 4-chunk (deg 3)
+        assert fl["useful_flops"] == pytest.approx(13 * per_entry + 2 * per_solve)
+        assert fl["executed_flops"] == pytest.approx(
+            (8 + 4 + 4) * per_entry + 2 * per_solve
+        )
+
+
 class TestSolve:
     @pytest.mark.parametrize("implicit", [False, True])
     def test_solve_half_matches_numpy(self, implicit):
@@ -116,6 +175,53 @@ class TestSolve:
         )
         want = _numpy_solve_half(V, coo, lam=0.1, implicit=implicit, alpha=10.0)
         np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_chunked_solve_half_matches_numpy(self, implicit):
+        """The single-dispatch accumulate-then-solve program computes the
+        same normal equations as the per-bucket path and the oracle, incl.
+        rows split across multiple chunks."""
+        rng = np.random.default_rng(3)
+        coo = _random_coo(rng, users=25, items=30, density=0.5)
+        K = 6
+        V = rng.standard_normal((coo.num_cols, K)).astype(np.float32)
+        chunked = chunk_rows(coo, sizes=(8, 4))  # rows of deg>8 multi-chunk
+        import jax.numpy as jnp
+
+        got = np.asarray(
+            solve_half(jnp.asarray(V), chunked, K, lam=0.1,
+                       implicit=implicit, alpha=10.0)
+        )
+        want = _numpy_solve_half(V, coo, lam=0.1, implicit=implicit, alpha=10.0)
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_layout_validation(self):
+        rng = np.random.default_rng(0)
+        coo = _random_coo(rng, users=5, items=5)
+        with pytest.raises(ValueError, match="layout must be"):
+            als_train(coo, rank=4, iterations=1, layout="chunkd")
+        with pytest.raises(ValueError, match="bucketed-layout knobs"):
+            als_train(coo, rank=4, iterations=1, max_row_len=4)
+        # the knobs work on the layout built for them
+        f = als_train(coo, rank=4, iterations=1, max_row_len=4,
+                      layout="bucketed")
+        assert np.isfinite(np.asarray(f.item)).all()
+
+    def test_chunked_zero_rows_and_train_parity(self):
+        rng = np.random.default_rng(9)
+        coo = _random_coo(rng, users=30, items=20)
+        chunked = als_train(coo, rank=6, iterations=6, lam=0.05, seed=2,
+                            layout="chunked", chunk_sizes=(8, 4))
+        bucketed = als_train(coo, rank=6, iterations=6, lam=0.05, seed=2,
+                             layout="bucketed")
+        np.testing.assert_allclose(
+            np.asarray(chunked.user), np.asarray(bucketed.user),
+            rtol=5e-3, atol=5e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(chunked.item), np.asarray(bucketed.item),
+            rtol=5e-3, atol=5e-3,
+        )
 
     def test_train_reduces_rmse_and_reconstructs(self):
         rng = np.random.default_rng(2)
@@ -144,12 +250,14 @@ class TestSolve:
         assert np.allclose(u[1], 0) and np.allclose(u[3], 0)
         assert not np.allclose(u[0], 0)
 
-    def test_sharded_matches_single_device(self, mesh8):
+    @pytest.mark.parametrize("layout", ["chunked", "bucketed"])
+    def test_sharded_matches_single_device(self, mesh8, layout):
         rng = np.random.default_rng(3)
         coo = _random_coo(rng, users=32, items=16)
-        single = als_train(coo, rank=4, iterations=3, lam=0.05, seed=1)
+        single = als_train(coo, rank=4, iterations=3, lam=0.05, seed=1,
+                           layout=layout)
         sharded = als_train(coo, rank=4, iterations=3, lam=0.05, seed=1,
-                            mesh=mesh8)
+                            mesh=mesh8, layout=layout)
         np.testing.assert_allclose(
             np.asarray(single.user), np.asarray(sharded.user),
             rtol=1e-4, atol=1e-4,

@@ -103,8 +103,10 @@ class TestRingAttention:
 
 
 class TestPallasFlashAttention:
-    """Reference pallas kernel (off by default — ops/pallas_attention
-    docstring records the measurements; force=True exercises it)."""
+    """Pallas flash kernel — auto-dispatched on real TPUs for long
+    sequences (ops/pallas_attention docstring records the measured
+    envelope); on the CPU test backend only force=True exercises it
+    (interpret mode)."""
 
     def test_matches_full_attention(self):
         from predictionio_tpu.ops.pallas_attention import flash_attention
@@ -120,7 +122,9 @@ class TestPallasFlashAttention:
             np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
                                        atol=1e-5, rtol=1e-5)
 
-    def test_default_path_is_xla(self):
+    def test_default_path_is_xla_on_cpu(self):
+        """Interpret mode (CPU backend) never auto-engages — unforced
+        calls are exactly full_attention regardless of S."""
         from predictionio_tpu.ops import pallas_attention
 
         q, k, v = _qkv(7)
@@ -128,3 +132,25 @@ class TestPallasFlashAttention:
         exp = full_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
                                    atol=1e-6, rtol=1e-6)
+
+    def test_auto_envelope_bounds(self, monkeypatch):
+        """The auto window engages exactly on [MIN_SEQ, MAX_SEQ] in
+        compiled mode (mode and kernel stubbed — no TPU in CI, and the
+        point here is routing, not kernel math)."""
+        from predictionio_tpu.ops import pallas_attention as pa
+
+        calls = []
+        monkeypatch.setattr(pa, "_mode", lambda: "compiled")
+        monkeypatch.setattr(
+            pa, "_flash_call",
+            lambda q, k, v, m, causal, interp: calls.append(q.shape) or q,
+        )
+        # stub the fallback too: at the out-of-envelope sizes the real
+        # full_attention would materialize (S, S) logits (~4 GB at 32768)
+        monkeypatch.setattr(pa, "full_attention",
+                            lambda q, k, v, **kw: q)
+        for S, expect in ((1024, 0), (2048, 1), (16384, 1), (32768, 0)):
+            calls.clear()
+            q = jnp.zeros((1, 1, S, 8), jnp.float32)
+            pa.flash_attention(q, q, q, causal=True)
+            assert len(calls) == expect, (S, expect)

@@ -1,0 +1,119 @@
+"""Scenario test for examples/recommendation-custom-preparator — the
+custom-preparator variant (reference:
+examples/scala-parallel-recommendation/custom-prepartor): a user-defined
+Preparator with its own params drops no-train items from the ratings
+before training, so excluded items have no factors and can never be
+recommended."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.context import EngineContext
+from predictionio_tpu.workflow.persistence import load_models
+from predictionio_tpu.workflow.train import run_train
+
+EXAMPLE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "examples",
+    "recommendation-custom-preparator",
+)
+
+
+@pytest.fixture
+def example_engine():
+    sys.path.insert(0, EXAMPLE_DIR)
+    sys.modules.pop("engine", None)
+    try:
+        import engine
+
+        yield engine
+    finally:
+        sys.path.remove(EXAMPLE_DIR)
+        sys.modules.pop("engine", None)
+
+
+@pytest.fixture
+def storage_with_ratings(storage):
+    app_id = storage.get_meta_data_apps().insert(App(0, "CustomPreparatorApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(5)
+    for u in range(16):
+        for i in range(12):
+            if i % 2 == u % 2 and rng.random() < 0.9:
+                events.insert(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties=DataMap({"rating": 5.0}),
+                    ),
+                    app_id,
+                )
+    return storage
+
+
+def test_shipped_engine_json_binds(example_engine):
+    import json
+
+    with open(os.path.join(EXAMPLE_DIR, "engine.json")) as f:
+        variant = json.load(f)
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(variant)
+    assert ep.preparator_params[1].filepath == "no_train_items.txt"
+    assert ep.algorithm_params_list[0][1].num_iterations == 10
+
+
+def test_excluded_items_have_no_factors(example_engine, storage_with_ratings,
+                                        tmp_path):
+    from predictionio_tpu.templates.recommendation import Query
+
+    no_train = tmp_path / "no_train_items.txt"
+    no_train.write_text("i0\ni4\n")
+    variant = {
+        "id": "custom-preparator",
+        "engineFactory": "engine.engine_factory",
+        "datasource": {"params": {"app_name": "CustomPreparatorApp"}},
+        "preparator": {"params": {"filepath": str(no_train)}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 8, "num_iterations": 8, "lambda_": 0.05,
+                        "seed": 1, "use_mesh": False}}
+        ],
+    }
+    storage = storage_with_ratings
+    outcome = run_train(variant=variant, storage=storage)
+    assert outcome.status == "COMPLETED"
+
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(variant)
+    ctx = EngineContext(storage=storage)
+    models = eng.prepare_deploy(ctx, ep, load_models(storage, outcome.instance_id))
+    _, _, algos, serving = eng.make_components(ep)
+
+    # excluded items are absent from the model's id space entirely
+    model = models[0]
+    assert "i0" not in model.item_ids and "i4" not in model.item_ids
+    assert "i2" in model.item_ids
+
+    # and therefore never appear in any user's recommendations
+    for user in ("u0", "u2", "u5"):
+        q = serving.supplement(Query(user=user, num=8))
+        served = serving.serve(
+            q, [a.predict(m, q) for a, m in zip(algos, models)])
+        items = [s.item for s in served.item_scores]
+        assert "i0" not in items and "i4" not in items
+
+    # an empty exclusion file trains on everything (control)
+    no_train.write_text("")
+    outcome2 = run_train(variant=variant, storage=storage)
+    models2 = eng.prepare_deploy(
+        ctx, ep, load_models(storage, outcome2.instance_id))
+    assert "i0" in models2[0].item_ids

@@ -1,0 +1,89 @@
+"""Top-k dispatch contract (ops/topk.recommend_topk_fused): flat
+materialize+top_k for small catalogs / B=1 serving, chunked-scan merge
+for big catalogs with batched queries. The pallas streaming-select
+kernel that used to sit behind this dispatch was deleted on
+measurement — ops/topk.recommend_topk_fused docstring records the
+numbers."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from predictionio_tpu.ops.topk import (
+    _MIN_BATCH,
+    _MIN_ITEMS,
+    _SEEN_WIDTHS,
+    _trim_seen,
+    recommend_topk,
+    recommend_topk_chunked,
+    recommend_topk_fused,
+)
+
+
+def _setup(B, I, K=8, S=16, seed=0):
+    rng = np.random.default_rng(seed)
+    uv = jnp.asarray(rng.standard_normal((B, K)).astype(np.float32))
+    itf = jnp.asarray(rng.standard_normal((I, K)).astype(np.float32))
+    cols = jnp.asarray(rng.integers(0, I, (B, S)).astype(np.int32))
+    mask = jnp.asarray((rng.random((B, S)) < 0.5).astype(np.float32))
+    allow = jnp.asarray((rng.random(I) < 0.9).astype(np.float32))
+    return uv, itf, cols, mask, allow
+
+
+def test_fused_matches_flat_small():
+    uv, itf, cols, mask, allow = _setup(4, 200)
+    fv, fi = recommend_topk_fused(uv, itf, cols, mask, allow, 5)
+    rv, ri = recommend_topk(uv, itf, cols, mask, allow, 5)
+    np.testing.assert_array_equal(np.asarray(fi), np.asarray(ri))
+    np.testing.assert_allclose(np.asarray(fv), np.asarray(rv))
+
+
+def test_chunked_matches_flat_on_finite_slots():
+    uv, itf, cols, mask, allow = _setup(6, 5000, S=24)
+    fv, fi = recommend_topk(uv, itf, cols, mask, allow, 10)
+    cv, ci = recommend_topk_chunked(uv, itf, cols, mask, allow, 10,
+                                    chunk=1024)
+    fv, fi = np.asarray(fv), np.asarray(fi)
+    cv, ci = np.asarray(cv), np.asarray(ci)
+    finite = np.isfinite(fv)
+    np.testing.assert_array_equal(ci[finite], fi[finite])
+    np.testing.assert_allclose(cv[finite], fv[finite], rtol=1e-6)
+    # sentinel slots never collide with real item indices
+    assert (ci[~np.isfinite(cv)] >= 5000).all()
+
+
+def test_trim_seen_picks_menu_width():
+    cols = jnp.zeros((3, 512), jnp.int32)
+    mask = jnp.zeros((3, 512), jnp.float32).at[1, 30].set(1.0)
+    tc, tm = _trim_seen(cols, mask)
+    assert tm.shape[1] == 32 and tm.shape[1] in _SEEN_WIDTHS
+    # a tracer passes through untouched (static shapes under jit)
+    @jax.jit
+    def f(c, m):
+        tc, tm = _trim_seen(c, m)
+        return tm.shape[1]
+    assert f(cols, mask) == 512
+
+
+def test_dispatch_threshold_uses_chunked(monkeypatch):
+    """Above the measured envelope the fused entry must route to the
+    chunked path (checked by stubbing, not by allocating 1M items)."""
+    import predictionio_tpu.ops.topk as t
+
+    calls = []
+    monkeypatch.setattr(
+        t, "recommend_topk_chunked",
+        lambda *a, **kw: calls.append("chunked") or t.recommend_topk(*a[:5], a[5]),
+    )
+    monkeypatch.setattr(t, "_MIN_ITEMS", 100)
+    monkeypatch.setattr(t, "_MIN_BATCH", 2)
+    uv, itf, cols, mask, allow = _setup(4, 200)
+    t.recommend_topk_fused(uv, itf, cols, mask, allow, 5)
+    assert calls == ["chunked"]
+    # 2-D allow (per-query business rules) must stay on the flat path
+    calls.clear()
+    allow2 = jnp.ones((4, 200), jnp.float32)
+    t.recommend_topk_fused(uv, itf, cols, mask, allow2, 5)
+    assert calls == []
