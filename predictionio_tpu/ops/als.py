@@ -22,9 +22,11 @@ TPU-first design (NOT a translation of MLlib's block solver):
   the solver's working set; rating slabs are HBM-resident by default
   (fastest) or streamed per bucket with ``hbm_resident=False`` when the
   padded rating set exceeds device memory.
-- **Batched Cholesky.** Per-row K×K systems are solved with
-  ``jnp.linalg.cholesky`` + two batched triangular solves (vmapped by
-  construction), keeping the solve on-device.
+- **Batched conjugate-gradient solves.** Per-row K×K SPD systems are
+  solved with batched-matvec CG (``_cg_solve_batched``) — XLA's batched
+  cholesky/triangular_solve lower to sequential scalar loops and run
+  ~10-20x slower on TPU; the ridge-regularised systems hit CG's f32
+  accuracy floor within ~16-24 steps at every rank.
 - **Mesh sharding.** Slab row dimensions carry a NamedSharding over the
   "data" mesh axis while factor tables stay replicated; XLA inserts the
   all-gathers/psums on ICI — the analogue of MLlib's block shuffles,
@@ -515,20 +517,33 @@ def _cho_solve_batched(A: jax.Array, b: jax.Array) -> jax.Array:
     return x[..., 0]
 
 
+#: default CG step cap: batched f32 CG on ridge-regularised ALS normal
+#: matrices reaches its float32 accuracy floor well before K steps —
+#: measured on Wishart-like systems: rank 32/deg 500 converges to 4e-7
+#: rel err by step 16; rank 200/deg 800-2000 plateaus at its f32 floor
+#: (1e-2..4e-3, conditioning-bound — the same floor a f32 direct solve
+#: hits) by step 16-24. Steps past the plateau only re-stream A.
+_CG_STEP_CAP = 24
+
+
 def _cg_solve_batched(A: jax.Array, b: jax.Array,
-                      extra_steps: int = 4) -> jax.Array:
-    """Solve SPD systems A x = b for (..., K, K) / (..., K) by K+extra
-    conjugate-gradient steps — the TPU-fast batched solver.
+                      steps: int | None = None) -> jax.Array:
+    """Solve SPD systems A x = b for (..., K, K) / (..., K) by batched
+    conjugate gradients — the TPU-fast solver.
 
     XLA's cholesky + triangular_solve lower to sequential scalar loops
     for small batched systems: measured 506ms for 138k rank-32 solves on
     one v5e-class chip, vs 30ms for this CG (HBM-bound batched matvecs,
-    the layout the VPU/MXU actually likes). In exact arithmetic CG on a
-    K x K SPD system terminates in K steps; the extra steps absorb f32
-    rounding (measured max relative error 3e-5 vs a float64 direct
-    solve — same as XLA's own f32 LU). The ALS normal matrices carry a
-    ``lam * n`` (or flat ``lam``) ridge, so they are well-conditioned by
-    construction; inactive rows pass the identity."""
+    the layout the VPU/MXU actually likes); at rank 200 the gap is 1154ms
+    vs 104ms (20k systems). ``steps`` defaults to ``min(K + 4, 24)`` —
+    exact-in-exact-arithmetic for K <= 20, and past the measured f32
+    accuracy plateau for every larger rank (see ``_CG_STEP_CAP``). The
+    ALS normal matrices carry a ``lam * n`` (or flat ``lam``) ridge, so
+    they are well-conditioned by construction; inactive rows pass the
+    identity. Callers can raise ``steps`` (als_train(cg_steps=...)) for
+    pathologically conditioned data."""
+    if steps is None:
+        steps = min(A.shape[-1] + 4, _CG_STEP_CAP)
     x = jnp.zeros_like(b)
     r = b
     p = r
@@ -547,12 +562,12 @@ def _cg_solve_batched(A: jax.Array, b: jax.Array,
         return (x, r, p, rs_new), None
 
     (x, _, _, _), _ = jax.lax.scan(
-        step, (x, r, p, rs), None, length=A.shape[-1] + extra_steps)
+        step, (x, r, p, rs), None, length=steps)
     return x
 
 
 @partial(jax.jit,
-         static_argnames=("implicit", "bf16", "lam", "alpha"),
+         static_argnames=("implicit", "bf16", "lam", "alpha", "cg_steps"),
          donate_argnums=())
 def _solve_slabs(
     V: jax.Array,      # (num_cols, K) opposite factors, replicated
@@ -564,6 +579,7 @@ def _solve_slabs(
     gram: jax.Array,   # per call, which dominates on remote-attached
     implicit: bool,    # devices (measured ~350ms/call on the axon tunnel)
     bf16: bool = False,
+    cg_steps: int | None = None,
 ) -> jax.Array:
     """Per-slab batched normal-equation solve; scan bounds peak memory.
 
@@ -606,7 +622,7 @@ def _solve_slabs(
                            preferred_element_type=jnp.float32)
         # rows with zero ratings (padding rows): A = λ'I -> x = 0
         A = jnp.where(d[:, None, None] > 0, A, eye)
-        x = _cg_solve_batched(A, b)
+        x = _cg_solve_batched(A, b, steps=cg_steps)
         x = jnp.where(d[:, None] > 0, x, 0.0)
         return None, x
 
@@ -620,7 +636,8 @@ def _gramian(V: jax.Array) -> jax.Array:
 
 
 @partial(jax.jit,
-         static_argnames=("implicit", "bf16", "num_rows", "lam", "alpha"))
+         static_argnames=("implicit", "bf16", "num_rows", "lam", "alpha",
+                          "cg_steps"))
 def _solve_half_chunked(
     V: jax.Array,           # (num_cols, K) opposite factors
     slabs: tuple,           # per size: (rids(S,B), cols(S,B,L), vals, deg)
@@ -630,6 +647,7 @@ def _solve_half_chunked(
     implicit: bool,
     num_rows: int,
     bf16: bool = False,
+    cg_steps: int | None = None,
 ) -> jax.Array:
     """One ALS half-step over the chunked layout as a SINGLE program:
     per-chunk partial normal equations (batched einsums on the MXU),
@@ -685,7 +703,7 @@ def _solve_half_chunked(
         A = A_acc + (jnp.float32(lam) * n_acc)[:, None, None] * eye[None]
     active = n_acc > 0
     A = jnp.where(active[:, None, None], A, eye[None])
-    x = _cg_solve_batched(A, b_acc)
+    x = _cg_solve_batched(A, b_acc, steps=cg_steps)
     return jnp.where(active[:, None], x, 0.0)
 
 
@@ -713,6 +731,7 @@ def solve_half(
     max_slab_elems: int = 1 << 24,
     matmul_dtype: str = "float32",
     shard_factors: bool = False,
+    cg_steps: int | None = None,
 ) -> jax.Array:
     """One ALS half-step: solve all row factors given opposite factors V.
 
@@ -770,7 +789,7 @@ def solve_half(
         )
         return _solve_half_chunked(
             V, slabs, lam_a, alpha_a, gram, implicit, bucketed.num_rows,
-            bf16=(matmul_dtype == "bfloat16"),
+            bf16=(matmul_dtype == "bfloat16"), cg_steps=cg_steps,
         )
 
     out = jnp.zeros((bucketed.num_rows, rank), dtype=V.dtype)
@@ -796,7 +815,8 @@ def solve_half(
             bucket = _stage_bucket(bucket, rank, mesh, max_slab_elems)
         X = _solve_slabs(V, bucket.cols, bucket.vals, bucket.deg,
                          lam_a, alpha_a, gram, implicit,
-                         bf16=(matmul_dtype == "bfloat16"))
+                         bf16=(matmul_dtype == "bfloat16"),
+                         cg_steps=cg_steps)
         X = X.reshape(-1, rank)[: bucket.n]
         out = out.at[bucket.row_ids].set(X)
     return out
@@ -828,8 +848,10 @@ def als_train(
     max_slab_elems: int = 1 << 24,
     hbm_resident: bool = True,
     matmul_dtype: str = "float32",
-    layout: str = "chunked",
+    layout: str = "auto",
     chunk_sizes: Sequence[int] = (1024, 128),
+    chunked_acc_budget: int = 4 << 30,
+    cg_steps: int | None = None,
 ) -> ALSFactors:
     """Full alternating-least-squares training.
 
@@ -837,14 +859,18 @@ def als_train(
     `ALS.trainImplicit(..., alpha)` semantics from the reference templates
     (ALSAlgorithm.scala:79-85); same hyperparameter meanings.
 
-    ``layout="chunked"`` (default) decomposes rows into fixed-size
-    chunks (:func:`chunk_rows`): one dispatch per half-step, MXU-width
+    ``layout="chunked"`` decomposes rows into fixed-size chunks
+    (:func:`chunk_rows`): one dispatch per half-step, MXU-width
     contractions, no dropped ratings, ``len(chunk_sizes)`` compile keys.
     ``layout="bucketed"`` pads whole rows into a power-of-``bucket_growth``
     ladder (:func:`bucket_rows`) — lower device memory (no per-row
     accumulator, which costs ``num_rows * rank^2`` floats) and the only
     mode supporting ``max_row_len``/streaming, at one dispatch per
-    bucket.
+    bucket. ``layout="auto"`` (default) picks chunked unless the
+    accumulator (``max(num_rows, num_cols) * rank^2 * 4`` bytes) would
+    exceed ``chunked_acc_budget`` or a bucketed-only knob is set — e.g.
+    the ML-20M rank-200 BASELINE config needs 22 GB of accumulator and
+    auto-routes to bucketed.
 
     ``hbm_resident=True`` stages all rating slabs on device once (fast;
     needs ~8 bytes x padded nnz x 2 orientations of HBM).
@@ -852,15 +878,22 @@ def als_train(
     half-step (bucketed layout only) — peak device memory bounded by
     ``max_slab_elems`` at the cost of re-transferring every iteration.
     """
-    if layout not in ("chunked", "bucketed"):
+    if layout not in ("auto", "chunked", "bucketed"):
         raise ValueError(
-            f"layout must be 'chunked' or 'bucketed', got {layout!r}")
+            f"layout must be 'auto', 'chunked' or 'bucketed', got {layout!r}")
+    if layout == "auto":
+        acc_bytes = max(ratings.num_rows, ratings.num_cols) * rank * rank * 4
+        if (max_row_len is not None or not hbm_resident
+                or acc_bytes > chunked_acc_budget):
+            layout = "bucketed"
+        else:
+            layout = "chunked"
     if layout == "chunked" and (max_row_len is not None or not hbm_resident):
         raise ValueError(
             "max_row_len / hbm_resident=False are bucketed-layout knobs "
-            "(row capping and streaming); pass layout='bucketed' to use "
-            "them — the chunked layout never drops ratings and stages "
-            "slabs HBM-resident"
+            "(row capping and streaming); pass layout='bucketed' (or "
+            "'auto') to use them — the chunked layout never drops ratings "
+            "and stages slabs HBM-resident"
         )
     if layout == "chunked":
         by_user = chunk_rows(ratings, chunk_sizes)
@@ -879,9 +912,11 @@ def als_train(
         user = None
         for _ in range(iterations):
             user = solve_half(item, by_user, rank, lam, implicit, alpha,
-                              mesh, max_slab_elems, matmul_dtype)
+                              mesh, max_slab_elems, matmul_dtype,
+                              cg_steps=cg_steps)
             item = solve_half(user, by_item, rank, lam, implicit, alpha,
-                              mesh, max_slab_elems, matmul_dtype)
+                              mesh, max_slab_elems, matmul_dtype,
+                              cg_steps=cg_steps)
         return ALSFactors(user=user, item=item)
 
     by_user = bucket_rows(ratings, min_bucket, bucket_growth, max_row_len)
@@ -904,9 +939,9 @@ def als_train(
     user = None
     for it in range(iterations):
         user = solve_half(item, by_user, rank, lam, implicit, alpha, mesh,
-                          max_slab_elems, matmul_dtype)
+                          max_slab_elems, matmul_dtype, cg_steps=cg_steps)
         item = solve_half(user, by_item, rank, lam, implicit, alpha, mesh,
-                          max_slab_elems, matmul_dtype)
+                          max_slab_elems, matmul_dtype, cg_steps=cg_steps)
     return ALSFactors(user=user, item=item)
 
 

@@ -200,11 +200,16 @@ class TestSolve:
         coo = _random_coo(rng, users=5, items=5)
         with pytest.raises(ValueError, match="layout must be"):
             als_train(coo, rank=4, iterations=1, layout="chunkd")
+        # bucketed-only knobs on the explicit chunked layout raise;
+        # "auto" routes them to bucketed instead
         with pytest.raises(ValueError, match="bucketed-layout knobs"):
-            als_train(coo, rank=4, iterations=1, max_row_len=4)
-        # the knobs work on the layout built for them
-        f = als_train(coo, rank=4, iterations=1, max_row_len=4,
-                      layout="bucketed")
+            als_train(coo, rank=4, iterations=1, max_row_len=4,
+                      layout="chunked")
+        f = als_train(coo, rank=4, iterations=1, max_row_len=4)
+        assert np.isfinite(np.asarray(f.item)).all()
+        # auto falls back to bucketed when the accumulator would blow the
+        # budget (num_rows * rank^2 * 4 bytes > chunked_acc_budget)
+        f = als_train(coo, rank=4, iterations=1, chunked_acc_budget=1)
         assert np.isfinite(np.asarray(f.item)).all()
 
     def test_chunked_zero_rows_and_train_parity(self):

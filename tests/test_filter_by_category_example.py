@@ -1,0 +1,114 @@
+"""Scenario test for examples/recommendation-filter-by-category
+(reference: examples/scala-parallel-recommendation/filter-by-category):
+item categories from $set events restrict recommendations pre-top-k."""
+
+import os
+import sys
+
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.context import EngineContext
+from predictionio_tpu.workflow.persistence import load_models
+from predictionio_tpu.workflow.train import run_train
+
+EXAMPLE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "examples",
+    "recommendation-filter-by-category",
+)
+
+
+@pytest.fixture
+def example_engine():
+    sys.path.insert(0, EXAMPLE_DIR)
+    sys.modules.pop("engine", None)
+    try:
+        import engine
+
+        yield engine
+    finally:
+        sys.path.remove(EXAMPLE_DIR)
+        sys.modules.pop("engine", None)
+
+
+@pytest.fixture
+def storage_with_data(storage):
+    app_id = storage.get_meta_data_apps().insert(App(0, "FilterCategoryApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    # even items = "even" category, odd = "odd"; i0/i1 get both
+    for i in range(12):
+        cats = ["even" if i % 2 == 0 else "odd"]
+        if i < 2:
+            cats = ["even", "odd"]
+        events.insert(
+            Event(event="$set", entity_type="item", entity_id=f"i{i}",
+                  properties=DataMap({"categories": cats})),
+            app_id,
+        )
+    for u in range(16):
+        for i in range(12):
+            if i % 2 == u % 2:
+                events.insert(
+                    Event(event="rate", entity_type="user",
+                          entity_id=f"u{u}", target_entity_type="item",
+                          target_entity_id=f"i{i}",
+                          properties=DataMap({"rating": 5.0})),
+                    app_id,
+                )
+    return storage
+
+
+def test_category_filtered_recommendations(example_engine, storage_with_data):
+    variant = {
+        "id": "filter-by-category",
+        "engineFactory": "engine.engine_factory",
+        "datasource": {"params": {"app_name": "FilterCategoryApp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 8, "num_iterations": 8, "lambda_": 0.05,
+                        "seed": 1, "use_mesh": False,
+                        "exclude_seen": False}}
+        ],
+    }
+    storage = storage_with_data
+    outcome = run_train(variant=variant, storage=storage)
+    assert outcome.status == "COMPLETED"
+
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(variant)
+    ctx = EngineContext(storage=storage)
+    models = eng.prepare_deploy(
+        ctx, ep, load_models(storage, outcome.instance_id))
+    _, _, algos, serving = eng.make_components(ep)
+    Query = example_engine.Query
+
+    def ask(**kw):
+        q = serving.supplement(Query(**kw))
+        return serving.serve(
+            q, [a.predict(m, q) for a, m in zip(algos, models)])
+
+    # no categories: unrestricted
+    free = ask(user="u0", num=6)
+    assert len(free.item_scores) == 6
+
+    # category restriction: only odd-category items (incl. the dual i0/i1)
+    odd = ask(user="u0", num=6, categories=("odd",))
+    items = [s.item for s in odd.item_scores]
+    assert items and all(
+        int(i[1:]) % 2 == 1 or i in ("i0", "i1") for i in items
+    )
+
+    # unknown category: empty-eligibility semantics -> nothing served
+    none = ask(user="u0", num=6, categories=("nope",))
+    assert none.item_scores == ()
+
+    # the shipped engine.json binds as-is
+    import json
+
+    with open(os.path.join(EXAMPLE_DIR, "engine.json")) as f:
+        shipped = json.load(f)
+    ep2 = eng.params_from_variant_json(shipped)
+    assert ep2.algorithm_params_list[0][1].rank == 10
