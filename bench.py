@@ -28,6 +28,9 @@ round is comparable on all axes (VERDICT r1 items 1, 2, 7, 10):
 - ``seqrec_tokens_per_sec``/``seqrec_mfu_pct`` — the beyond-reference
   sessionrec transformer's training rate (50k vocab, d256, L4, S256,
   bf16) so its perf claims are measured round-over-round.
+- ``ingest_events_per_sec`` — batched REST ingest through the real
+  event server into file-backed sqlite (the serving plane's front
+  door; host-bound, no device).
 
 Baseline (``vs_baseline``): Spark/MLlib cannot run here (no JVM), so
 the Spark-on-CPU comparable is a measured proxy: a single-process NumPy
@@ -291,6 +294,73 @@ def bench_serving(user_f, item_f, users, items, n_queries=SERVE_QUERIES):
 
 
 # ---------------------------------------------------------------------------
+# Event-server ingest throughput (the serving plane's front door)
+# ---------------------------------------------------------------------------
+
+
+def bench_ingest(n_events: int = 2000, batch: int = 50):
+    """Batched REST ingest rate over HTTP loopback into a file-backed
+    sqlite event store (reference front door: POST /batch/events.json,
+    EventServer.scala:376-460; <=50 events/request). CPU + storage
+    bound — no device involvement."""
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    from predictionio_tpu.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.storage.base import AccessKey, App
+    from predictionio_tpu.storage.registry import Storage
+
+    with tempfile.TemporaryDirectory() as tmp:
+        storage = Storage({
+            "PIO_STORAGE_SOURCES_S_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_S_PATH": f"{tmp}/pio.db",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+        })
+        app_id = storage.get_meta_data_apps().insert(App(0, "BenchApp"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey("bench-key", app_id, []))
+        storage.get_events().init(app_id)
+        server = EventServer(
+            storage, EventServerConfig(ip="127.0.0.1", port=0))
+        server.start()
+        try:
+            url = (f"http://127.0.0.1:{server.port}/batch/events.json"
+                   f"?accessKey=bench-key")
+            payload = [
+                {"event": "rate", "entityType": "user",
+                 "entityId": f"u{j % 97}", "targetEntityType": "item",
+                 "targetEntityId": f"i{j % 53}",
+                 "properties": {"rating": float(j % 5 + 1)}}
+                for j in range(batch)
+            ]
+            body = _json.dumps(payload).encode()
+
+            def post():
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+
+            for _ in range(4):  # warm connections/WAL
+                post()
+            posted = (n_events // batch) * batch
+            t0 = time.perf_counter()
+            for _ in range(n_events // batch):
+                post()
+            dt = time.perf_counter() - t0
+        finally:
+            server.stop()
+    return {"ingest_events_per_sec": round(posted / dt, 1)}
+
+
+# ---------------------------------------------------------------------------
 # Quality parity (the "at matching MAP@10" half of the north star)
 # ---------------------------------------------------------------------------
 
@@ -412,6 +482,7 @@ def main() -> None:
         ("serving", lambda: bench_serving(user_f, item_f, users, items)),
         ("quality", bench_quality),
         ("seqrec", bench_seqrec),
+        ("ingest", bench_ingest),
     ):
         try:
             line.update(fn())

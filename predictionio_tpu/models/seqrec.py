@@ -29,7 +29,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.ops.attention import full_attention, ring_attention
+from predictionio_tpu.ops.attention import (
+    blockwise_attention,
+    full_attention,
+    ring_attention,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -135,6 +139,13 @@ def forward(
             from predictionio_tpu.ops.pallas_attention import flash_attention
 
             att = flash_attention(q, k, v, causal=True, kv_mask=mask)
+        elif S >= 4096 and any(S % b == 0 for b in (512, 256, 128)):
+            # single-device long-context TRAINING: full_attention's
+            # (S, S) logits OOM from ~16k; blockwise is differentiable
+            # with O(S * q_block) peak (ops/attention.blockwise_attention)
+            qb = next(b for b in (512, 256, 128) if S % b == 0)
+            att = blockwise_attention(q, k, v, causal=True, kv_mask=mask,
+                                      q_block=qb)
         else:
             att = full_attention(q, k, v, causal=True, kv_mask=mask)
         att = att.transpose(0, 2, 1, 3).reshape(B, S, d)

@@ -584,13 +584,15 @@ def _solve_slabs(
     """Per-slab batched normal-equation solve; scan bounds peak memory.
 
     ``bf16=True`` feeds the normal-equation einsums bf16 operands with
-    f32 accumulation. Measured on one v5e-class chip (ML-20M shapes,
-    rank 32) it is ~1.5x SLOWER than the f32 default — the cast/where
-    ops break XLA's fusion and the f32-HIGHEST path is already
-    MXU-bound — so this is an HBM/interop knob (bf16 factor tables at
-    half the bytes), not a speed knob, on current XLA. Cholesky and
-    regularisation stay f32; factor quality stays within ~1e-3 RMSE.
-    Opt in via ``als_train(matmul_dtype="bfloat16")``."""
+    f32 accumulation. Measured with the forcing protocol (bench.py
+    header) on one v5e-class chip, ML-20M shapes, rank 32, chunked
+    layout: 322ms vs 393ms per iteration (~22% faster; a round-1 claim
+    that bf16 was slower came from the broken timing protocol and is
+    retracted). Factor tables diverge ~5e-3 relative from the f32 path
+    after 10 iterations — inside quality-parity tolerances but not
+    bit-comparable, so f32-HIGHEST stays the default. The solve and
+    regularisation stay f32. Opt in via
+    ``als_train(matmul_dtype="bfloat16")``."""
     K = V.shape[1]
     L = cols.shape[-1]
     eye = jnp.eye(K, dtype=jnp.float32)

@@ -52,6 +52,65 @@ def full_attention(
                       ).astype(q.dtype)
 
 
+def blockwise_attention(
+    q: jax.Array,  # (B, H, S, D)
+    k: jax.Array,  # (B, H, S, D)
+    v: jax.Array,  # (B, H, S, D)
+    *,
+    causal: bool = True,
+    kv_mask: jax.Array | None = None,  # (B, S)
+    q_block: int = 512,
+) -> jax.Array:
+    """Memory-bounded, DIFFERENTIABLE attention: lax.scan over query
+    tiles, each tile computing its (q_block, S) logits and softmax; the
+    rematerialised body recomputes tile logits in the backward pass, so
+    peak memory is O(B*H*q_block*S) instead of O(B*H*S^2).
+
+    This is the single-device long-context TRAINING path: full_attention
+    materializes the (S, S) logits (~8.6 GB at S=16384, OOM on one
+    v5e), the pallas flash kernel (ops/pallas_attention) is
+    forward-only, and ring_attention needs a mesh "seq" axis. Matches
+    full_attention to f32 rounding in both values and gradients
+    (tests/test_attention.py). ``S`` must divide by ``q_block``; pad
+    with ``kv_mask`` otherwise.
+    """
+    B, H, S, D = q.shape
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, S), dtype=jnp.float32)
+    q_block = min(q_block, S)
+    if S % q_block:
+        raise ValueError(f"S={S} must divide by q_block={q_block}")
+    n_tiles = S // q_block
+    scale = jnp.float32(1.0 / math.sqrt(D))
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    k_pos = lax.iota(jnp.int32, S)
+    valid_k = kv_mask[:, None, None, :].astype(bool)       # (B, 1, 1, S)
+
+    qt = q.reshape(B, H, n_tiles, q_block, D).transpose(2, 0, 1, 3, 4)
+
+    def tile(_, xs):
+        q_tile, t = xs                                     # (B, H, Tq, D)
+        logits = jnp.einsum("bhsd,bhtd->bhst", q_tile.astype(jnp.float32),
+                            kf) * scale                    # (B, H, Tq, S)
+        valid = valid_k
+        if causal:
+            q_pos = t * q_block + lax.iota(jnp.int32, q_block)
+            valid = valid & (q_pos[None, None, :, None] >= k_pos[None, None, None, :])
+        logits = jnp.where(valid, logits, _NEG)
+        probs = jax.nn.softmax(logits, axis=-1)
+        # fully-masked rows (padding queries) get zero output
+        any_valid = jnp.any(valid, axis=-1, keepdims=True)
+        probs = jnp.where(any_valid, probs, 0.0)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, vf)
+        return None, out.astype(q.dtype)
+
+    _, tiles = lax.scan(
+        jax.checkpoint(tile), None,
+        (qt, jnp.arange(n_tiles, dtype=jnp.int32)))
+    return tiles.transpose(1, 2, 0, 3, 4).reshape(B, H, S, D)
+
+
 def _ring_attention_local(
     q: jax.Array,        # (B, H, Sl, D) local query block
     k: jax.Array,        # (B, H, Sl, D) local key block (rotates)
