@@ -241,3 +241,44 @@ def test_mid_training_checkpoint_resume(tmp_path):
                                 n_layers=1)
     seqrec.train(seqs, other, epochs=1, batch_size=8, seed=4,
                  checkpoint_dir=d, checkpoint_every=0)
+
+
+def test_tiled_loss_matches_flat():
+    """Big-vocab configs tile the cross-entropy over sequence tiles
+    (models/seqrec.next_item_loss): values and gradients must match the
+    flat path to f32 rounding."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from predictionio_tpu.models import seqrec
+
+    cfg = seqrec.SeqRecConfig(vocab=300, max_len=32, d_model=16,
+                              n_heads=2, n_layers=1)
+    params = seqrec.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    seqs = jnp.asarray(rng.integers(0, 300, (4, 32)).astype(np.int32))
+    tgts = jnp.asarray(rng.integers(0, 300, (4, 32)).astype(np.int32))
+
+    def loss(p):
+        return seqrec.next_item_loss(p, seqs, tgts, cfg)
+
+    flat_v, flat_g = jax.value_and_grad(loss)(params)
+    orig = seqrec._LOSS_TILE_BYTES
+    try:
+        seqrec._LOSS_TILE_BYTES = 4 * 300 * 8 * 4  # force tile=8
+        assert seqrec._pick_loss_tile(4, 32, 300) == 8
+        tiled_v, tiled_g = jax.value_and_grad(loss)(params)
+    finally:
+        seqrec._LOSS_TILE_BYTES = orig
+    assert float(flat_v) == pytest.approx(float(tiled_v), abs=1e-5)
+    for (pa, a), (pb, b) in zip(
+        sorted(seqrec._flat_paths(flat_g).items()),
+        sorted(seqrec._flat_paths(tiled_g).items()),
+    ):
+        assert pa == pb
+        # summation order differs (per-tile vs flat) and the logits
+        # matmuls run bf16-in/f32-accum: grads agree to accumulation
+        # noise, not bitwise
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=5e-4, rtol=0)
