@@ -130,25 +130,59 @@ def load_bucketize() -> ctypes.CDLL | None:
         except OSError:
             _bucketize_failed = True
             return None
-        i32_p = ctypes.POINTER(ctypes.c_int32)
-        i64_p = ctypes.POINTER(ctypes.c_int64)
-        f32_p = ctypes.POINTER(ctypes.c_float)
-        lib.pio_bucketize.argtypes = [
-            ctypes.c_int64, i32_p, i32_p, f32_p, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-        ]
-        lib.pio_bucketize.restype = ctypes.c_void_p
-        lib.pio_bucketize_num_buckets.argtypes = [ctypes.c_void_p]
-        lib.pio_bucketize_num_buckets.restype = ctypes.c_int32
-        lib.pio_bucketize_bucket_info.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32, i32_p, i64_p,
-        ]
-        lib.pio_bucketize_bucket_info.restype = ctypes.c_int
-        lib.pio_bucketize_fill.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32, i32_p, i32_p, f32_p, i32_p,
-        ]
-        lib.pio_bucketize_fill.restype = ctypes.c_int
-        lib.pio_bucketize_free.argtypes = [ctypes.c_void_p]
-        lib.pio_bucketize_free.restype = None
-        _bucketize_lib = lib
-        return _bucketize_lib
+        return _bind_bucketize(lib)
+
+
+def _bind_bucketize(lib: ctypes.CDLL) -> ctypes.CDLL | None:
+    global _bucketize_lib, _bucketize_failed
+    try:
+        _bind_bucketize_symbols(lib)
+    except AttributeError:
+        # a stale/prebuilt .so without the full symbol set (e.g. built
+        # from an older bucketize.cc) must mean "no native path", not a
+        # crash on every call — fall back to NumPy everywhere
+        _bucketize_failed = True
+        return None
+    _bucketize_lib = lib
+    return _bucketize_lib
+
+
+def _bind_bucketize_symbols(lib: ctypes.CDLL) -> None:
+    i32_p = ctypes.POINTER(ctypes.c_int32)
+    i64_p = ctypes.POINTER(ctypes.c_int64)
+    f32_p = ctypes.POINTER(ctypes.c_float)
+    lib.pio_bucketize.argtypes = [
+        ctypes.c_int64, i32_p, i32_p, f32_p, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.pio_bucketize.restype = ctypes.c_void_p
+    lib.pio_bucketize_num_buckets.argtypes = [ctypes.c_void_p]
+    lib.pio_bucketize_num_buckets.restype = ctypes.c_int32
+    lib.pio_bucketize_bucket_info.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i32_p, i64_p,
+    ]
+    lib.pio_bucketize_bucket_info.restype = ctypes.c_int
+    lib.pio_bucketize_fill.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i32_p, i32_p, f32_p, i32_p,
+    ]
+    lib.pio_bucketize_fill.restype = ctypes.c_int
+    lib.pio_bucketize_free.argtypes = [ctypes.c_void_p]
+    lib.pio_bucketize_free.restype = None
+    # chunker entry points (same library; ops/als.chunk_rows)
+    lib.pio_chunk.argtypes = [
+        ctypes.c_int64, i32_p, i32_p, f32_p, ctypes.c_int32, i32_p,
+        ctypes.c_int32,
+    ]
+    lib.pio_chunk.restype = ctypes.c_void_p
+    lib.pio_chunk_num_slabs.argtypes = [ctypes.c_void_p]
+    lib.pio_chunk_num_slabs.restype = ctypes.c_int32
+    lib.pio_chunk_slab_info.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i32_p, i64_p,
+    ]
+    lib.pio_chunk_slab_info.restype = ctypes.c_int
+    lib.pio_chunk_fill.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i32_p, i32_p, f32_p, i32_p,
+    ]
+    lib.pio_chunk_fill.restype = ctypes.c_int
+    lib.pio_chunk_free.argtypes = [ctypes.c_void_p]
+    lib.pio_chunk_free.restype = None

@@ -188,3 +188,153 @@ void pio_bucketize_free(void* handle) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Chunker: greedy fixed-size decomposition (ops/als.chunk_rows contract)
+// ---------------------------------------------------------------------------
+//
+// Every row decomposes greedily into full chunks of the largest size,
+// cascading down; the final remainder pads to the smallest size. Chunks
+// of one row are consecutive and carry the row's entries in their
+// row-sorted order — identical layout to the NumPy implementation.
+//
+//   h = pio_chunk(nnz, rows, cols, vals, num_rows, sizes, n_sizes)
+//       (sizes strictly descending, all > 0)
+//   n = pio_chunk_num_slabs(h)            // one slab set per size with chunks
+//   pio_chunk_slab_info(h, s, &L, &n_chunks)
+//   pio_chunk_fill(h, s, row_ids_out, cols_out, vals_out, deg_out)
+//   pio_chunk_free(h)
+
+namespace {
+
+struct ChunkRef {
+    int64_t start;   // offset into the row-sorted entry order
+    int32_t row_id;
+    int32_t count;   // real entries in this chunk (<= L)
+};
+
+struct SlabPlan {
+    int32_t len;
+    std::vector<ChunkRef> chunks;
+};
+
+struct Chunker {
+    std::vector<int64_t> order;
+    std::vector<SlabPlan> slabs;
+    const int32_t* cols;
+    const float* vals;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pio_chunk(int64_t nnz, const int32_t* rows, const int32_t* cols,
+                const float* vals, int32_t num_rows, const int32_t* sizes,
+                int32_t n_sizes) try {
+    if (nnz < 0 || num_rows < 0 || n_sizes <= 0) return nullptr;
+    for (int32_t i = 0; i < n_sizes; ++i) {
+        if (sizes[i] <= 0) return nullptr;
+        if (i > 0 && sizes[i] >= sizes[i - 1]) return nullptr;  // descending
+    }
+    for (int64_t i = 0; i < nnz; ++i) {
+        if (rows[i] < 0 || rows[i] >= num_rows) return nullptr;
+    }
+    auto* ck = new Chunker();
+    ck->cols = cols;
+    ck->vals = vals;
+
+    // counting sort by row id (stable)
+    const int64_t n_rows = num_rows;
+    std::vector<int64_t> counts(n_rows + 1, 0);
+    for (int64_t i = 0; i < nnz; ++i) ++counts[rows[i] + 1];
+    std::vector<int64_t> offsets(counts);
+    for (int64_t r = 0; r < n_rows; ++r) offsets[r + 1] += offsets[r];
+    ck->order.resize(nnz);
+    {
+        std::vector<int64_t> cursor(offsets.begin(), offsets.end() - 1);
+        for (int64_t i = 0; i < nnz; ++i) ck->order[cursor[rows[i]]++] = i;
+    }
+
+    // greedy cascade: per size class, full chunks (remainder pads into
+    // the smallest class)
+    std::vector<int64_t> consumed(n_rows, 0);
+    ck->slabs.reserve(n_sizes);
+    for (int32_t s = 0; s < n_sizes; ++s) {
+        const int64_t L = sizes[s];
+        SlabPlan plan;
+        plan.len = sizes[s];
+        for (int64_t r = 0; r < n_rows; ++r) {
+            const int64_t deg = offsets[r + 1] - offsets[r];
+            const int64_t remaining = deg - consumed[r];
+            if (remaining <= 0) continue;
+            int64_t covered;
+            if (s < n_sizes - 1) {
+                covered = (remaining / L) * L;   // full chunks only
+            } else {
+                covered = remaining;             // remainder pads to last size
+            }
+            for (int64_t off = 0; off < covered; off += L) {
+                ChunkRef ref;
+                ref.start = offsets[r] + consumed[r] + off;
+                ref.row_id = static_cast<int32_t>(r);
+                ref.count = static_cast<int32_t>(std::min(L, covered - off));
+                plan.chunks.push_back(ref);
+            }
+            consumed[r] += covered;
+        }
+        if (!plan.chunks.empty()) ck->slabs.push_back(std::move(plan));
+    }
+    return ck;
+} catch (...) {
+    return nullptr;
+}
+
+int32_t pio_chunk_num_slabs(void* handle) {
+    if (!handle) return -1;
+    return static_cast<int32_t>(static_cast<Chunker*>(handle)->slabs.size());
+}
+
+int pio_chunk_slab_info(void* handle, int32_t s, int32_t* len,
+                        int64_t* n_chunks) {
+    if (!handle) return -1;
+    auto* ck = static_cast<Chunker*>(handle);
+    if (s < 0 || s >= static_cast<int32_t>(ck->slabs.size())) return -1;
+    *len = ck->slabs[s].len;
+    *n_chunks = static_cast<int64_t>(ck->slabs[s].chunks.size());
+    return 0;
+}
+
+int pio_chunk_fill(void* handle, int32_t s, int32_t* row_ids_out,
+                   int32_t* cols_out, float* vals_out, int32_t* deg_out) try {
+    if (!handle) return -1;
+    auto* ck = static_cast<Chunker*>(handle);
+    if (s < 0 || s >= static_cast<int32_t>(ck->slabs.size())) return -1;
+    const SlabPlan& plan = ck->slabs[s];
+    const int32_t L = plan.len;
+    for (int64_t j = 0; j < static_cast<int64_t>(plan.chunks.size()); ++j) {
+        const ChunkRef& ref = plan.chunks[j];
+        row_ids_out[j] = ref.row_id;
+        deg_out[j] = ref.count;
+        int32_t* crow = cols_out + j * L;
+        float* vrow = vals_out + j * L;
+        if (ref.count < L) {
+            std::memset(crow + ref.count, 0, sizeof(int32_t) * (L - ref.count));
+            std::memset(vrow + ref.count, 0, sizeof(float) * (L - ref.count));
+        }
+        for (int32_t t = 0; t < ref.count; ++t) {
+            const int64_t e = ck->order[ref.start + t];
+            crow[t] = ck->cols[e];
+            vrow[t] = ck->vals[e];
+        }
+    }
+    return 0;
+} catch (...) {
+    return -1;
+}
+
+void pio_chunk_free(void* handle) {
+    delete static_cast<Chunker*>(handle);
+}
+
+}  // extern "C"

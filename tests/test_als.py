@@ -421,6 +421,38 @@ class TestNativeBucketizer:
         assert bucket_rows(coo).buckets == ()
 
 
+class TestNativeChunker:
+    """native/bucketize.cc pio_chunk* vs the NumPy chunk_rows fallback:
+    identical slab layout, chunk order, and padding."""
+
+    def test_native_matches_python(self):
+        rng = np.random.default_rng(5)
+        nnz = 20_000
+        coo = RatingsCOO(
+            (400 * rng.random(nnz) ** 1.5).astype(np.int32),
+            (300 * rng.random(nnz) ** 1.5).astype(np.int32),
+            rng.random(nnz).astype(np.float32) * 5,
+            400, 300,
+        )
+        for sizes in ((16, 4), (64, 16, 4), (8,)):
+            nat = chunk_rows(coo, sizes)
+            py = chunk_rows(coo, sizes, use_native=False)
+            assert [s.cols.shape for s in nat.slabs] == \
+                [s.cols.shape for s in py.slabs]
+            for sn, sp in zip(nat.slabs, py.slabs):
+                np.testing.assert_array_equal(sn.row_ids, sp.row_ids)
+                np.testing.assert_array_equal(sn.deg, sp.deg)
+                # same entry multiset per chunk (order within a chunk is
+                # row-sorted in both; compare exactly)
+                np.testing.assert_array_equal(sn.cols, sp.cols)
+                np.testing.assert_array_equal(sn.vals, sp.vals)
+
+    def test_empty_coo_falls_back(self):
+        coo = RatingsCOO(np.zeros(0, np.int32), np.zeros(0, np.int32),
+                         np.zeros(0, np.float32), 4, 4)
+        assert chunk_rows(coo).slabs == ()
+
+
 def test_bf16_matmul_close_to_f32():
     """als_train(matmul_dtype="bfloat16"): native-MXU-rate normal
     equations; factor quality must stay within tolerance of f32."""
@@ -459,3 +491,27 @@ def test_sharded_factor_table_matches_replicated():
     rep = np.asarray(solve_half(V, b, 8, 0.05, mesh=mesh))
     tp = np.asarray(solve_half(V, b, 8, 0.05, mesh=mesh, shard_factors=True))
     np.testing.assert_allclose(rep, tp, atol=1e-5)
+
+
+def test_stale_native_library_falls_back_to_numpy(monkeypatch):
+    """A cached/prebuilt _bucketize.so missing the newer pio_chunk*
+    symbols must register as 'no native path' (NumPy fallback), not
+    crash every bucket_rows/chunk_rows call (AttributeError on dlsym)."""
+    import predictionio_tpu.native as native
+
+    class _StaleLib:
+        def __getattr__(self, name):
+            if name.startswith("pio_chunk"):
+                raise AttributeError(name)  # symbol missing in old .so
+            return lambda *a: None
+
+    monkeypatch.setattr(native, "_bucketize_lib", None)
+    monkeypatch.setattr(native, "_bucketize_failed", False)
+    assert native._bind_bucketize(_StaleLib()) is None
+    assert native._bucketize_failed is True
+    # and the layout builders still work (NumPy path)
+    rng = np.random.default_rng(0)
+    coo = _random_coo(rng, users=10, items=8)
+    monkeypatch.setattr(
+        "predictionio_tpu.native.load_bucketize", lambda: None)
+    assert sum(int(s.deg.sum()) for s in chunk_rows(coo, (8,)).slabs) == coo.nnz
