@@ -1,0 +1,64 @@
+"""bench.py is the driver's interface (BENCH_r{N}.json): its ONE-line
+JSON contract must not regress. This smoke test runs the real ALS and
+ingest sections at tiny scale on the CPU backend and stubs the
+device-heavy sections (serving/quality/seqrec run for minutes at real
+shapes), asserting the primary keys and the partial-failure guard."""
+
+import json
+
+import pytest
+
+
+@pytest.fixture
+def tiny_bench(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "USERS", 120)
+    monkeypatch.setattr(bench, "ITEMS", 60)
+    monkeypatch.setattr(bench, "NNZ", 3000)
+    monkeypatch.setattr(bench, "SUB_NNZ", 1000)
+    monkeypatch.setattr(bench, "N_SHORT", 1)
+    monkeypatch.setattr(bench, "N_LONG", 3)
+    monkeypatch.setattr(bench, "bench_serving",
+                        lambda *a, **kw: {"p50_ms": 1.0, "p99_ms": 2.0})
+    monkeypatch.setattr(bench, "bench_quality",
+                        lambda: {"map10_tpu": 0.1, "map10_ref": 0.1})
+    monkeypatch.setattr(bench, "bench_seqrec",
+                        lambda: {"seqrec_tokens_per_sec": 1.0})
+    # keep ingest real but tiny (default posts 2000+warmup events)
+    real_ingest = bench.bench_ingest
+    monkeypatch.setattr(bench, "bench_ingest",
+                        lambda: real_ingest(n_events=100, batch=25))
+    return bench
+
+
+def test_single_json_line_with_primary_contract(tiny_bench, capsys, monkeypatch):
+    monkeypatch.setattr("sys.argv", ["bench.py"])
+    tiny_bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "bench must print exactly ONE line"
+    line = json.loads(out[0])
+    # the driver's primary contract
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in line, key
+    assert line["unit"] == "ratings/sec"
+    assert line["value"] > 0 and line["vs_baseline"] > 0
+    # round-over-round comparison keys
+    for key in ("stdev_pct", "iter_ms", "padding_x", "p50_ms",
+                "map10_tpu", "seqrec_tokens_per_sec",
+                "ingest_events_per_sec"):
+        assert key in line, key
+
+
+def test_section_failure_keeps_primary_metric(tiny_bench, capsys, monkeypatch):
+    """A crashing section must surface as error_<name>, never lose the
+    headline metric (the driver records whatever line is printed)."""
+    monkeypatch.setattr("sys.argv", ["bench.py"])
+    monkeypatch.setattr(
+        tiny_bench, "bench_quality",
+        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    tiny_bench.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["value"] > 0
+    assert "error_quality" in line and "boom" in line["error_quality"]
+    assert "map10_tpu" not in line
