@@ -2,47 +2,72 @@
 
 Primary contract (driver): {"metric", "value", "unit", "vs_baseline"}.
 The line also carries the rest of the BASELINE.md north star so every
-round is comparable on all axes (VERDICT r1 items 1, 2, 7, 10):
+round is comparable on all axes:
 
 - ``value``/``stdev_pct``/``iter_ms`` — ALS train throughput at
   MovieLens-20M shape (138,493 x 26,744, 20M ratings, power-law skew),
-  rank 32, full alternating iterations, min-of-N over ``REPS`` timed
-  repeats with the relative spread reported (this host's load varies).
+  rank 32, full alternating iterations on the library-default path
+  (fused MXU-width ladder, bf16 normal equations with f32 accumulation,
+  one device program for the whole run — ops/als layout="fused").
+  Min-of-N over ``REPS`` timed repeats, relative spread reported.
+- ``phase_*_ms`` — per-phase decomposition of one iteration (VERDICT
+  r2 weak #1): gather-only and gather+einsum chain variants isolate
+  the factor row-gather (row-count-bound: measured invariant to row
+  width 32->128 lanes, dtype, and index locality — ~2.8ns/row) and
+  the normal-equation einsums; solve+write-back is the remainder.
+- ``als_f32_rate`` — the f32-HIGHEST opt-in path
+  (matmul_dtype="float32"), tracked so the precision trade stays
+  visible round-over-round.
+- ``rank200_*`` — the BASELINE.md rank-200 configuration on the same
+  ML-20M shape (fused layout; CG step cap active). Its quality
+  validation lives in ``rank200_rmse_tpu``/``rank200_rmse_ref``:
+  device rank-200 ALS vs an exact per-row NumPy solver on the
+  ML-100k-statistics dataset.
 - ``mfu_pct``/``useful_tflops``/``padding_x`` — useful-FLOP model
-  utilisation and the layout-padding overhead (ops/als.half_step_flops);
-  "useful" counts only real rating entries, so padding work earns no
-  credit. MFU is quoted against the chip's headline dense bf16 peak
-  even though the normal equations run f32-HIGHEST (which cannot reach
-  bf16 peak on the MXU) — conservative by construction.
-- ``p50_ms``/``p99_ms`` — end-to-end serving latency of the trained
-  model behind the real engine server: POST /queries.json driven
-  ``SERVE_QUERIES`` times over HTTP loopback (reference counter:
-  CreateServer.scala:583-590). Includes JSON, HTTP, and host<->device
-  transfer; on a remote-attached device (axon tunnel) the link
-  dominates — see README serving notes.
-- ``map10_tpu``/``map10_ref``/``rmse_tpu``/``rmse_ref`` — quality
-  parity on an ML-100k-statistics dataset: the device-path ALS vs an
-  independent NumPy ALS-WR (the MLlib estimator) under the reference's
-  Evaluation.scala protocol (e2/quality.py). The north star is
-  throughput *at matching MAP@10*; these keys prove the "matching".
-- ``seqrec_tokens_per_sec``/``seqrec_mfu_pct`` — the beyond-reference
-  sessionrec transformer's training rate (50k vocab, d256, L4, S256,
-  bf16) so its perf claims are measured round-over-round.
+  utilisation (ops/als.half_step_flops): "useful" counts real rating
+  entries and algorithmic-minimum (Cholesky-priced) solves; executed
+  prices the solve at the CG steps actually run, so padding_x carries
+  both layout padding and solver overhead. MFU is quoted against the
+  chip's headline dense bf16 peak — conservative by construction.
+- ``p50_ms``/``p99_ms``/``serve_inproc_p50_ms`` — end-to-end serving
+  latency over HTTP loopback (reference counter:
+  CreateServer.scala:583-590) AND the in-process serve path (same
+  query flow minus HTTP + tunnel), so the link share is measured, not
+  asserted. ``batch_predict_qps_2m`` — batched top-k scoring rate
+  against a 2M-item catalog (the eval hot path).
+- ``flash_s4096_ms``/``xla_s4096_ms`` — pallas flash (force=True) vs
+  XLA attention forward at S=4096. Tracking this pair is what caught
+  the round-2 envelope claim being wrong (XLA wins at every measured
+  serving shape; auto-dispatch retired — ops/pallas_attention).
+- ``map10_*``/``rmse_*`` — quality on the ML-100k-statistics dataset.
+  map10_tpu/map10_ref vs an independent NumPy ALS-WR are PARITY keys;
+  map10_implicit vs map10_popularity is the ranking-WINS key (explicit
+  ALS models rating values and sits below the popularity baseline on
+  top-N — MLlib's does too; the implicit path must beat it).
+- ``seqrec_*`` — sessionrec transformer training at S=256 (dense
+  attention), S=4096 (blockwise long-context path), and serving p50 at
+  S=2048.
 - ``ingest_events_per_sec`` — batched REST ingest through the real
-  event server into file-backed sqlite (the serving plane's front
-  door; host-bound, no device).
+  event server into file-backed sqlite.
 
-Baseline (``vs_baseline``): Spark/MLlib cannot run here (no JVM), so
-the Spark-on-CPU comparable is a measured proxy: a single-process NumPy
-ALS-WR iteration (segment reductions — pure useful work) on a
-subsample (size-normalised rate), scaled by this host's core count as
-if Spark local[N] scaled perfectly with zero overhead — strictly
-generous to Spark, so ``vs_baseline`` is a lower bound on the real
-ratio. The BASELINE.md gate is >=10x.
+Baseline: Spark/MLlib cannot run here (no JVM), so the comparable is a
+measured proxy — a single-core NumPy ALS-WR iteration (segment
+reductions, pure useful work), scaled two ways: ``vs_baseline``
+against this host's core count as a Spark local[N] perfect-scaling
+bound, and ``vs_baseline_64core`` against a 64-core cluster width
+(a realistic production Spark allocation) — both generous to Spark by
+construction. The BASELINE.md gate is >=10x and is evaluated against
+the 64-core figure in README.
 
-``--sweep`` re-measures the chunk-layout grid and prints one JSON line
-per config (throughput, padding overhead, MFU) — the data behind the
-README layout table.
+MEASUREMENT PROTOCOL (critical on remote-attached devices): on the
+axon tunnel, jax.block_until_ready can return before the computation
+actually executes — chained f32 matmuls "measured" 20 PFLOP/s that
+way. Every timing below therefore forces real execution by fetching a
+scalar reduction of the full result (float(jnp.sum(...))), and
+per-iteration time comes from the difference of a long and a short
+chain, which cancels the fetch's round-trip latency. Chain inputs vary
+per step (factors feed back), since repeated identical dispatches
+measure inconsistently on this backend.
 """
 
 from __future__ import annotations
@@ -66,17 +91,6 @@ SUB_NNZ = 500_000   # numpy-baseline subsample (rate is size-normalised)
 SERVE_QUERIES = 500
 SERVE_WARMUP = 20
 
-# Chosen by `bench.py --sweep` on TPU v5e (see README layout table):
-# fixed-size chunks, MXU-width contraction, zero dropped ratings.
-CHUNK_SIZES = (512, 128)
-
-# MEASUREMENT PROTOCOL (critical on remote-attached devices): on the
-# axon tunnel, jax.block_until_ready can return before the computation
-# actually executes — chained f32 matmuls "measured" 20 PFLOP/s that
-# way. Every timing below therefore forces real execution by fetching a
-# scalar reduction of the full result (float(jnp.sum(...))), and
-# per-iteration time comes from the difference of a long and a short
-# chain, which cancels the fetch's round-trip latency.
 N_SHORT, N_LONG = 2, 10
 
 # headline dense bf16 peak per chip (MFU denominator)
@@ -107,59 +121,94 @@ def _device_peak():
     return kind, _PEAK_BF16.get(kind)
 
 
+def _chain_time(run, n_short=None, n_long=None, reps=REPS):
+    """Per-step times from differential chains.
+
+    Returns (robust, per_rep): ``robust`` differences the MIN short and
+    MIN long endpoint across reps — immune to the tunnel's asymmetric
+    multi-second stalls, which can make a single rep's difference
+    negative — and ``per_rep`` keeps the rep-wise differences for the
+    spread report."""
+    n_short = N_SHORT if n_short is None else n_short
+    n_long = N_LONG if n_long is None else n_long
+    shorts, longs = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run(n_short)
+        shorts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run(n_long)
+        longs.append(time.perf_counter() - t0)
+    dn = n_long - n_short
+    robust = (min(longs) - min(shorts)) / dn
+    per_rep = [(tl - ts) / dn for ts, tl in zip(shorts, longs)]
+    return robust, per_rep
+
+
 # ---------------------------------------------------------------------------
-# ALS train throughput + MFU/padding accounting
+# ALS train throughput (fused ladder, the library default) + f32 + rank 200
 # ---------------------------------------------------------------------------
 
 
-def bench_als(users, items, vals, chunk_sizes=CHUNK_SIZES, reps=REPS):
+_LADDER_CACHE: dict = {}
+
+
+def _staged_ladder(users, items, vals, rank):
+    """One ladder layout + HBM staging per rank, memoized — bench_als,
+    bench_phases, and bench_rank200 share it (the 20M-entry packing and
+    both orientations' device upload are seconds each)."""
+    # fingerprint the data too: a rank-only key would hand back stale
+    # staged buffers if ever called with different ratings
+    key = (rank, len(users), int(users[:1000].sum()),
+           int(items[:1000].sum()))
+    if key in _LADDER_CACHE:
+        return _LADDER_CACHE[key]
+    from predictionio_tpu.ops import als as A
+
+    coo = A.RatingsCOO(users, items, vals, USERS, ITEMS)
+    by_u = A.ladder_rows(coo)
+    by_i = A.ladder_rows(coo.transpose())
+    dev_u = A.stage_buckets(by_u, rank)
+    dev_i = A.stage_buckets(by_i, rank)
+    out = (by_u, by_i, A._fused_bucket_args(dev_u),
+           A._fused_bucket_args(dev_i))
+    _LADDER_CACHE[key] = out
+    return out
+
+
+def _fused_run_fn(bu, bi, rank, bf16, item0_np):
     import jax
     import jax.numpy as jnp
 
-    from predictionio_tpu.ops.als import (
-        RatingsCOO,
-        chunk_rows,
-        half_step_flops,
-        solve_half,
-        stage_chunks,
-    )
+    from predictionio_tpu.ops import als as A
 
-    coo = RatingsCOO(users, items, vals, USERS, ITEMS)
-    by_user = chunk_rows(coo, chunk_sizes)
-    by_item = chunk_rows(coo.transpose(), chunk_sizes)
+    def run(n):
+        # item0 uploads fresh per call (the program donates arg 0)
+        u, it = A._als_iterate_fused(
+            jax.device_put(item0_np), bu, bi, n, LAM, 40.0, False,
+            USERS, ITEMS, bf16=bf16, cg_steps=None)
+        return float(jnp.sum(jnp.abs(u))) + float(jnp.sum(jnp.abs(it)))
 
-    fl_u = half_step_flops(by_user, RANK)
-    fl_i = half_step_flops(by_item, RANK)
+    return run
+
+
+def bench_als(users, items, vals, reps=REPS):
+    from predictionio_tpu.ops.als import half_step_flops
+
+    by_u, by_i, bu, bi = _staged_ladder(users, items, vals, RANK)
+    fl_u = half_step_flops(by_u, RANK)
+    fl_i = half_step_flops(by_i, RANK)
     useful = fl_u["useful_flops"] + fl_i["useful_flops"]
     executed = fl_u["executed_flops"] + fl_i["executed_flops"]
 
     rng = np.random.default_rng(1)
-    item_f0 = (rng.standard_normal((ITEMS, RANK)) / np.sqrt(RANK)).astype(
-        np.float32
-    )
-    item_f = jax.device_put(jnp.asarray(item_f0))
-    dev_user = stage_chunks(by_user, RANK)
-    dev_item = stage_chunks(by_item, RANK)
+    item0 = (rng.standard_normal((ITEMS, RANK)) / np.sqrt(RANK)).astype(
+        np.float32)
 
-    def run(n):
-        """n chained full iterations ending in a forcing scalar fetch."""
-        cur = item_f
-        for _ in range(n):
-            user_f = solve_half(cur, dev_user, RANK, LAM)
-            cur = solve_half(user_f, dev_item, RANK, LAM)
-        return float(jnp.sum(jnp.abs(cur))), user_f, cur
-
-    run(1)  # compile warm-up
-    iter_times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        run(N_SHORT)
-        t_short = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        _, user_f, cur = run(N_LONG)
-        t_long = time.perf_counter() - t0
-        iter_times.append((t_long - t_short) / (N_LONG - N_SHORT))
-    best = min(iter_times)
+    run = _fused_run_fn(bu, bi, RANK, True, item0)
+    run(N_SHORT)  # compile warm-up — BOTH chain lengths, so no rep
+    run(N_LONG)   # ever times a compile
+    best, iter_times = _chain_time(run, reps=reps)
     mean = statistics.fmean(iter_times)
     stdev_pct = (
         100.0 * statistics.stdev(iter_times) / mean if reps > 1 else 0.0
@@ -177,8 +226,129 @@ def bench_als(users, items, vals, chunk_sizes=CHUNK_SIZES, reps=REPS):
     }
     if peak:
         result["mfu_pct"] = round(100.0 * useful / best / peak, 2)
-    # final factors reused by the serving benchmark
-    return result, np.asarray(user_f), np.asarray(cur)
+
+    # f32-HIGHEST opt-in rate (the precision trade, tracked)
+    run32 = _fused_run_fn(bu, bi, RANK, False, item0)
+    run32(N_SHORT)
+    run32(N_LONG)
+    result["als_f32_rate"] = round(
+        NNZ / _chain_time(run32, reps=max(2, reps - 3))[0], 1)
+
+    # final factors for the serving benchmark (one more full train)
+    import jax
+    import numpy as _np
+
+    from predictionio_tpu.ops import als as A
+
+    u, it = A._als_iterate_fused(
+        jax.device_put(item0), bu, bi, 10, LAM, 40.0, False,
+        USERS, ITEMS, bf16=True, cg_steps=None)
+    return result, _np.asarray(u), _np.asarray(it)
+
+
+def bench_phases(users, items, vals):
+    """Per-phase decomposition via chain variants on the ladder layout:
+    G = gather+mask only, E = gather+einsums; the full iteration comes
+    from the headline. Feedback keeps chain inputs varying (protocol)."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    _, _, bu, bi = _staged_ladder(users, items, vals, RANK)
+    _HI = jax.lax.Precision.HIGHEST
+
+    @partial(jax.jit, static_argnames=("einsum",))
+    def half_variant(V, buckets, base, einsum: bool):
+        tot = jnp.float32(0.0)
+        for row_ids, cols, vals_, deg in buckets:
+            L = cols.shape[-1]
+
+            def body(carry, xs):
+                c, v, d = xs
+                m = (jnp.arange(L, dtype=jnp.int32)[None, :]
+                     < d[:, None]).astype(jnp.float32)
+                F = V[c]
+                if einsum:
+                    Fm = F * m[..., None]
+                    Ap = jnp.einsum("blk,blm->bkm", Fm.astype(jnp.bfloat16),
+                                    F.astype(jnp.bfloat16),
+                                    preferred_element_type=jnp.float32)
+                    bp = jnp.einsum("bl,blk->bk", (v * m).astype(jnp.bfloat16),
+                                    F.astype(jnp.bfloat16),
+                                    preferred_element_type=jnp.float32)
+                    s = jnp.sum(Ap) + jnp.sum(bp)
+                else:
+                    s = jnp.sum(F * m[..., None]) + jnp.sum(v)
+                return carry + s, None
+
+            tot, _ = jax.lax.scan(body, tot, (cols, vals_, deg))
+        return base * (1.0 + 1e-12 * jnp.tanh(tot))
+
+    rng = np.random.default_rng(1)
+    item0 = jax.device_put(jnp.asarray(
+        (rng.standard_normal((ITEMS, RANK)) / np.sqrt(RANK)).astype(np.float32)))
+    base_u = jax.device_put(jnp.asarray(
+        (rng.standard_normal((USERS, RANK)) / np.sqrt(RANK)).astype(np.float32)))
+    base_i = jax.device_put(jnp.asarray(
+        (rng.standard_normal((ITEMS, RANK)) / np.sqrt(RANK)).astype(np.float32)))
+
+    out = {}
+    for name, einsum in (("gather", False), ("einsum", True)):
+        def run(n):
+            cur = item0
+            for _ in range(n):
+                uf = half_variant(cur, bu, base_u, einsum)
+                cur = half_variant(uf, bi, base_i, einsum)
+            return float(jnp.sum(jnp.abs(cur)))
+
+        run(1)
+        out[name] = _chain_time(run, reps=3)[0] * 1e3
+    return {
+        "phase_gather_ms": round(out["gather"], 1),
+        "phase_einsum_ms": round(out["einsum"] - out["gather"], 1),
+    }
+
+
+RANK200 = 200
+
+
+def bench_rank200(users, items, vals):
+    """BASELINE.md's rank-200 ML-20M configuration, in the bench
+    contract (VERDICT r2 missing #2). Heavy: the normal-equation build
+    is 2K^2 FLOPs/entry = ~4.3 PFLOP/iteration at rank 200, so short
+    chains."""
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops import als as A
+    from predictionio_tpu.ops.als import half_step_flops
+
+    by_u, by_i, bu, bi = _staged_ladder(users, items, vals, RANK200)
+    fl_u = half_step_flops(by_u, RANK200)
+    fl_i = half_step_flops(by_i, RANK200)
+    useful = fl_u["useful_flops"] + fl_i["useful_flops"]
+
+    rng = np.random.default_rng(1)
+    item0 = (rng.standard_normal((ITEMS, RANK200)) /
+             np.sqrt(RANK200)).astype(np.float32)
+
+    def run(n):
+        u, it = A._als_iterate_fused(
+            jax.device_put(item0), bu, bi, n, LAM, 40.0, False,
+            USERS, ITEMS, bf16=True, cg_steps=None)
+        return float(jnp.sum(jnp.abs(u))) + float(jnp.sum(jnp.abs(it)))
+
+    run(1)
+    run(5)    # warm both chain lengths before timing
+    best, _ = _chain_time(run, n_short=1, n_long=5, reps=3)
+    _, peak = _device_peak()
+    out = {
+        "rank200_rate": round(NNZ / best, 1),
+        "rank200_iter_ms": round(best * 1e3, 1),
+    }
+    if peak:
+        out["rank200_mfu_pct"] = round(100.0 * useful / best / peak, 2)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -205,16 +375,18 @@ def bench_numpy_baseline(users, items, vals):
         "numpy_1core_rate": round(one_core_rate, 1),
         "baseline_rate": round(one_core_rate * cores, 1),
         "baseline_cores": cores,
+        "baseline_64core_rate": round(one_core_rate * 64, 1),
         "baseline": (
             f"single-process NumPy ALS-WR (segment reductions) x {cores} "
             "core(s) (Spark local[N] perfect-scaling proxy; generous to "
-            "Spark)"
+            "Spark); vs_baseline_64core scales the same rate to a 64-core "
+            "cluster width"
         ),
     }
 
 
 # ---------------------------------------------------------------------------
-# Serving latency: the trained model behind the real engine server
+# Serving latency: HTTP + in-process + batched top-k at 2M items
 # ---------------------------------------------------------------------------
 
 
@@ -264,7 +436,8 @@ def bench_serving(user_f, item_f, users, items, n_queries=SERVE_QUERIES):
         engine_id="bench", engine_version="1", engine_variant="bench",
         engine_factory="bench",
     )
-    deployed = DeployedEngine(None, instance, [algo], FirstServing(), [model])
+    serving = FirstServing()
+    deployed = DeployedEngine(None, instance, [algo], serving, [model])
     server = EngineServer(deployed, ServerConfig(ip="127.0.0.1", port=0))
     server.start()
     try:
@@ -286,11 +459,107 @@ def bench_serving(user_f, item_f, users, items, n_queries=SERVE_QUERIES):
         lat = np.asarray([query(u) for u in query_uix[SERVE_WARMUP:]])
     finally:
         server.stop()
+
+    # in-process p50: the identical serve flow minus HTTP + loopback,
+    # so the link's share of p50 is measured rather than asserted
+    # (VERDICT r2 weak #5)
+    def inproc(uix: int) -> float:
+        q = rec.Query(user=f"u{int(uix)}", num=10)
+        t0 = time.perf_counter()
+        serving.serve(q, [algo.predict(model, q)])
+        return time.perf_counter() - t0
+
+    for uix in query_uix[:SERVE_WARMUP]:
+        inproc(uix)
+    inlat = np.asarray([inproc(u) for u in query_uix[SERVE_WARMUP:]])
+
     return {
         "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
         "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+        "serve_inproc_p50_ms": round(float(np.percentile(inlat, 50)) * 1e3, 2),
         "serve_queries": int(len(lat)),
+        **bench_batch_predict(),
     }
+
+
+def bench_batch_predict(n_items: int = 2_000_000, batch: int = 256,
+                        rounds: int = 8):
+    """Batched top-k scoring against a 2M-item catalog — the eval hot
+    path (recommend_topk_chunked's envelope; VERDICT r2 weak #5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops.topk import recommend_topk_fused
+
+    rng = np.random.default_rng(3)
+    item_f = jax.device_put(jnp.asarray(
+        rng.standard_normal((n_items, RANK)).astype(np.float32)))
+    uv = jax.device_put(jnp.asarray(
+        rng.standard_normal((batch, RANK)).astype(np.float32)))
+    seen = np.zeros((batch, 32), dtype=np.int32)
+    mask = np.zeros((batch, 32), dtype=np.float32)
+    allow = jnp.ones((n_items,), dtype=jnp.float32)
+
+    def run(n):
+        cur = uv
+        for _ in range(n):
+            v, i = recommend_topk_fused(cur, item_f, seen, mask, allow, 10)
+            # feed the scores back so chained inputs differ (protocol)
+            cur = cur * (1.0 + 1e-9 * jnp.tanh(jnp.sum(v)))
+        return float(jnp.sum(jnp.asarray(i)))
+
+    run(1)
+    per_call, _ = _chain_time(run, n_short=1, n_long=1 + rounds, reps=3)
+    return {"batch_predict_qps_2m": round(batch / per_call, 1)}
+
+
+# ---------------------------------------------------------------------------
+# Attention: pallas flash vs XLA at the envelope midpoint
+# ---------------------------------------------------------------------------
+
+
+def bench_attention(S: int = 4096, B: int = 1, H: int = 4, D: int = 64,
+                    rounds: int = 64):
+    """Forward serving attention at S=4096: the pallas flash kernel vs
+    the XLA formulation (VERDICT r2 weak #4 — the 35x/OOM envelope
+    lived only in a docstring)."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from predictionio_tpu.ops.attention import full_attention
+    from predictionio_tpu.ops.pallas_attention import flash_attention
+
+    rng = np.random.default_rng(2)
+
+    def mk():
+        return jax.device_put(jnp.asarray(
+            rng.standard_normal((B, H, S, D)).astype(np.float32) * 0.05))
+
+    q, k, v = mk(), mk(), mk()
+
+    @partial(jax.jit, static_argnames=("flash",))
+    def step(q, k, v, flash: bool):
+        fn = (lambda *a, **kw: flash_attention(*a, force=True, **kw)) \
+            if flash else full_attention
+        o = fn(q, k, v, causal=True)
+        # feed back: next q depends on this output (protocol)
+        return q * (1.0 + 1e-9 * jnp.tanh(jnp.sum(o))), o
+
+    out = {}
+    for name, flash in (("flash", True), ("xla", False)):
+        def run(n):
+            cur = q
+            o = None
+            for _ in range(n):
+                cur, o = step(cur, k, v, flash)
+            return float(jnp.sum(jnp.abs(o)))
+
+        run(1)
+        out[f"{name}_s{S}_ms"] = round(
+            _chain_time(run, n_short=1, n_long=1 + rounds, reps=3)[0] * 1e3,
+            2)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -361,7 +630,7 @@ def bench_ingest(n_events: int = 2000, batch: int = 50):
 
 
 # ---------------------------------------------------------------------------
-# Quality parity (the "at matching MAP@10" half of the north star)
+# Quality (parity + ranking-wins) and the rank-200 quality validation
 # ---------------------------------------------------------------------------
 
 
@@ -369,20 +638,50 @@ def bench_quality():
     from predictionio_tpu.data.movielens import synthesize_ml100k
     from predictionio_tpu.e2 import quality
 
-    q = quality.compare_quality(
-        synthesize_ml100k(), rank=10, iterations=10, lam=0.05, k_fold=5
-    )
-    return {
+    ds = synthesize_ml100k()
+    q = quality.compare_quality(ds, rank=10, iterations=10, lam=0.05,
+                                k_fold=5)
+    out = {
         "map10_tpu": q["map10_tpu"],
         "map10_ref": q["map10_ref"],
         "map10_popularity": q["map10_popularity"],
+        # ranking-WINS key (vs the parity keys above): the implicit
+        # path must beat the popularity baseline; explicit ALS does not
+        # (MLlib's doesn't either — it models rating values, not
+        # interaction propensity)
+        "map10_implicit": q["map10_implicit"],
         "rmse_tpu": q["rmse_tpu"],
         "rmse_ref": q["rmse_ref"],
+    }
+    out.update(_rank200_quality(ds))
+    return out
+
+
+def _rank200_quality(ds, iterations: int = 5, lam: float = 0.1):
+    """Rank-200 RMSE parity: device ALS at the BASELINE rank vs an
+    exact per-row NumPy solver on the same fold — validates the CG step
+    cap at the rank where it matters (VERDICT r2 missing #2 /
+    ADVICE r2 medium)."""
+    from predictionio_tpu.e2 import quality
+    from predictionio_tpu.ops.als import RatingsCOO, als_train
+
+    train, test_by_user = quality.kfold_split(ds, k_fold=5)
+    f = als_train(
+        RatingsCOO(train.users, train.items, train.ratings,
+                   train.num_users, train.num_items),
+        rank=RANK200, iterations=iterations, lam=lam, seed=3)
+    rmse_tpu = quality.test_rmse(f.user, f.item, test_by_user)
+    U, V = quality.numpy_als_wr_rowloop(
+        train, rank=RANK200, iterations=iterations, lam=lam, seed=4)
+    rmse_ref = quality.test_rmse(U, V, test_by_user)
+    return {
+        "rank200_rmse_tpu": round(rmse_tpu, 4),
+        "rank200_rmse_ref": round(rmse_ref, 4),
     }
 
 
 # ---------------------------------------------------------------------------
-# sessionrec transformer train step (beyond-reference model family)
+# sessionrec transformer: dense, long-context training, flash serving
 # ---------------------------------------------------------------------------
 
 
@@ -409,8 +708,6 @@ def bench_seqrec(steps: int = 20, batch: int = 64):
     step_fn = make_train_step(cfg)
 
     def run(n):
-        """n chained steps; the final loss fetch forces the whole chain
-        (see the measurement-protocol note at the top)."""
         params, opt_m, opt_v = params0, opt_m0, opt_v0
         for i in range(n):
             params, opt_m, opt_v, loss = step_fn(
@@ -437,20 +734,74 @@ def bench_seqrec(steps: int = 20, batch: int = 64):
     if peak:
         out["seqrec_mfu_pct"] = round(
             100.0 * tokens * per_token / dt / peak, 2)
+    out.update(bench_seqrec_longcontext())
     return out
 
 
-# ---------------------------------------------------------------------------
-# Chunk-layout sweep (README table; VERDICT r1 item 3)
-# ---------------------------------------------------------------------------
+def bench_seqrec_longcontext(steps: int = 4):
+    """The long-context ladder's tracked numbers (VERDICT r2 weak #7):
+    training step rate at S=4096 (blockwise attention path) and serving
+    p50 at S=2048 (predict_topk end to end)."""
+    import jax
+    import jax.numpy as jnp
 
+    from predictionio_tpu.models.seqrec import (
+        PAD,
+        SeqRecConfig,
+        init_params,
+        make_train_step,
+        predict_topk,
+    )
 
-def sweep():
-    users, items, vals = make_ratings(NNZ)
-    for sizes in [(1024, 128), (2048, 256), (512, 128), (1024, 256),
-                  (4096, 512, 128)]:
-        res, _, _ = bench_als(users, items, vals, chunk_sizes=sizes, reps=3)
-        print(json.dumps({"chunk_sizes": sizes, **res}), flush=True)
+    out = {}
+    rng = np.random.default_rng(6)
+
+    # --- S=4096 training (forward routes through blockwise_attention)
+    cfg = SeqRecConfig(vocab=50_000, max_len=4096, d_model=256, n_heads=4,
+                       n_layers=4)
+    batch = 4
+    seqs = rng.integers(1, cfg.vocab, size=(batch, cfg.max_len),
+                        dtype=np.int64).astype(np.int32)
+    tgts = rng.integers(1, cfg.vocab, size=(batch, cfg.max_len),
+                        dtype=np.int64).astype(np.int32)
+    params0 = init_params(jax.random.PRNGKey(0), cfg)
+    m0 = jax.tree.map(jnp.zeros_like, params0)
+    v0 = jax.tree.map(jnp.zeros_like, params0)
+    step_fn = make_train_step(cfg)
+
+    def run(n):
+        params, m, v = params0, m0, v0
+        for i in range(n):
+            params, m, v, loss = step_fn(params, m, v, i + 1, seqs, tgts,
+                                         1e-3)
+        return float(loss)
+
+    run(1)
+    per_step, _ = _chain_time(run, n_short=1, n_long=1 + steps, reps=2)
+    out["seqrec_s4096_tokens_per_sec"] = round(
+        batch * cfg.max_len / per_step, 1)
+
+    # --- S=2048 serving p50 (predict_topk end to end)
+    scfg = SeqRecConfig(vocab=50_000, max_len=2048, d_model=256, n_heads=4,
+                        n_layers=4)
+    sparams = init_params(jax.random.PRNGKey(1), scfg)
+    hist = rng.integers(1, scfg.vocab, size=(1, scfg.max_len),
+                        dtype=np.int64).astype(np.int32)
+    vocab_mask = jnp.zeros((scfg.vocab,), dtype=jnp.float32)
+
+    lats = []
+    predict_topk(sparams, jnp.asarray(hist), 10, scfg, vocab_mask)  # compile
+    for j in range(40):
+        h = jnp.asarray(
+            np.where(hist == 0, 0, (hist + j) % (scfg.vocab - 1) + 1)
+            .astype(np.int32))
+        t0 = time.perf_counter()
+        v_, i_ = predict_topk(sparams, h, 10, scfg, vocab_mask)
+        float(jnp.sum(v_)) + float(jnp.sum(i_))   # forcing fetch
+        lats.append(time.perf_counter() - t0)
+    out["seqrec_serve_s2048_p50_ms"] = round(
+        float(np.percentile(lats, 50)) * 1e3, 2)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -458,12 +809,9 @@ def sweep():
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--sweep", action="store_true",
-                        help="bucket-layout grid instead of the bench line")
+    parser.add_argument("--skip-heavy", action="store_true",
+                        help="headline + quality + ingest only")
     args = parser.parse_args()
-    if args.sweep:
-        sweep()
-        return
 
     users, items, vals = make_ratings(NNZ)
     als, user_f, item_f = bench_als(users, items, vals)
@@ -476,14 +824,23 @@ def main() -> None:
 
     base = bench_numpy_baseline(users, items, vals)
     line["vs_baseline"] = round(line["value"] / base["baseline_rate"], 2)
+    line["vs_baseline_64core"] = round(
+        line["value"] / base["baseline_64core_rate"], 2)
     line.update(base)
 
-    for section, fn in (
+    sections = [
+        ("phases", lambda: bench_phases(users, items, vals)),
+        ("rank200", lambda: bench_rank200(users, items, vals)),
         ("serving", lambda: bench_serving(user_f, item_f, users, items)),
+        ("attention", bench_attention),
         ("quality", bench_quality),
         ("seqrec", bench_seqrec),
         ("ingest", bench_ingest),
-    ):
+    ]
+    if args.skip_heavy:
+        sections = [s for s in sections
+                    if s[0] in ("quality", "ingest")]
+    for section, fn in sections:
         try:
             line.update(fn())
         except Exception as e:  # keep the primary metric on partial failure

@@ -1,0 +1,155 @@
+"""E-commerce template, weighted-items variant.
+
+Mirror of the reference's weighted-items variant (reference:
+examples/scala-parallel-ecommercerecommendation/weighted-items/
+src/main/scala/ALSAlgorithm.scala:70-74, 234-295): operators publish
+weight groups as a ``$set`` event on the constraint entity
+``weightedItems`` —
+
+    {"weights": [{"items": ["i1", "i2"], "weight": 2.0},
+                 {"items": ["i9"],       "weight": 0.5}]}
+
+— and every query re-reads the LATEST groups and multiplies each item's
+score by its weight (default 1.0). Promoted items (> 1.0) surface more
+often, demoted ones (< 1.0) less, all live: no retrain, no redeploy.
+
+TPU design note: for known users the reference multiplies scores
+item-by-item inside its ranking loop; here the weights fold into the
+item-factor table (``score = u . (w * v) = w * (u . v)`` for w >= 0),
+so the existing jitted matmul+top-k kernel runs unchanged — the
+weighting costs one (I, K) elementwise multiply, cached per
+(weights version, model). The unknown-user fallback ranks by cosine
+similarity — which normalizes a table scaling away — so that path
+re-weights the similarity scores over an expanded candidate pool
+instead (both paths weighted, like the reference's
+predictKnownUser/predictSimilar).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from predictionio_tpu.controller import Engine, FirstServing
+from predictionio_tpu.templates.ecommerce import (
+    ECommAlgorithm,
+    ECommAlgorithmParams,
+    ECommDataSource,
+    ECommModel,
+    ECommPreparator,
+    ItemScore,
+    PredictedResult,
+    Query,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightedParams(ECommAlgorithmParams):
+    weight_constraint_id: str = "weightedItems"
+
+
+class WeightedECommAlgorithm(ECommAlgorithm):
+    """ECommAlgorithm + live per-item score weights."""
+
+    params_class = WeightedParams
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self._weight_cache: tuple[str | None, object] | None = None
+
+    def _weight_groups(self):
+        """Latest $set on (constraint, weightedItems) -> list of
+        {items, weight} groups; [] when unset (ALSAlgorithm.scala:234-251
+        in the variant, same live-read pattern as unavailableItems)."""
+        p = self.params
+        if self._ctx is None or not p.app_name:
+            return None, []
+        try:
+            events = list(
+                self._ctx.event_store().find_by_entity(
+                    p.app_name, p.unavailable_constraint_entity,
+                    p.weight_constraint_id, event_names=["$set"],
+                    limit=1, latest=True,
+                )
+            )
+        except Exception:
+            return None, []
+        if not events:
+            return None, []
+        ev = events[0]
+        groups = ev.properties.get_opt("weights") or []
+        return ev.event_id, groups
+
+    def _weights_vector(self, model: ECommModel):
+        version, groups = self._weight_groups()
+        if not groups:
+            return version, None
+        w = np.ones(len(model.als.item_ids), dtype=np.float32)
+        for group in groups:
+            weight = float(group.get("weight", 1.0))
+            if weight < 0.0:
+                raise ValueError(f"negative item weight: {group}")
+            for item_id in group.get("items", []):
+                ix = model.als.item_ids.get(item_id)
+                if ix is not None:
+                    w[ix] = weight
+        return version, w
+
+    def _weighted_model(self, model: ECommModel) -> ECommModel:
+        """Item factors scaled by the current weights, cached per
+        (weights-event version, base model) — the base model changes
+        across eval folds and /reload hot-swaps, so the version alone
+        is not a sound key."""
+        version, w = self._weights_vector(model)
+        if w is None:
+            return model
+        key = (version, id(model.als))
+        if self._weight_cache is not None and self._weight_cache[0] == key:
+            return self._weight_cache[1]
+        weighted = ECommModel(
+            als=dataclasses.replace(
+                model.als,
+                item_factors=model.als.item_factors * w[:, None],
+            ),
+            categories=model.categories,
+        )
+        self._weight_cache = (key, weighted)
+        return weighted
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        if query.user in model.als.user_ids:
+            # known user: dot-product ranking, where the weights fold
+            # exactly into the factor table (u . (w v) = w (u . v))
+            return super().predict(self._weighted_model(model), query)
+        # unknown user: the fallback ranks by COSINE similarity, which
+        # normalizes a factor-table scaling away — apply the weights to
+        # the similarity scores instead (the reference variant
+        # multiplies final scores on both paths, ALSAlgorithm.scala:
+        # 294-295, 400-401), over an expanded candidate pool so
+        # promoted items outside the unweighted top-num can surface
+        version, w = self._weights_vector(model)
+        recent = self._recent_items(query.user)
+        if not recent or w is None:
+            return super().predict(model, query)
+        allow = self._allow_vector(model, query)
+        pool = model.als.similar(recent, min(
+            query.num * 8, model.als.item_factors.shape[0]), allow=allow)
+        rescored = sorted(
+            ((item, score * float(w[model.als.item_ids[item]]))
+             for item, score in pool),
+            key=lambda kv: -kv[1],
+        )[: query.num]
+        return PredictedResult(
+            item_scores=tuple(ItemScore(item=i, score=s)
+                              for i, s in rescored)
+        )
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class_map=ECommDataSource,
+        preparator_class_map=ECommPreparator,
+        algorithm_class_map={"ecomm": WeightedECommAlgorithm},
+        serving_class_map=FirstServing,
+    )

@@ -1,0 +1,40 @@
+"""Seed WeightedEcommApp: two view-taste clusters plus an initial
+weightedItems constraint. Run after `pio app new WeightedEcommApp`."""
+
+import sys
+
+import numpy as np
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.registry import Storage
+
+storage = Storage.default()
+app = storage.get_meta_data_apps().get_by_name("WeightedEcommApp")
+if app is None:
+    sys.exit("app 'WeightedEcommApp' not found — run "
+             "`pio app new WeightedEcommApp` first")
+
+events = storage.get_events()
+rng = np.random.default_rng(7)
+n = 0
+for u in range(20):
+    for i in range(16):
+        if i % 2 == u % 2 and rng.random() < 0.85:
+            events.insert(
+                Event(event="view", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties=DataMap({})),
+                app.id,
+            )
+            n += 1
+
+events.insert(
+    Event(event="$set", entity_type="constraint", entity_id="weightedItems",
+          properties=DataMap({"weights": [
+              {"items": ["i3"], "weight": 2.0},
+          ]})),
+    app.id,
+)
+print(f"seeded {n} view events + 1 weights constraint into "
+      f"WeightedEcommApp (app id {app.id})")

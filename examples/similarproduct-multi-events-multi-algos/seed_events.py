@@ -1,0 +1,51 @@
+"""Seed MultiSimilarApp: two view-taste clusters plus like/dislike
+signals (with one like->dislike flip to exercise latest-wins dedup).
+Run after `pio app new MultiSimilarApp`."""
+
+import sys
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.registry import Storage
+
+storage = Storage.default()
+app = storage.get_meta_data_apps().get_by_name("MultiSimilarApp")
+if app is None:
+    sys.exit("app 'MultiSimilarApp' not found — run "
+             "`pio app new MultiSimilarApp` first")
+
+events = storage.get_events()
+rng = np.random.default_rng(11)
+t0 = datetime.now(timezone.utc)
+n = 0
+
+
+def emit(event, u, i, minutes=0):
+    global n
+    events.insert(
+        Event(event=event, entity_type="user", entity_id=f"u{u}",
+              target_entity_type="item", target_entity_id=f"i{i}",
+              properties=DataMap({}),
+              event_time=t0 + timedelta(minutes=minutes)),
+        app.id,
+    )
+    n += 1
+
+
+for u in range(20):
+    for i in range(16):
+        if i % 2 == u % 2 and rng.random() < 0.85:
+            emit("view", u, i)
+        if i % 2 == u % 2 and rng.random() < 0.5:
+            emit("like", u, i)
+# everyone dislikes item 0 (despite viewing it)
+for u in range(0, 20, 2):
+    emit("dislike", u, 0, minutes=5)
+# u2 liked i0 late, then flipped to dislike even later: dislike wins
+emit("like", 2, 0, minutes=6)
+emit("dislike", 2, 0, minutes=7)
+
+print(f"seeded {n} events into MultiSimilarApp (app id {app.id})")

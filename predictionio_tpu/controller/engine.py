@@ -124,9 +124,17 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         self,
         ctx: "EngineContext",
         engine_params: EngineParams,
+        algorithms: Sequence[Any] | None = None,
     ) -> TrainResult:
+        """``algorithms`` lets deploy-time retrain train the SAME
+        instances that will serve (see prepare_deploy) — train hooks
+        stash serve-time state on the instance just like load_model
+        hooks do."""
         params = ctx.workflow_params
-        data_source, preparator, algorithms, _ = self.make_components(engine_params)
+        data_source, preparator, made_algorithms, _ = \
+            self.make_components(engine_params)
+        if algorithms is None:
+            algorithms = made_algorithms
 
         td = data_source.read_training(ctx)
         _sanity_check(td, "training data", not params.skip_sanity_check)
@@ -160,8 +168,17 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         ctx: "EngineContext",
         engine_params: EngineParams,
         persisted: Sequence[Any],
+        algorithms: Sequence[Any] | None = None,
     ) -> list[Any]:
-        _, _, algorithms, _ = self.make_components(engine_params)
+        """Restore deployable models. ``algorithms`` MUST be the same
+        instances that will later serve the models when an algorithm
+        keeps deploy-time state — ``load_model`` hooks commonly stash
+        the context for serve-time live reads (e.g. the ecommerce
+        template's unavailableItems/weight constraints), and loading on
+        one instance while serving with another silently drops that
+        state (caught by the round-3 CLI end-to-end drive)."""
+        if algorithms is None:
+            _, _, algorithms, _ = self.make_components(engine_params)
         models: list[Any] = []
         retrain_needed = any(p is None for p in persisted)
         retrained: TrainResult | None = None
@@ -170,8 +187,12 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
             # save_model=False: deploy-time retrain must not redo (or
             # overwrite) persistence work.
             logger.info("some models were not persisted; retraining for deploy")
+            # retrain on the SERVING instances, not throwaway ones —
+            # train hooks stash serve-time state exactly like
+            # load_model hooks (same bug class as the docstring above)
             retrained = self.train(
-                ctx.with_workflow_params(save_model=False), engine_params
+                ctx.with_workflow_params(save_model=False), engine_params,
+                algorithms=algorithms,
             )
         for i, (algo, blob) in enumerate(zip(algorithms, persisted)):
             if blob is None:

@@ -117,7 +117,18 @@ class ALSModel:
             if exclude_seen
             else np.empty(0, dtype=np.int32)
         )
-        seen = seen[:_SEEN_PAD]
+        if len(seen) > _SEEN_PAD:
+            # exclude_seen is a correctness contract — overflow beyond
+            # the packed buffer folds into the allow vector (exact; one
+            # extra (I,) upload only for >512-item histories) instead
+            # of silently truncating
+            if allow is None:
+                allow = np.ones((self.item_factors.shape[0],),
+                                dtype=np.float32)
+            else:
+                allow = np.asarray(allow, dtype=np.float32).copy()
+            allow[seen[_SEEN_PAD:]] = 0.0
+            seen = seen[:_SEEN_PAD]
         allow_v = self._allow_or_default(allow)
         k = min(_serving_k(num), self.item_factors.shape[0])
         buf = np.zeros((1 + 2 * _SEEN_PAD,), dtype=np.int32)

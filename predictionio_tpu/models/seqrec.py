@@ -107,10 +107,12 @@ def forward(
     """Hidden states (B, S, D) in cfg.dtype. When ``mesh`` has a
     ``seq_axis``, attention runs as ring attention over it.
 
-    ``inference=True`` routes single-device attention through the
-    pallas flash kernel's auto-dispatch (ops/pallas_attention — wins
-    from S=2048, the only path at S=16384; forward-only, so training
-    keeps the XLA formulation)."""
+    ``inference=True`` routes single-device attention through
+    ops/pallas_attention.flash_attention — which, since the round-3
+    envelope re-measurement, is XLA full attention unless a caller
+    forces the pallas kernel (it lost at every serving shape; module
+    docstring has the table). The flag is kept so serving stays a
+    distinct dispatch point from the differentiable training paths."""
     B, S = seqs.shape
     d, H = cfg.d_model, cfg.n_heads
     hd = d // H

@@ -1,40 +1,43 @@
-"""Pallas TPU kernel: flash attention — the long-sequence serving path.
+"""Pallas TPU kernel: flash attention — kept force-only, on measurement.
 
 Tile-streamed causal attention with the standard flash online softmax:
 for each query tile, K/V tiles stream through the MXU and a running
 (max, denominator, numerator) carry folds each tile — the S x S logits
 matrix never exists in HBM.
 
-**Auto-dispatched for S >= 2048 on TPU, on measurement.** Round 1
-concluded the opposite ("XLA 2.3ms at S=16384 vs pallas 34.8ms") from
-timings taken with bare ``block_until_ready``, which on this
-remote-attached backend can return before work executes (see bench.py's
-measurement-protocol note). Re-measured with the forcing protocol
-(bf16, B=2, H=4, D=64, chained calls, full-result fetch):
+**Auto-dispatch is OFF (round 3, re-measured).** The round-2 envelope
+claimed the kernel wins from S=2048 ("XLA 53-68ms" across S=2048-8192)
+— but those XLA timings were nearly flat in S, which no O(S^2)
+attention can be, and the round-3 re-measurement with robust
+min-endpoint differential chains (64-call chains, feed-back inputs,
+B=1 H=4 D=64 f32 — the serving shape) shows XLA ahead at EVERY depth,
+with no OOM at B=1:
 
 =======  ==========  ============
 S        XLA (ms)    pallas (ms)
 =======  ==========  ============
-1024     ~noise      ~noise
-2048     53          < 2
-4096     56          1.5
-8192     68          5.7
-16384    OOM         50
+2048     0.40        0.44
+4096     1.10        1.88
+8192     4.71        7.35
+16384    18.8        29.3
 =======  ==========  ============
 
-XLA materializes the (S, S) logits — at S=16384 that is ~8.6 GB and
-fails outright — so above the crossover this kernel is not only faster
-but the only single-device path. At S=32768 the kernel's per-(batch,
-head) K/V residency exceeds VMEM and it fails too; shard longer
-sequences over the mesh "seq" axis instead (ops/attention.
-ring_attention).
+(the bench line tracks the S=4096 pair as ``flash_s4096_ms`` /
+``xla_s4096_ms``, which is how the round-2 claim was caught.) XLA's
+timings scale ~4x per S-doubling and sit near the HBM-traffic floor of
+the materialized formulation; the pallas kernel is correct but
+~1.5-2.3x slower at these shapes, so — like the deleted pallas top-k
+(ops/topk docstring) — it does not auto-dispatch. It remains available
+via ``force=True`` (and powers the CPU interpret-mode tests) as the
+memory-bounded fallback: the XLA path materializes (B, H, S, S) logits
+(~4.3 GB at B=1 f32 S=16384) and will OOM for batched long-context
+serving where the kernel's O(S * tile) footprint still fits; callers
+with that shape opt in explicitly. Sequences beyond a chip shard over
+the mesh "seq" axis instead (ops/attention.ring_attention).
 
 Forward-only: no VJP — training paths (models/seqrec.next_item_loss,
 ring attention local blocks) use ops/attention.full_attention, whose
-per-device blocks stay small under sequence parallelism. Serving paths
-(models/seqrec.predict_topk*) route through :func:`flash_attention`.
-Interpret mode covers CPU tests (force-only — interpret is too slow for
-the auto envelope).
+per-device blocks stay small under sequence parallelism.
 """
 
 from __future__ import annotations
@@ -53,10 +56,11 @@ from predictionio_tpu.ops.attention import full_attention
 _TILE_Q = 128
 _TILE_K = 128
 _NEG = -1e30  # python float: jnp scalars would be captured consts in the kernel
-#: auto-dispatch envelope (see module docstring's measurement table):
-#: the kernel wins from S=2048 on a real TPU; the K/V-resident design
-#: exceeds VMEM around S=32768 (shard longer sequences instead)
-_MIN_SEQ = 2048
+#: auto-dispatch envelope: DISABLED (round-3 measurement table above —
+#: XLA wins at every serving shape); ``force=True`` is the only way in.
+#: _MAX_SEQ still bounds force-mode builds (K/V residency exceeds VMEM
+#: around S=32768).
+_MIN_SEQ = None
 _MAX_SEQ = 16384
 
 
@@ -161,14 +165,15 @@ def flash_attention(
     kv_mask: jax.Array | None = None,
     force: bool = False,
 ) -> jax.Array:
-    """Streaming-tile attention for the serving path.
+    """Streaming-tile attention, force-only (module docstring: the
+    round-3 re-measurement found XLA ahead at every serving shape, so
+    the auto envelope is disabled — ``_MIN_SEQ is None``).
 
-    Auto-dispatches to the pallas kernel on a real TPU for
-    ``_MIN_SEQ <= S <= _MAX_SEQ`` (measured envelope — module
-    docstring); ``force=True`` runs it anywhere it can build (incl.
-    interpret mode for CPU tests); otherwise this is exactly
-    ops/attention.full_attention. Forward-only — do not call under
-    jax.grad (training uses full_attention / ring_attention).
+    ``force=True`` runs the pallas kernel anywhere it can build (incl.
+    interpret mode for CPU tests, and the memory-bounded long-context
+    fallback where XLA's materialized logits OOM); otherwise this is
+    exactly ops/attention.full_attention. Forward-only — do not call
+    under jax.grad (training uses full_attention / ring_attention).
     """
     B, H, S, D = q.shape
     if kv_mask is None:

@@ -15,6 +15,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = jnp.float32(-jnp.inf)
 
@@ -139,20 +140,26 @@ _MIN_ITEMS = 786_432
 _MIN_BATCH = 24
 
 
-def _trim_seen(seen_cols: jax.Array, seen_mask: jax.Array):
+def _trim_seen(seen_cols, seen_mask):
     """Shrink the seen-item pad to the smallest static width covering
-    the batch's real max seen count (concrete arrays only — under a
-    tracer the caller's pad stands). Smaller uploads, same masking."""
-    if isinstance(seen_mask, jax.core.Tracer) or seen_mask.ndim != 2:
+    the batch's real max seen count. Host-side only: the seen arrays
+    originate as NumPy in the templates, and a device reduction here
+    would cost one synchronous host<->device scalar fetch per call —
+    the same per-dispatch RTT the static lam/alpha args eliminate
+    elsewhere. Device arrays / tracers and menu-width inputs pass
+    through untouched (templates/recommendation.py already right-sizes
+    to the ``_SEEN_WIDTHS`` menu)."""
+    if not isinstance(seen_mask, np.ndarray) or seen_mask.ndim != 2 \
+            or seen_mask.shape[1] in _SEEN_WIDTHS:
         return seen_cols, seen_mask
     # bound by the last occupied slot (not the count): entries need not
     # be left-packed
-    occupied = jnp.where(
+    occupied = np.where(
         seen_mask > 0,
-        jnp.arange(1, seen_mask.shape[1] + 1)[None, :],
+        np.arange(1, seen_mask.shape[1] + 1, dtype=np.int64)[None, :],
         0,
     )
-    real = int(jnp.max(occupied))
+    real = int(occupied.max()) if occupied.size else 0
     for width in _SEEN_WIDTHS:
         if real <= width < seen_mask.shape[1]:
             return seen_cols[:, :width], seen_mask[:, :width]

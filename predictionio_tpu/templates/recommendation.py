@@ -309,7 +309,11 @@ class ALSAlgorithm(ShardedAlgorithm):
         uixs = np.asarray([u for _, u, _ in known], dtype=np.int32)
         max_num = max(n for _, _, n in known)
         # right-size the seen arrays to the smallest menu width covering
-        # the real counts (smaller uploads, bounded compile-shape menu)
+        # the real counts (smaller uploads, bounded compile-shape menu);
+        # a batch whose heaviest user exceeds the menu gets the next
+        # power of two instead — exclude_seen is a correctness contract,
+        # so the seen list must NEVER silently truncate (a >512-item
+        # history would otherwise re-recommend already-seen items)
         pad = topk_ops._SEEN_WIDTHS[0]
         if self.params.exclude_seen:
             widest = max(
@@ -320,6 +324,8 @@ class ALSAlgorithm(ShardedAlgorithm):
                 pad = cap
                 if widest <= cap:
                     break
+            while pad < widest:
+                pad *= 2
         cols = np.zeros((len(known), pad), dtype=np.int32)
         mask = np.zeros((len(known), pad), dtype=np.float32)
         if self.params.exclude_seen:
@@ -334,8 +340,10 @@ class ALSAlgorithm(ShardedAlgorithm):
         vals, idxs = topk_ops.recommend_topk_fused(
             model.user_factors[jnp.asarray(uixs)],
             model.item_factors,
-            jnp.asarray(cols),
-            jnp.asarray(mask),
+            # NumPy on purpose: the dispatcher's host-side _trim_seen
+            # can only right-size concrete host arrays; jit moves them
+            cols,
+            mask,
             allow,
             k,
         )

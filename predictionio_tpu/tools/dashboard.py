@@ -12,6 +12,13 @@ instance's evaluator results as text, HTML, or JSON:
 
 (the reference's path segment is "engine_instances" even though the data
 is EvaluationInstances — kept for URL parity, Dashboard.scala:101-141).
+
+CORS: every response carries ``Access-Control-Allow-Origin: *`` and an
+``OPTIONS`` preflight for a routed resource answers with the allowed
+methods, header whitelist, and a 20-day max-age — parity with the
+``CORSSupport`` trait the reference mixes into the dashboard
+(tools/.../dashboard/CorsSupport.scala:31-77, wired at
+Dashboard.scala:89).
 """
 
 from __future__ import annotations
@@ -29,6 +36,16 @@ logger = logging.getLogger(__name__)
 
 _RESULTS_RE = re.compile(
     r"^/engine_instances/([^/]+)/evaluator_results\.(txt|html|json)$"
+)
+
+# CorsSupport.scala:33-45 — the origin header goes on every response;
+# the remaining two only on OPTIONS preflights.
+_CORS_ORIGIN = ("Access-Control-Allow-Origin", "*")
+_CORS_PREFLIGHT = (
+    ("Access-Control-Allow-Headers",
+     "Origin, X-Requested-With, Content-Type, Accept, Accept-Encoding, "
+     "Accept-Language, Host, Referer, User-Agent"),
+    ("Access-Control-Max-Age", "1728000"),
 )
 
 
@@ -91,8 +108,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        self.send_header(*_CORS_ORIGIN)
         self.end_headers()
         self.wfile.write(data)
+
+    def do_OPTIONS(self) -> None:  # noqa: N802
+        """CORS preflight (CorsSupport.scala:48-63): a routed path answers
+        with the methods it supports; unknown paths still 404."""
+        path = self.path.split("?")[0]
+        known = path == "/" or _RESULTS_RE.match(path) is not None
+        self.send_response(200 if known else 404)
+        self.send_header("Access-Control-Allow-Methods", "OPTIONS, GET")
+        self.send_header(*_CORS_ORIGIN)
+        for header in _CORS_PREFLIGHT:
+            self.send_header(*header)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
     def log_message(self, format: str, *args) -> None:
         logger.debug("%s - %s", self.address_string(), format % args)

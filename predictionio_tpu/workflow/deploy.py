@@ -152,8 +152,12 @@ def load_deployed_engine(
         instance.serving_params,
     )
     persisted = load_models(storage, instance.id)
-    models = engine.prepare_deploy(ctx, engine_params, persisted)
+    # one set of algorithm instances for BOTH load_model and serving:
+    # load hooks stash serve-time state (e.g. the context for live
+    # constraint reads) on the instance
     _, _, algorithms, serving = engine.make_components(engine_params)
+    models = engine.prepare_deploy(ctx, engine_params, persisted,
+                                   algorithms=algorithms)
     logger.info(
         "deployed engine instance %s (%s; %d algorithm(s))",
         instance.id, instance.engine_factory, len(algorithms),

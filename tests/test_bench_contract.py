@@ -25,6 +25,12 @@ def tiny_bench(monkeypatch):
                         lambda: {"map10_tpu": 0.1, "map10_ref": 0.1})
     monkeypatch.setattr(bench, "bench_seqrec",
                         lambda: {"seqrec_tokens_per_sec": 1.0})
+    # device-heavy r3 sections (pallas interpret mode on CPU is minutes;
+    # rank 200 is PFLOP-scale at real shapes)
+    monkeypatch.setattr(bench, "bench_rank200",
+                        lambda *a, **kw: {"rank200_rate": 1.0})
+    monkeypatch.setattr(bench, "bench_attention",
+                        lambda *a, **kw: {"flash_s4096_ms": 1.0})
     # keep ingest real but tiny (default posts 2000+warmup events)
     real_ingest = bench.bench_ingest
     monkeypatch.setattr(bench, "bench_ingest",

@@ -1,0 +1,157 @@
+"""Scenario test for examples/similarproduct-multi-events-multi-algos —
+the reference's similarproduct "multi" variant (examples/
+scala-parallel-similarproduct/multi/): two event streams (view +
+like/dislike with latest-wins dedup), two algorithms (view-ALS +
+LikeAlgorithm on ±1 signals), and a z-score-standardizing Serving that
+blends both score scales. Driven through the real train workflow and
+the HTTP serving path."""
+
+import json
+import os
+import sys
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.context import EngineContext
+from predictionio_tpu.workflow.persistence import load_models
+from predictionio_tpu.workflow.train import run_train
+
+EXAMPLE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "examples",
+    "similarproduct-multi-events-multi-algos",
+)
+
+
+@pytest.fixture
+def example_engine():
+    sys.path.insert(0, EXAMPLE_DIR)
+    sys.modules.pop("engine", None)
+    try:
+        import engine
+
+        yield engine
+    finally:
+        sys.path.remove(EXAMPLE_DIR)
+        sys.modules.pop("engine", None)
+
+
+@pytest.fixture
+def seeded_storage(storage):
+    """Two view-taste clusters; every even user dislikes item 0; u2
+    likes then dislikes it (latest must win)."""
+    app_id = storage.get_meta_data_apps().insert(App(0, "MultiSimilarApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(11)
+    t0 = datetime.now(timezone.utc)
+
+    def emit(event, u, i, minutes=0):
+        events.insert(
+            Event(event=event, entity_type="user", entity_id=f"u{u}",
+                  target_entity_type="item", target_entity_id=f"i{i}",
+                  properties=DataMap({}),
+                  event_time=t0 + timedelta(minutes=minutes)),
+            app_id,
+        )
+
+    for u in range(20):
+        for i in range(16):
+            if i % 2 == u % 2 and rng.random() < 0.85:
+                emit("view", u, i)
+            if i % 2 == u % 2 and i != 0 and rng.random() < 0.5:
+                emit("like", u, i)
+    for u in range(0, 20, 2):
+        emit("dislike", u, 0, minutes=5)
+    emit("like", 2, 0, minutes=6)
+    emit("dislike", 2, 0, minutes=7)
+    return storage
+
+
+def _variant():
+    with open(os.path.join(EXAMPLE_DIR, "engine.json")) as f:
+        variant = json.load(f)
+    for algo in variant["algorithms"]:
+        algo["params"]["use_mesh"] = False
+    return variant
+
+
+def test_shipped_engine_json_binds_two_algorithms(example_engine):
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(_variant())
+    names = [name for name, _ in ep.algorithm_params_list]
+    assert names == ["als", "likealgo"]
+    assert ep.algorithm_params_list[0][1].num_iterations == 12
+    assert ep.algorithm_params_list[1][1].alpha == 5.0
+
+
+def test_latest_event_wins_dedup(example_engine, seeded_storage):
+    ds = example_engine.MultiDataSource(
+        example_engine.MultiDataSourceParams(app_name="MultiSimilarApp"))
+    td = ds.read_training(EngineContext(storage=seeded_storage))
+    by_pair = dict(zip(zip(td.like_users, td.like_items), td.like_signs))
+    # u2 liked i0 at t+6 then disliked at t+7: the dislike stands
+    assert by_pair[("u2", "i0")] == -1.0
+    assert (td.like_signs == -1.0).sum() >= 10
+
+
+def test_blend_demotes_disliked_item_and_serves_http(
+        example_engine, seeded_storage):
+    from predictionio_tpu.api.engine_server import EngineServer
+    from predictionio_tpu.templates.similarproduct import Query
+    from predictionio_tpu.workflow.deploy import DeployedEngine, ServerConfig
+
+    variant = _variant()
+    outcome = run_train(variant=variant, storage=seeded_storage)
+    assert outcome.status == "COMPLETED"
+
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(variant)
+    ctx = EngineContext(storage=seeded_storage)
+    models = eng.prepare_deploy(
+        ctx, ep, load_models(seeded_storage, outcome.instance_id))
+    assert len(models) == 2
+    _, _, algos, serving = eng.make_components(ep)
+    assert isinstance(serving, example_engine.StandardizeServing)
+
+    # item 0 is in the even-view cluster, so the view-only algorithm
+    # ranks it among items similar to i2...
+    q = Query(items=("i2",), num=6)
+    view_only = algos[0].predict(models[0], q)
+    view_items = [s.item for s in view_only.item_scores]
+    assert "i0" in view_items
+
+    # ...but every even user dislikes it, so the blended serving must
+    # rank it strictly lower than the view-only algorithm does
+    blended = serving.serve(q, [a.predict(m, q)
+                                for a, m in zip(algos, models)])
+    blend_items = [s.item for s in blended.item_scores]
+    assert len(blend_items) > 0
+    v_pos = view_items.index("i0")
+    b_pos = blend_items.index("i0") if "i0" in blend_items else len(blend_items)
+    assert b_pos > v_pos, (view_items, blend_items)
+
+    # the same deployed engine behind the real HTTP server
+    instance = seeded_storage.get_meta_data_engine_instances().get(
+        outcome.instance_id)
+    server = EngineServer(
+        DeployedEngine(None, instance, algos, serving, models),
+        ServerConfig(ip="127.0.0.1", port=0),
+    )
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/queries.json",
+            data=json.dumps({"items": ["i2"], "num": 6}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = json.loads(r.read())
+        assert [s["item"] for s in body["itemScores"]] == blend_items
+    finally:
+        server.stop()

@@ -138,6 +138,33 @@ class TestDashboard:
         finally:
             dash.stop()
 
+    def test_cors_headers(self, storage):
+        """Parity: CorsSupport.scala:31-77 — allow-origin on every
+        response, preflight OPTIONS with methods/headers/max-age."""
+        dash = Dashboard(storage, ip="127.0.0.1", port=0)
+        dash.start()
+        try:
+            base = f"http://127.0.0.1:{dash.port}"
+            with urllib.request.urlopen(f"{base}/", timeout=5) as r:
+                assert r.headers["Access-Control-Allow-Origin"] == "*"
+
+            req = urllib.request.Request(f"{base}/", method="OPTIONS")
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.status == 200
+                assert r.headers["Access-Control-Allow-Origin"] == "*"
+                methods = r.headers["Access-Control-Allow-Methods"]
+                assert "OPTIONS" in methods and "GET" in methods
+                assert "Content-Type" in r.headers["Access-Control-Allow-Headers"]
+                assert r.headers["Access-Control-Max-Age"] == "1728000"
+
+            # preflight for an unrouted path is still a 404
+            req = urllib.request.Request(f"{base}/nope", method="OPTIONS")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=5)
+            assert e.value.code == 404
+        finally:
+            dash.stop()
+
 
 # ---------------------------------------------------------------------------
 # export / import

@@ -55,16 +55,28 @@ def test_chunked_matches_flat_on_finite_slots():
 
 
 def test_trim_seen_picks_menu_width():
-    cols = jnp.zeros((3, 512), jnp.int32)
-    mask = jnp.zeros((3, 512), jnp.float32).at[1, 30].set(1.0)
+    # host arrays trim to the smallest covering menu width...
+    cols = np.zeros((3, 513), np.int32)
+    mask = np.zeros((3, 513), np.float32)
+    mask[1, 30] = 1.0
     tc, tm = _trim_seen(cols, mask)
     assert tm.shape[1] == 32 and tm.shape[1] in _SEEN_WIDTHS
-    # a tracer passes through untouched (static shapes under jit)
+    # ...a menu-width input skips the scan entirely...
+    c512 = np.zeros((3, 512), np.int32)
+    m512 = np.zeros((3, 512), np.float32)
+    tc, tm = _trim_seen(c512, m512)
+    assert tm.shape[1] == 512 and tm is m512
+    # ...and device arrays / tracers pass through untouched (no host
+    # round-trip, static shapes under jit)
+    dc, dm = jnp.asarray(cols), jnp.asarray(mask)
+    tc, tm = _trim_seen(dc, dm)
+    assert tm is dm
+
     @jax.jit
     def f(c, m):
         tc, tm = _trim_seen(c, m)
         return tm.shape[1]
-    assert f(cols, mask) == 512
+    assert f(dc, dm) == 513
 
 
 def test_dispatch_threshold_uses_chunked(monkeypatch):
