@@ -168,6 +168,13 @@ def _bind_bucketize_symbols(lib: ctypes.CDLL) -> None:
     lib.pio_bucketize_fill.restype = ctypes.c_int
     lib.pio_bucketize_free.argtypes = [ctypes.c_void_p]
     lib.pio_bucketize_free.restype = None
+    # ladder entry point (ops/als.ladder_rows) — shares the bucketize
+    # handle/info/fill/free contract
+    lib.pio_ladder.argtypes = [
+        ctypes.c_int64, i32_p, i32_p, f32_p, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, i64_p, ctypes.c_int32,
+    ]
+    lib.pio_ladder.restype = ctypes.c_void_p
     # chunker entry points (same library; ops/als.chunk_rows)
     lib.pio_chunk.argtypes = [
         ctypes.c_int64, i32_p, i32_p, f32_p, ctypes.c_int32, i32_p,

@@ -21,6 +21,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <vector>
 
 namespace {
@@ -51,27 +52,25 @@ int32_t pad_len_for(int32_t kept, int32_t min_len, int32_t growth) {
     return static_cast<int32_t>(len);
 }
 
-}  // namespace
-
-extern "C" {
-
-void* pio_bucketize(int64_t nnz, const int32_t* rows, const int32_t* cols,
-                    const float* vals, int32_t num_rows, int32_t min_len,
-                    int32_t growth, int32_t max_len) try {
-    if (nnz < 0 || num_rows < 0 || min_len <= 0 || growth < 2) return nullptr;
-    auto* bz = new Bucketizer();
+// Shared grouping pipeline behind pio_bucketize and pio_ladder: row
+// validation, counting sort, RowRef construction (max_len == 0 means
+// no cap), and stable grouping by the caller's pad rule. Returns a
+// heap Bucketizer, or nullptr on invalid input; exception-safe via
+// unique_ptr (an allocation throw must not leak across the ctypes
+// boundary).
+template <typename PadFn>
+Bucketizer* build_grouped(int64_t nnz, const int32_t* rows,
+                          const int32_t* cols, const float* vals,
+                          int32_t num_rows, int32_t max_len, PadFn pad_fn) {
+    auto bz = std::make_unique<Bucketizer>();
     bz->cols = cols;
     bz->vals = vals;
-
-    // counting sort by row id (stable): row ids are dense indices in
-    // [0, num_rows). Out-of-range ids (corrupted input / int32 overflow
-    // upstream) would be out-of-bounds writes or huge allocations below —
-    // reject and let the caller fall back to the NumPy path.
+    // row ids must be dense indices in [0, num_rows): out-of-range ids
+    // (corrupted input / int32 overflow upstream) would be
+    // out-of-bounds writes below — reject and let the caller fall back
+    // to the NumPy path
     for (int64_t i = 0; i < nnz; ++i) {
-        if (rows[i] < 0 || rows[i] >= num_rows) {
-            delete bz;
-            return nullptr;
-        }
+        if (rows[i] < 0 || rows[i] >= num_rows) return nullptr;
     }
     const int64_t n_rows = num_rows;
     std::vector<int64_t> counts(n_rows + 1, 0);
@@ -83,8 +82,6 @@ void* pio_bucketize(int64_t nnz, const int32_t* rows, const int32_t* cols,
         std::vector<int64_t> cursor(offsets.begin(), offsets.end() - 1);
         for (int64_t i = 0; i < nnz; ++i) bz->order[cursor[rows[i]]++] = i;
     }
-
-    // per-row refs for non-empty rows
     for (int64_t r = 0; r < n_rows; ++r) {
         const int64_t c = offsets[r + 1] - offsets[r];
         if (c == 0) continue;
@@ -96,14 +93,11 @@ void* pio_bucketize(int64_t nnz, const int32_t* rows, const int32_t* cols,
                                                 : static_cast<int32_t>(c);
         bz->rows_.push_back(ref);
     }
-
-    // group rows by pad length (ascending, like np.unique in the
-    // Python implementation)
-    std::vector<std::pair<int32_t, int64_t>> keyed;  // (pad_len, row index)
+    // group rows by pad length (ascending, like np.unique in Python)
+    std::vector<std::pair<int32_t, int64_t>> keyed;
     keyed.reserve(bz->rows_.size());
     for (int64_t i = 0; i < static_cast<int64_t>(bz->rows_.size()); ++i) {
-        keyed.emplace_back(
-            pad_len_for(bz->rows_[i].kept, min_len, growth), i);
+        keyed.emplace_back(pad_fn(bz->rows_[i].kept), i);
     }
     std::stable_sort(keyed.begin(), keyed.end(),
                      [](const auto& a, const auto& b) {
@@ -115,7 +109,21 @@ void* pio_bucketize(int64_t nnz, const int32_t* rows, const int32_t* cols,
         }
         bz->buckets.back().row_refs.push_back(idx);
     }
-    return bz;
+    return bz.release();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pio_bucketize(int64_t nnz, const int32_t* rows, const int32_t* cols,
+                    const float* vals, int32_t num_rows, int32_t min_len,
+                    int32_t growth, int32_t max_len) try {
+    if (nnz < 0 || num_rows < 0 || min_len <= 0 || growth < 2) return nullptr;
+    return build_grouped(nnz, rows, cols, vals, num_rows, max_len,
+                         [min_len, growth](int32_t kept) {
+                             return pad_len_for(kept, min_len, growth);
+                         });
 } catch (...) {
     // no C++ exception may cross the ctypes boundary (std::terminate)
     return nullptr;
@@ -185,6 +193,37 @@ try {
 
 void pio_bucketize_free(void* handle) {
     delete static_cast<Bucketizer*>(handle);
+}
+
+// Ladder variant (ops/als.ladder_rows): same handle/info/fill/free
+// contract as pio_bucketize — the only difference is the pad rule:
+// rows with degree <= small_len pad to small_len; otherwise to
+// width * c with c the smallest ladder count covering ceil(deg/width),
+// the ladder extending by doubling past its last entry (arbitrary
+// degrees supported, no capping ever).
+void* pio_ladder(int64_t nnz, const int32_t* rows, const int32_t* cols,
+                 const float* vals, int32_t num_rows, int32_t width,
+                 int32_t small_len, const int64_t* ladder,
+                 int32_t n_ladder) try {
+    if (nnz < 0 || num_rows < 0 || width <= 0 || small_len <= 0 ||
+        n_ladder <= 0) {
+        return nullptr;
+    }
+    auto ladder_pad = [width, small_len, ladder,
+                       n_ladder](int32_t kept) -> int32_t {
+        if (kept <= small_len) return small_len;
+        const int64_t need = (static_cast<int64_t>(kept) + width - 1) / width;
+        int64_t c = ladder[n_ladder - 1];
+        for (int32_t j = 0; j < n_ladder; ++j) {
+            if (ladder[j] >= need) { c = ladder[j]; break; }
+        }
+        while (c < need) c *= 2;                   // extend by doubling
+        return static_cast<int32_t>(c * width);
+    };
+    // max_len = 0: the ladder never caps
+    return build_grouped(nnz, rows, cols, vals, num_rows, 0, ladder_pad);
+} catch (...) {
+    return nullptr;
 }
 
 }  // extern "C"

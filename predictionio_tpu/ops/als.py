@@ -447,7 +447,8 @@ def half_step_flops(
     (chunk/row padding and slab-shape rounding from :func:`_slab_shape`)
     and prices the solve at what the default batched-CG solver actually
     executes: ``steps × (2K² + 8K)`` (one batched matvec + the CG vector
-    updates per step, ``steps = cg_steps or min(K+4, 24)``) — for the
+    updates per step, ``steps = cg_steps or min(K+4, _CG_STEP_CAP)``) —
+    for the
     chunked layout over every row (inactive rows solve the identity).
     The ratio ``executed / useful`` therefore carries BOTH the layout's
     padding overhead and the CG-vs-direct solver overhead (ADVICE r2:
@@ -490,8 +491,37 @@ LADDER_COUNTS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128,
                  192, 256, 384, 512, 768, 1024, 1536, 2048)
 
 
+def _ladder_rows_native(
+    coo: RatingsCOO, width: int, small: int
+) -> BucketedRatings | None:
+    """C++ packing path (native/bucketize.cc pio_ladder — one counting
+    sort + one fill, same handle contract as the bucketizer); None when
+    the native toolchain is unavailable."""
+    from predictionio_tpu.native import load_bucketize
+
+    lib = load_bucketize()
+    if lib is None or coo.nnz == 0:
+        return None
+    import ctypes
+
+    rows, cols, vals, rp, cp, vp = _native_coo_args(coo)
+    ladder = np.ascontiguousarray(LADDER_COUNTS, dtype=np.int64)
+    handle = lib.pio_ladder(
+        coo.nnz, rp, cp, vp, coo.num_rows, width, small,
+        ladder.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(ladder))
+    if not handle:
+        return None
+    buckets = _native_read_slabs(
+        handle, lib.pio_bucketize_num_buckets, lib.pio_bucketize_bucket_info,
+        lib.pio_bucketize_fill, lib.pio_bucketize_free, Bucket)
+    if buckets is None:
+        return None
+    return BucketedRatings(buckets, coo.num_rows, coo.num_cols, coo.nnz)
+
+
 def ladder_rows(
-    coo: RatingsCOO, width: int = 128, small: int = 64
+    coo: RatingsCOO, width: int = 128, small: int = 64,
+    use_native: bool = True,
 ) -> BucketedRatings:
     """Whole-row buckets padded to the MXU-width ladder — the layout
     behind ``layout="fused"``.
@@ -509,11 +539,17 @@ def ladder_rows(
     113ms per ML-20M iteration on the chunked path, scratch profile
     r3). No ratings are dropped.
 
-    Vectorized packing: one argsort over nnz + bincount/cumsum
-    bookkeeping, no per-row Python loop.
+    The packing runs in native C++ when available (one counting sort +
+    one fill, native/bucketize.cc ``pio_ladder``); the NumPy fallback
+    below is vectorized (one stable argsort over nnz + contiguous
+    per-bucket slices) and produces an identical slab layout.
     """
     if coo.nnz == 0:
         return BucketedRatings((), coo.num_rows, coo.num_cols, 0)
+    if use_native:
+        native = _ladder_rows_native(coo, width, small)
+        if native is not None:
+            return native
     order = np.argsort(coo.rows, kind="stable")
     rows_s = coo.rows[order]
     cols_s = coo.cols[order]
@@ -684,12 +720,19 @@ def _cho_solve_batched(A: jax.Array, b: jax.Array) -> jax.Array:
 
 
 #: default CG step cap: batched f32 CG on ridge-regularised ALS normal
-#: matrices reaches its float32 accuracy floor well before K steps —
-#: measured on Wishart-like systems: rank 32/deg 500 converges to 4e-7
-#: rel err by step 16; rank 200/deg 800-2000 plateaus at its f32 floor
-#: (1e-2..4e-3, conditioning-bound — the same floor a f32 direct solve
-#: hits) by step 16-24. Steps past the plateau only re-stream A.
-_CG_STEP_CAP = 24
+#: matrices reaches its float32 accuracy floor (~2e-7 rel err vs an f64
+#: oracle) well before K steps. Round-3 measurement on real ALS-WR and
+#: Hu-Koren system families (48 systems each, f64 oracle):
+#:   explicit K=200 lam*deg ridge, deg 800-2000:  floor by step 6
+#:   explicit K=200 lam=0.01 (weak ridge):        floor by step 16
+#:   explicit K=32  lam=0.01 (weakest measured):  9.6e-6 @16, floor @24
+#:   implicit K=10..32, alpha 5-10, flat lam:     floor by step 12
+#: The cap at 16 keeps worst-case solve error ~1e-5 relative — orders
+#: below the alternation's own statistical noise — and each step past
+#: it only re-streams A (measured ~23ms/step at the ML-20M rank-200
+#: shape). Raise via als_train(cg_steps=...) for pathological
+#: conditioning; solver="cholesky" is the exact escape hatch.
+_CG_STEP_CAP = 16
 
 
 def _cg_solve_batched(A: jax.Array, b: jax.Array,
@@ -701,9 +744,9 @@ def _cg_solve_batched(A: jax.Array, b: jax.Array,
     for small batched systems: measured 506ms for 138k rank-32 solves on
     one v5e-class chip, vs 30ms for this CG (HBM-bound batched matvecs,
     the layout the VPU/MXU actually likes); at rank 200 the gap is 1154ms
-    vs 104ms (20k systems). ``steps`` defaults to ``min(K + 4, 24)`` —
-    exact-in-exact-arithmetic for K <= 20, and past the measured f32
-    accuracy plateau for every larger rank (see ``_CG_STEP_CAP``). The
+    vs 104ms (20k systems). ``steps`` defaults to ``min(K + 4, 16)`` —
+    exact-in-exact-arithmetic for K <= 12, and at the measured f32
+    accuracy floor for every larger rank (see ``_CG_STEP_CAP``). The
     ALS normal matrices carry a ``lam * n`` (or flat ``lam``) ridge, so
     they are well-conditioned by construction; inactive rows pass the
     identity. Callers can raise ``steps`` (als_train(cg_steps=...)) for

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from predictionio_tpu.ops import als as als_mod
 from predictionio_tpu.ops.als import (
     ALSFactors,
     RatingsCOO,
@@ -96,7 +97,7 @@ class TestBucketing:
         )
         # executed prices the solve at what the default CG actually runs:
         # steps x (2K^2 + 8K) per row (ADVICE r2)
-        steps = min(K + 4, 24)
+        steps = min(K + 4, als_mod._CG_STEP_CAP)
         per_solve_exec = steps * (2 * K * K + 8 * K)
         assert fl["executed_flops"] == pytest.approx(
             (4 + 8) * per_entry + 2 * per_solve_exec
@@ -159,7 +160,7 @@ class TestChunking:
         per_solve = K**3 / 3 + 2 * K * K
         # row0: one 8-chunk + one 4-chunk (deg 2); row1: one 4-chunk (deg 3)
         assert fl["useful_flops"] == pytest.approx(13 * per_entry + 2 * per_solve)
-        steps = min(K + 4, 24)
+        steps = min(K + 4, als_mod._CG_STEP_CAP)
         per_solve_exec = steps * (2 * K * K + 8 * K)
         assert fl["executed_flops"] == pytest.approx(
             (8 + 4 + 4) * per_entry + 2 * per_solve_exec
@@ -491,6 +492,36 @@ class TestNativeBucketizer:
             # padding stays zeroed
             assert (bn.cols * (1 - bn.mask)).sum() == 0
             assert (bn.vals * (1 - bn.mask)).sum() == 0
+
+    def test_native_ladder_matches_python(self):
+        """pio_ladder (the fused layout's packer, measured ~6.7x the
+        NumPy path at ML-20M scale) must produce the identical slab
+        layout, including beyond-base-ladder degrees."""
+        from predictionio_tpu.ops.als import ladder_rows
+
+        rng = np.random.default_rng(4)
+        nnz = 40_000
+        rows = (500 * rng.random(nnz) ** 1.8).astype(np.int32)
+        cols = (300 * rng.random(nnz) ** 1.8).astype(np.int32)
+        vals = rng.random(nnz).astype(np.float32) * 5
+        # one row heavier than the base ladder (2048 * width = 32768
+        # entries at width=16) so the doubling-extension branch runs in
+        # BOTH implementations
+        heavy = 40_000
+        rows = np.concatenate([rows, np.full(heavy, 501, np.int32)])
+        cols = np.concatenate([cols, (np.arange(heavy) % 300).astype(np.int32)])
+        vals = np.concatenate([vals, np.ones(heavy, np.float32)])
+        coo = RatingsCOO(rows, cols, vals, 502, 300)
+        nat = ladder_rows(coo, width=16, small=8)
+        py = ladder_rows(coo, width=16, small=8, use_native=False)
+        assert nat.buckets[-1].pad_len > 2048 * 16  # extension engaged
+        assert [b.pad_len for b in nat.buckets] == \
+               [b.pad_len for b in py.buckets]
+        for bn, bp in zip(nat.buckets, py.buckets):
+            np.testing.assert_array_equal(bn.row_ids, bp.row_ids)
+            np.testing.assert_array_equal(bn.deg, bp.deg)
+            np.testing.assert_array_equal(bn.cols, bp.cols)
+            np.testing.assert_array_equal(bn.vals, bp.vals)
 
     def test_empty_and_fallback(self):
         coo = RatingsCOO(np.zeros(0, np.int32), np.zeros(0, np.int32),
