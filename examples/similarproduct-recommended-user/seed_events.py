@@ -1,0 +1,34 @@
+"""Seed RecommendedUserApp: two follow communities with sparse
+cross-links. Run after `pio app new RecommendedUserApp`."""
+
+import sys
+
+import numpy as np
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.registry import Storage
+
+storage = Storage.default()
+app = storage.get_meta_data_apps().get_by_name("RecommendedUserApp")
+if app is None:
+    sys.exit("app 'RecommendedUserApp' not found — run "
+             "`pio app new RecommendedUserApp` first")
+
+events = storage.get_events()
+rng = np.random.default_rng(13)
+n = 0
+for u in range(24):
+    for v in range(24):
+        if u == v:
+            continue
+        same = (u % 2) == (v % 2)
+        if rng.random() < (0.7 if same else 0.02):
+            events.insert(
+                Event(event="follow", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="user", target_entity_id=f"u{v}",
+                      properties=DataMap({})),
+                app.id,
+            )
+            n += 1
+print(f"seeded {n} follow events into RecommendedUserApp (app id {app.id})")

@@ -390,6 +390,33 @@ class DeviceChunkedRatings:
     nnz: int
 
 
+def pad_chunk_slab(
+    slab: ChunkSlab, rank: int, data_axis: int, max_slab_elems: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Pad one chunk slab to its full (S, B, ...) device shape on the
+    host: (row_ids, cols, vals, deg). Pad chunks carry row 0 with zero
+    degree — zero contribution. Shared by single-process staging
+    (:func:`stage_chunks`) and multi-process staging, where each
+    process pads identically and contributes its local B-slice via
+    ``jax.make_array_from_process_local_data``
+    (tests/multihost_als_child.py)."""
+    n, L = slab.cols.shape
+    s, b = _slab_shape(n, L, rank, data_axis, max_slab_elems)
+    total = s * b
+
+    def pad2(a):
+        p = np.zeros((total, a.shape[1]), dtype=a.dtype)
+        p[:n] = a
+        return p.reshape(s, b, a.shape[1])
+
+    deg = np.zeros((total,), dtype=np.int32)
+    deg[:n] = slab.deg
+    rids = np.zeros((total,), dtype=np.int32)
+    rids[:n] = slab.row_ids
+    return (rids.reshape(s, b), pad2(slab.cols), pad2(slab.vals),
+            deg.reshape(s, b))
+
+
 def stage_chunks(
     chunked: ChunkedRatings,
     rank: int,
@@ -399,21 +426,8 @@ def stage_chunks(
     data_axis = int(mesh.shape["data"]) if mesh is not None else 1
     out = []
     for slab in chunked.slabs:
-        n, L = slab.cols.shape
-        s, b = _slab_shape(n, L, rank, data_axis, max_slab_elems)
-        total = s * b
-
-        def pad2(a, fill=0):
-            p = np.full((total, a.shape[1]), fill, dtype=a.dtype)
-            p[:n] = a
-            return p.reshape(s, b, a.shape[1])
-
-        deg = np.zeros((total,), dtype=np.int32)
-        deg[:n] = slab.deg
-        rids = np.zeros((total,), dtype=np.int32)  # pad chunks -> row 0,
-        rids[:n] = slab.row_ids                    # zero contribution
-        cols, vals = pad2(slab.cols), pad2(slab.vals)
-        deg, rids = deg.reshape(s, b), rids.reshape(s, b)
+        rids, cols, vals, deg = pad_chunk_slab(
+            slab, rank, data_axis, max_slab_elems)
         if mesh is not None:
             slab_sh = NamedSharding(mesh, P(None, "data", None))
             vec_sh = NamedSharding(mesh, P(None, "data"))

@@ -1,0 +1,101 @@
+"""Child process for the two-process sharded-ALS test: a real ALS
+half-step program executing across process boundaries.
+
+Both "hosts" build the identical chunk layout (same seed), contribute
+their LOCAL slab rows via ``make_array_from_process_local_data`` (the
+multi-process staging path — plain ``device_put`` cannot address the
+other host's devices), and run the fused accumulate-then-solve
+half-step jitted over the 4-device global mesh; XLA inserts the DCN
+collectives. Each host asserts the replicated factor output matches a
+local NumPy oracle. Run only via test_distributed_multihost.py.
+"""
+
+import sys
+
+import numpy as np
+
+from predictionio_tpu.utils.testing import force_cpu_devices
+
+force_cpu_devices(2)
+
+from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
+
+active = maybe_initialize_distributed()
+assert active
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops.als import (
+    DeviceChunkedRatings,
+    DeviceChunkSlab,
+    RatingsCOO,
+    chunk_rows,
+    pad_chunk_slab,
+    solve_half,
+)
+
+assert jax.device_count() == 4
+
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+
+# identical layout on both hosts (same seed)
+rng = np.random.default_rng(0)
+num_rows, num_cols, nnz = 64, 24, 800
+coo = RatingsCOO(
+    rows=(num_rows * rng.random(nnz) ** 1.6).astype(np.int32),
+    cols=(num_cols * rng.random(nnz) ** 1.6).astype(np.int32),
+    vals=(rng.random(nnz) * 5).astype(np.float32),
+    num_rows=num_rows,
+    num_cols=num_cols,
+)
+chunked = chunk_rows(coo, sizes=(8, 4), use_native=False)
+V = (rng.standard_normal((num_cols, 6)) / np.sqrt(6)).astype(np.float32)
+
+# multi-process staging: the SAME host padding as stage_chunks
+# (ops/als.pad_chunk_slab — shared so the layout convention cannot
+# drift), then contribute this process's half of every slab's B
+# dimension
+rank, data_axis = 6, 4
+rep_sh = NamedSharding(mesh, P())
+slab_sh = NamedSharding(mesh, P(None, "data", None))
+vec_sh = NamedSharding(mesh, P(None, "data"))
+pidx = jax.process_index()
+
+dev_slabs = []
+for slab in chunked.slabs:
+    rids, cols, vals, deg = pad_chunk_slab(slab, rank, data_axis, 1 << 12)
+    half = rids.shape[1] // 2
+    lo, hi = pidx * half, (pidx + 1) * half
+    mk = jax.make_array_from_process_local_data
+    dev_slabs.append(DeviceChunkSlab(
+        row_ids=mk(vec_sh, rids[:, lo:hi], rids.shape),
+        cols=mk(slab_sh, cols[:, lo:hi], cols.shape),
+        vals=mk(slab_sh, vals[:, lo:hi], vals.shape),
+        deg=mk(vec_sh, deg[:, lo:hi], deg.shape),
+    ))
+
+dev = DeviceChunkedRatings(tuple(dev_slabs), num_rows, num_cols, nnz)
+V_dev = jax.make_array_from_process_local_data(rep_sh, V, V.shape)
+
+out = solve_half(V_dev, dev, rank, lam=0.1, mesh=mesh)
+out_local = np.asarray(
+    jax.jit(lambda x: x, out_shardings=rep_sh)(out))
+
+# local oracle
+K = rank
+oracle = np.zeros((num_rows, K))
+for u in range(num_rows):
+    sel = coo.rows == u
+    if not sel.any():
+        continue
+    F = V[coo.cols[sel]].astype(np.float64)
+    r = coo.vals[sel].astype(np.float64)
+    A = F.T @ F + 0.1 * len(r) * np.eye(K)
+    oracle[u] = np.linalg.solve(A, F.T @ r)
+np.testing.assert_allclose(out_local, oracle, rtol=2e-3, atol=2e-3)
+
+print(f"RESULT host={jax.process_index()} als_half_ok "
+      f"norm={float(np.linalg.norm(out_local)):.4f}", flush=True)
+sys.exit(0)
