@@ -114,7 +114,16 @@ class SampleAlgorithm(LocalAlgorithm):
 
 
 class UnpersistedAlgorithm(SampleAlgorithm):
-    """Returns None from make_persistent_model -> retrain-on-deploy path."""
+    """Returns None from make_persistent_model -> retrain-on-deploy path.
+    Stashes the training context on the instance (the live-read-state
+    pattern the ecommerce template uses) so tests can assert WHICH
+    instance trained."""
+
+    _trained_with = None
+
+    def train(self, ctx, pd):
+        self._trained_with = ctx
+        return super().train(ctx, pd)
 
     def make_persistent_model(self, ctx, model):
         return None

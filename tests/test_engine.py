@@ -195,3 +195,36 @@ def test_prepare_deploy_with_retrain(ctx):
     assert models[1].mult == 9  # retrained on deploy
     p = SampleAlgorithm(AlgoParams(id=1, mult=9)).predict(models[1], Query(x=3))
     assert p == Prediction(value=27, tags=("algo1",))
+
+
+def test_retrain_on_deploy_trains_the_serving_instances():
+    """Regression for the round-3 deploy-path state bug, retrain
+    branch: when models were not persisted, prepare_deploy must retrain
+    on the SAME algorithm instances that will serve — train hooks stash
+    serve-time state on the instance exactly like load_model hooks
+    (ecommerce's live-constraint context), and training throwaway
+    instances silently drops it."""
+    # sentinel storage: identity below proves the parent context's
+    # storage propagated (both resolving the Storage.default()
+    # singleton would pass vacuously)
+    sentinel = object()
+    ctx = EngineContext(workflow_params=WorkflowParams(), storage=sentinel)
+    engine = make_engine()
+    ep = EngineParams.of(
+        data_source=DSParams(id=2),
+        algorithms=[("unpersisted", AlgoParams(id=1, mult=9))],
+    )
+    result = engine.train(ctx, ep)
+    assert result.persisted[0] is None
+
+    _, _, serving_algos, _ = engine.make_components(ep)
+    assert serving_algos[0]._trained_with is None
+    models = engine.prepare_deploy(ctx, ep, result.persisted,
+                                   algorithms=serving_algos)
+    assert models[0].mult == 9
+    # the serving instance itself ran train(): its stash is populated
+    # (with the save_model=False derived context prepare_deploy uses)
+    trained_ctx = serving_algos[0]._trained_with
+    assert trained_ctx is not None
+    assert trained_ctx.storage is sentinel
+    assert trained_ctx.workflow_params.save_model is False
