@@ -1,0 +1,45 @@
+"""Seed RateEcommApp: two taste clusters of rate events (ratings 1-5)
+plus one re-rate to exercise latest-wins. Run after
+`pio app new RateEcommApp`."""
+
+import sys
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.registry import Storage
+
+storage = Storage.default()
+app = storage.get_meta_data_apps().get_by_name("RateEcommApp")
+if app is None:
+    sys.exit("app 'RateEcommApp' not found — run "
+             "`pio app new RateEcommApp` first")
+
+events = storage.get_events()
+rng = np.random.default_rng(17)
+t0 = datetime.now(timezone.utc)
+n = 0
+for u in range(20):
+    for i in range(16):
+        if rng.random() < 0.5:
+            same = (i % 2) == (u % 2)
+            rating = float(rng.integers(4, 6) if same else rng.integers(1, 3))
+            events.insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties=DataMap({"rating": rating}),
+                      event_time=t0),
+                app.id,
+            )
+            n += 1
+# u0 re-rates i1 later: the 5.0 supersedes whatever came first
+events.insert(
+    Event(event="rate", entity_type="user", entity_id="u0",
+          target_entity_type="item", target_entity_id="i1",
+          properties=DataMap({"rating": 5.0}),
+          event_time=t0 + timedelta(minutes=5)),
+    app.id,
+)
+print(f"seeded {n + 1} rate events into RateEcommApp (app id {app.id})")
