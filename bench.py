@@ -157,10 +157,15 @@ def _staged_ladder(users, items, vals, rank):
     """One ladder layout + HBM staging per rank, memoized — bench_als,
     bench_phases, and bench_rank200 share it (the 20M-entry packing and
     both orientations' device upload are seconds each)."""
-    # fingerprint the data too: a rank-only key would hand back stale
-    # staged buffers if ever called with different ratings
-    key = (rank, len(users), int(users[:1000].sum()),
-           int(items[:1000].sum()))
+    # fingerprint the FULL index arrays (CRC over the raw bytes): a
+    # prefix-sum key can alias two datasets that agree on their first
+    # entries and silently hand back stale staged buffers (ADVICE r3)
+    import zlib
+
+    key = (rank, len(users),
+           zlib.crc32(np.ascontiguousarray(users)),
+           zlib.crc32(np.ascontiguousarray(items)),
+           zlib.crc32(np.ascontiguousarray(vals)))
     if key in _LADDER_CACHE:
         return _LADDER_CACHE[key]
     from predictionio_tpu.ops import als as A

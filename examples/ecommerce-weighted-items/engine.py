@@ -28,8 +28,12 @@ predictKnownUser/predictSimilar).
 from __future__ import annotations
 
 import dataclasses
+import logging
+import math
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from predictionio_tpu.controller import Engine, FirstServing
 from predictionio_tpu.templates.ecommerce import (
@@ -56,7 +60,8 @@ class WeightedECommAlgorithm(ECommAlgorithm):
 
     def __init__(self, params=None):
         super().__init__(params)
-        self._weight_cache: tuple[str | None, object] | None = None
+        # (weights-event version, base ALS model, weighted model)
+        self._weight_cache: tuple[str | None, object, object] | None = None
 
     def _weight_groups(self):
         """Latest $set on (constraint, weightedItems) -> list of
@@ -87,9 +92,20 @@ class WeightedECommAlgorithm(ECommAlgorithm):
             return version, None
         w = np.ones(len(model.als.item_ids), dtype=np.float32)
         for group in groups:
-            weight = float(group.get("weight", 1.0))
-            if weight < 0.0:
-                raise ValueError(f"negative item weight: {group}")
+            try:
+                weight = float(group.get("weight", 1.0))
+            except (TypeError, ValueError, AttributeError):
+                # non-dict entries land here too (AttributeError on .get)
+                logger.warning("skipping malformed weight group: %r", group)
+                continue
+            if not (math.isfinite(weight) and weight >= 0.0):
+                # one malformed operator event must not poison the
+                # serving path — the reference variant applies weights
+                # unvalidated; we skip the bad group (negative, NaN or
+                # inf weights would corrupt every score) and keep serving
+                logger.warning(
+                    "skipping invalid item weight group: %r", group)
+                continue
             for item_id in group.get("items", []):
                 ix = model.als.item_ids.get(item_id)
                 if ix is not None:
@@ -104,9 +120,13 @@ class WeightedECommAlgorithm(ECommAlgorithm):
         version, w = self._weights_vector(model)
         if w is None:
             return model
-        key = (version, id(model.als))
-        if self._weight_cache is not None and self._weight_cache[0] == key:
-            return self._weight_cache[1]
+        # hold the base ALS model itself in the cache entry and compare
+        # by identity to that held object — a raw id() key can alias a
+        # new model allocated at a freed model's address after /reload
+        if (self._weight_cache is not None
+                and self._weight_cache[0] == version
+                and self._weight_cache[1] is model.als):
+            return self._weight_cache[2]
         weighted = ECommModel(
             als=dataclasses.replace(
                 model.als,
@@ -114,7 +134,7 @@ class WeightedECommAlgorithm(ECommAlgorithm):
             ),
             categories=model.categories,
         )
-        self._weight_cache = (key, weighted)
+        self._weight_cache = (version, model.als, weighted)
         return weighted
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:

@@ -449,6 +449,7 @@ def half_step_flops(
     data_axis: int = 1,
     max_slab_elems: int = 1 << 24,
     cg_steps: int | None = None,
+    solver: str = "cg",
 ) -> dict[str, float]:
     """Useful vs executed FLOPs for one ALS half-step on this layout.
 
@@ -459,20 +460,31 @@ def half_step_flops(
     the solver actually run, so MFU never earns credit for extra solver
     work. Executed work replaces real entries with padded slab entries
     (chunk/row padding and slab-shape rounding from :func:`_slab_shape`)
-    and prices the solve at what the default batched-CG solver actually
-    executes: ``steps × (2K² + 8K)`` (one batched matvec + the CG vector
-    updates per step, ``steps = cg_steps or min(K+4, _CG_STEP_CAP)``) —
-    for the
-    chunked layout over every row (inactive rows solve the identity).
-    The ratio ``executed / useful`` therefore carries BOTH the layout's
-    padding overhead and the CG-vs-direct solver overhead (ADVICE r2:
-    the previous Cholesky-priced executed figure understated executed
-    solve FLOPs by ~4.5x at rank 32)."""
+    and prices the solve at what the solver actually run executes:
+    batched CG at ``steps × (2K² + 8K)`` (one batched matvec + the CG
+    vector updates per step, ``steps = cg_steps or min(K+4,
+    _CG_STEP_CAP)``), or — when ``solver="cholesky"`` is the path being
+    measured — the direct factorization + two triangular solves
+    (``K³/3 + 2K²``, i.e. the algorithmic minimum). Pass the same
+    ``solver``/``cg_steps`` the measured run used, or MFU/padding_x
+    misattribute the solve cost (ADVICE r3). Executed work also
+    replaces real entries with padded slab entries — for the chunked
+    layout over every row (inactive rows solve the identity). The
+    ratio ``executed / useful`` therefore carries BOTH the layout's
+    padding overhead and the solver-vs-minimum overhead (ADVICE r2:
+    a Cholesky-priced executed figure understates executed CG solve
+    FLOPs by ~4.5x at rank 32)."""
+    if solver not in ("cg", "cholesky"):
+        raise ValueError(f"solver must be 'cg' or 'cholesky', got {solver!r}")
     k = float(rank)
     per_entry = 2.0 * k * k + 2.0 * k
     per_solve = (k ** 3) / 3.0 + 2.0 * k * k
-    steps = cg_steps if cg_steps is not None else min(rank + 4, _CG_STEP_CAP)
-    per_solve_exec = float(steps) * (2.0 * k * k + 8.0 * k)
+    if solver == "cholesky":
+        per_solve_exec = per_solve
+    else:
+        steps = (cg_steps if cg_steps is not None
+                 else min(rank + 4, _CG_STEP_CAP))
+        per_solve_exec = float(steps) * (2.0 * k * k + 8.0 * k)
     useful = executed = 0.0
     if isinstance(bucketed, ChunkedRatings):
         active = set()

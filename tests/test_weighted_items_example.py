@@ -217,3 +217,42 @@ def test_live_weights_shift_ranking(example_engine, seeded_storage):
     finally:
         engine_srv.stop()
         event_srv.stop()
+
+
+def test_malformed_weight_group_is_skipped_not_fatal(
+        example_engine, seeded_storage):
+    """A negative or non-numeric weight in one group must not poison the
+    serving path (ADVICE r3): the bad group is logged and skipped, valid
+    groups in the same event still apply."""
+    from predictionio_tpu.templates.ecommerce import Query
+
+    with open(os.path.join(EXAMPLE_DIR, "engine.json")) as f:
+        variant = json.load(f)
+    variant["algorithms"][0]["params"]["use_mesh"] = False
+    outcome = run_train(variant=variant, storage=seeded_storage)
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(variant)
+    ctx = EngineContext(storage=seeded_storage)
+    _, _, algos, _ = eng.make_components(ep)
+    models = eng.prepare_deploy(
+        ctx, ep, load_models(seeded_storage, outcome.instance_id),
+        algorithms=algos)
+    algo, model = algos[0], models[0]
+
+    base = algo.predict(model, Query(user="u1", num=4))
+    assert base.item_scores
+    target = base.item_scores[-1].item
+    app = seeded_storage.get_meta_data_apps().get_by_name("WeightedEcommApp")
+    seeded_storage.get_events().insert(
+        Event(event="$set", entity_type="constraint",
+              entity_id="weightedItems",
+              properties=DataMap({"weights": [
+                  {"items": ["i0"], "weight": -3.0},       # invalid: skipped
+                  {"items": ["i1"], "weight": "heavy"},    # invalid: skipped
+                  {"items": ["i2"], "weight": "nan"},      # invalid: skipped
+                  "oops",                                  # non-dict: skipped
+                  {"items": [target], "weight": 50.0},     # valid: applies
+              ]})), app.id)
+    boosted = algo.predict(model, Query(user="u1", num=4))
+    assert boosted.item_scores, "serving must survive malformed weights"
+    assert boosted.item_scores[0].item == target
