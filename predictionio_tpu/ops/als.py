@@ -667,15 +667,17 @@ class DeviceBucketedRatings:
     nnz: int
 
 
-def _stage_bucket(
-    bucket: Bucket,
-    rank: int,
-    mesh: Mesh | None,
-    max_slab_elems: int,
-) -> DeviceBucket:
-    """Transfer one bucket's slabs to the device (sharded over the mesh's
-    data axis when given), padding row counts up to full slabs."""
-    data_axis = int(mesh.shape["data"]) if mesh is not None else 1
+def pad_bucket_slabs(
+    bucket: Bucket, rank: int, data_axis: int, max_slab_elems: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad one bucket to its full (S, B, L)/(S, B) device shape on the
+    host: (cols, vals, deg). Pad rows carry zero degree — zero
+    contribution. Shared by single-process staging (:func:`_stage_bucket`)
+    and multi-process staging, where each process pads identically and
+    contributes its local B-slice via
+    ``jax.make_array_from_process_local_data``
+    (tests/multihost_fused_child.py) — the ladder-layout analogue of
+    :func:`pad_chunk_slab`."""
     n = bucket.row_ids.shape[0]
     s, b = _slab_shape(n, bucket.pad_len, rank, data_axis, max_slab_elems)
     total = s * b
@@ -687,8 +689,21 @@ def _stage_bucket(
 
     deg = np.zeros((total,), dtype=np.int32)
     deg[:n] = bucket.deg
-    cols, vals = pad3(bucket.cols), pad3(bucket.vals)
-    deg = deg.reshape(s, b)
+    return pad3(bucket.cols), pad3(bucket.vals), deg.reshape(s, b)
+
+
+def _stage_bucket(
+    bucket: Bucket,
+    rank: int,
+    mesh: Mesh | None,
+    max_slab_elems: int,
+) -> DeviceBucket:
+    """Transfer one bucket's slabs to the device (sharded over the mesh's
+    data axis when given), padding row counts up to full slabs."""
+    data_axis = int(mesh.shape["data"]) if mesh is not None else 1
+    n = bucket.row_ids.shape[0]
+    cols, vals, deg = pad_bucket_slabs(bucket, rank, data_axis,
+                                       max_slab_elems)
     if mesh is not None:
         slab_sh = NamedSharding(mesh, P(None, "data", None))
         deg_sh = NamedSharding(mesh, P(None, "data"))
@@ -969,7 +984,7 @@ def _solve_half_chunked(
 
 
 def _solve_half_fused(V, buckets, lam, alpha, implicit, num_rows, bf16,
-                      cg_steps, solver="cg"):
+                      cg_steps, solver="cg", out_sharding=None):
     """One ALS half-step over the ladder layout, traced inline.
 
     Per bucket slab: build the complete per-row normal equations (every
@@ -979,12 +994,22 @@ def _solve_half_fused(V, buckets, lam, alpha, implicit, num_rows, bf16,
     build (100ms/iter) and the CG (113ms/iter) as the chunked path does
     (scratch profile, ML-20M rank 32). The only scatter left is the
     (n, K) factor write-back per bucket — row-count-bound like the
-    gather, ~0.5ms at ML-20M scale."""
+    gather, ~0.5ms at ML-20M scale.
+
+    ``out_sharding`` (tensor parallelism): a NamedSharding that pins the
+    produced factor table row-sharded over the mesh's "model" axis. The
+    opposite table V arrives with the same sharding; XLA inserts ONE
+    all-gather of V for the slab gathers (cheaper than psum-of-partials
+    whenever avg degree > 1) and scatters the write-back to the owning
+    shard, so the PERSISTENT state — both factor tables — stays sharded
+    and only one table at a time materialises transiently."""
     K = V.shape[1]
     mm = jnp.bfloat16 if bf16 else jnp.float32
     prec = None if bf16 else _HI
     gram = jnp.einsum("ik,im->km", V, V, precision=_HI) if implicit else None
     out = jnp.zeros((num_rows, K), dtype=jnp.float32)
+    if out_sharding is not None:
+        out = jax.lax.with_sharding_constraint(out, out_sharding)
     for row_ids, cols, vals, deg in buckets:
         n = row_ids.shape[0]   # static: row_ids is the (n,) unpadded id list
 
@@ -996,13 +1021,15 @@ def _solve_half_fused(V, buckets, lam, alpha, implicit, num_rows, bf16,
 
         _, X = jax.lax.scan(body, None, (cols, vals, deg))
         out = out.at[row_ids].set(X.reshape(-1, K)[:n])
+    if out_sharding is not None:
+        out = jax.lax.with_sharding_constraint(out, out_sharding)
     return out
 
 
 @partial(jax.jit,
          static_argnames=("iterations", "lam", "alpha", "implicit",
                           "num_users", "num_items", "bf16", "cg_steps",
-                          "solver"),
+                          "solver", "mesh", "shard_factors"),
          donate_argnums=(0,))
 def _als_iterate_fused(
     item0: jax.Array,
@@ -1017,21 +1044,39 @@ def _als_iterate_fused(
     bf16: bool = False,
     cg_steps: int | None = None,
     solver: str = "cg",
+    mesh: Mesh | None = None,
+    shard_factors: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Full ALS training as ONE device program: ``lax.scan`` over
     alternating :func:`_solve_half_fused` half-steps. One dispatch per
     training run — on remote-attached devices (axon tunnel) per-call
     dispatch overhead is material, and the scan also lets XLA overlap
-    consecutive iterations' transfers."""
+    consecutive iterations' transfers.
+
+    ``shard_factors=True`` (with a ``mesh`` carrying a "model" axis
+    > 1) is the tensor-parallel layout: BOTH carried factor tables stay
+    row-sharded over "model" through every scan step (the BASELINE
+    DP×MP configuration — MLlib's block-partitioned factors,
+    ALSAlgorithm.scala:79-85). See :func:`_solve_half_fused` for the
+    collective structure. ``num_users``/``num_items`` must be padded to
+    a multiple of the model-axis size by the caller (als_train does)."""
     K = item0.shape[1]
+    sh = None
+    if shard_factors and mesh is not None and "model" in mesh.shape \
+            and int(mesh.shape["model"]) > 1:
+        sh = NamedSharding(mesh, P("model", None))
     u0 = jnp.zeros((num_users, K), dtype=jnp.float32)
+    if sh is not None:
+        u0 = jax.lax.with_sharding_constraint(u0, sh)
 
     def it_body(carry, _):
         _, item = carry
         user = _solve_half_fused(item, user_buckets, lam, alpha, implicit,
-                                 num_users, bf16, cg_steps, solver)
+                                 num_users, bf16, cg_steps, solver,
+                                 out_sharding=sh)
         item = _solve_half_fused(user, item_buckets, lam, alpha, implicit,
-                                 num_items, bf16, cg_steps, solver)
+                                 num_items, bf16, cg_steps, solver,
+                                 out_sharding=sh)
         return (user, item), None
 
     (user, item), _ = jax.lax.scan(
@@ -1206,6 +1251,7 @@ def als_train(
     chunked_acc_budget: int = 4 << 30,
     cg_steps: int | None = None,
     solver: str = "cg",
+    shard_factors: bool = False,
 ) -> ALSFactors:
     """Full alternating-least-squares training.
 
@@ -1254,6 +1300,17 @@ def als_train(
     (``_cho_solve_batched``) — 10-20x slower on TPU, useful as an
     accuracy oracle or for pathologically conditioned data. Fused and
     bucketed layouts only.
+
+    ``shard_factors=True`` (with a ``mesh`` whose "model" axis is > 1)
+    keeps BOTH factor tables row-sharded over the model axis for the
+    whole run — the DP×MP tensor-parallel layout for catalog-scale
+    tables that exceed one device's HBM (BASELINE's sharded-embeddings
+    configuration). On the fused layout the tables are padded to a
+    multiple of the model-axis size, stay sharded across every
+    iteration of the scan, and the result tables come back sharded;
+    XLA all-gathers one (opposite) table transiently per half-step for
+    the slab gathers. Replicated (default) is faster whenever both
+    tables fit. See docs/parallelism.md.
     """
     if layout not in ("auto", "fused", "chunked", "bucketed"):
         raise ValueError(
@@ -1279,17 +1336,38 @@ def als_train(
         )
         dev_user = stage_buckets(by_user, rank, mesh, max_slab_elems)
         dev_item = stage_buckets(by_item, rank, mesh, max_slab_elems)
+        tp = bool(shard_factors and mesh is not None
+                  and "model" in mesh.shape and int(mesh.shape["model"]) > 1)
+        # table row counts pad to the model-axis size so every device
+        # holds an equal shard; padded rows are never indexed by any
+        # slab (col ids < num_cols) and are sliced off below
+        model_ax = int(mesh.shape["model"]) if tp else 1
+        num_users_p = ratings.num_rows + (-ratings.num_rows) % model_ax
+        num_items_p = ratings.num_cols + (-ratings.num_cols) % model_ax
         key = jax.random.PRNGKey(seed)
         item0 = jax.random.normal(key, (ratings.num_cols, rank),
                                   dtype=jnp.float32)
         item0 = item0 / jnp.sqrt(jnp.float32(rank))
+        if num_items_p != ratings.num_cols:
+            # pad rows are ZERO: never gathered (col ids < num_cols),
+            # and the implicit-mode gramian sums over every table row
+            item0 = jnp.concatenate(
+                [item0, jnp.zeros((num_items_p - ratings.num_cols, rank),
+                                  dtype=jnp.float32)])
+        if tp:
+            item0 = jax.device_put(
+                item0, NamedSharding(mesh, P("model", None)))
         user, item = _als_iterate_fused(
             item0, _fused_bucket_args(dev_user), _fused_bucket_args(dev_item),
             iterations, float(lam), float(alpha), implicit,
-            ratings.num_rows, ratings.num_cols,
+            num_users_p, num_items_p,
             bf16=(matmul_dtype == "bfloat16"), cg_steps=cg_steps,
-            solver=solver,
+            solver=solver, mesh=mesh if tp else None, shard_factors=tp,
         )
+        if num_users_p != ratings.num_rows:
+            user = user[: ratings.num_rows]
+        if num_items_p != ratings.num_cols:
+            item = item[: ratings.num_cols]
         return ALSFactors(user=user, item=item)
     if layout == "chunked" and (max_row_len is not None or not hbm_resident):
         raise ValueError(
@@ -1316,9 +1394,11 @@ def als_train(
         for _ in range(iterations):
             user = solve_half(item, by_user, rank, lam, implicit, alpha,
                               mesh, max_slab_elems, matmul_dtype,
+                              shard_factors=shard_factors,
                               cg_steps=cg_steps, solver=solver)
             item = solve_half(user, by_item, rank, lam, implicit, alpha,
                               mesh, max_slab_elems, matmul_dtype,
+                              shard_factors=shard_factors,
                               cg_steps=cg_steps, solver=solver)
         return ALSFactors(user=user, item=item)
 
@@ -1342,10 +1422,12 @@ def als_train(
     user = None
     for it in range(iterations):
         user = solve_half(item, by_user, rank, lam, implicit, alpha, mesh,
-                          max_slab_elems, matmul_dtype, cg_steps=cg_steps,
+                          max_slab_elems, matmul_dtype,
+                          shard_factors=shard_factors, cg_steps=cg_steps,
                           solver=solver)
         item = solve_half(user, by_item, rank, lam, implicit, alpha, mesh,
-                          max_slab_elems, matmul_dtype, cg_steps=cg_steps,
+                          max_slab_elems, matmul_dtype,
+                          shard_factors=shard_factors, cg_steps=cg_steps,
                           solver=solver)
     return ALSFactors(user=user, item=item)
 

@@ -157,6 +157,9 @@ class ECommAlgorithmParams(Params):
     alpha: float = 1.0
     seed: int = 3
     use_mesh: bool = True
+    #: DP×MP tensor parallelism (engine.json "shardFactors"); see
+    #: docs/parallelism.md
+    shard_factors: bool = False
 
 
 @dataclasses.dataclass
@@ -193,6 +196,7 @@ class ECommAlgorithm(ShardedAlgorithm):
             alpha=p.alpha,
             seed=p.seed,
             mesh=mesh,
+            shard_factors=p.shard_factors,
         )
         als = ALSModel(
             rank=p.rank,

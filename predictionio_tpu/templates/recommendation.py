@@ -237,6 +237,10 @@ class ALSAlgorithmParams(Params):
     alpha: float = 1.0
     use_mesh: bool = True
     exclude_seen: bool = True
+    #: row-shard the factor tables over the mesh's "model" axis (DP×MP
+    #: tensor parallelism, engine.json "shardFactors") — for catalogs
+    #: whose tables exceed one device's HBM; see docs/parallelism.md
+    shard_factors: bool = False
 
 
 class ALSAlgorithm(ShardedAlgorithm):
@@ -262,6 +266,7 @@ class ALSAlgorithm(ShardedAlgorithm):
             alpha=p.alpha,
             seed=p.seed,
             mesh=mesh,
+            shard_factors=p.shard_factors,
         )
         return ALSModel(
             rank=p.rank,

@@ -152,6 +152,9 @@ class ALSAlgorithmParams(Params):
     alpha: float = 1.0
     seed: int = 3
     use_mesh: bool = True
+    #: DP×MP tensor parallelism (engine.json "shardFactors"); see
+    #: docs/parallelism.md
+    shard_factors: bool = False
 
 
 @dataclasses.dataclass
@@ -185,6 +188,7 @@ class SimilarALSAlgorithm(ShardedAlgorithm):
             alpha=p.alpha,
             seed=p.seed,
             mesh=mesh,
+            shard_factors=p.shard_factors,
         )
         als = ALSModel(
             rank=p.rank,

@@ -1,0 +1,127 @@
+"""Child process for the two-process FUSED-layout ALS test: a short
+``_als_iterate_fused`` training run (the library's default layout)
+executing across process boundaries on a dp×tp mesh.
+
+Both "hosts" build the identical ladder layout (same seed) and
+contribute their LOCAL slab B-slices via
+``make_array_from_process_local_data`` using the SAME host padding as
+single-process staging (ops/als.pad_bucket_slabs — shared so the layout
+convention cannot drift). The 2×2 global mesh spans both processes on
+BOTH axes: slabs shard over "data" (one process per data index) and the
+factor tables shard over "model" (each model shard lives on devices of
+both processes), so the run exercises the DCN boundary for the data
+gathers AND the tensor-parallel table collectives. Each host verifies
+the replicated factor tables of the full 2-iteration run against a
+per-row NumPy f64 oracle. Run only via test_distributed_multihost.py.
+"""
+
+import sys
+
+import numpy as np
+
+from predictionio_tpu.utils.testing import force_cpu_devices
+
+force_cpu_devices(2)
+
+from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
+
+active = maybe_initialize_distributed()
+assert active
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops.als import (
+    RatingsCOO,
+    _als_iterate_fused,
+    ladder_rows,
+    pad_bucket_slabs,
+)
+
+assert jax.device_count() == 4
+
+# 2×2 mesh: "data" rows land one per process; "model" columns span both
+mesh = Mesh(np.asarray(jax.devices()).reshape(2, 2), ("data", "model"))
+data_axis = 2
+
+# identical layout on both hosts (same seed)
+rng = np.random.default_rng(0)
+num_rows, num_cols, nnz = 64, 24, 800
+rank, iterations, lam = 6, 2, 0.1
+coo = RatingsCOO(
+    rows=(num_rows * rng.random(nnz) ** 1.6).astype(np.int32),
+    cols=(num_cols * rng.random(nnz) ** 1.6).astype(np.int32),
+    vals=(rng.random(nnz) * 5).astype(np.float32),
+    num_rows=num_rows,
+    num_cols=num_cols,
+)
+# width=8 keeps several ladder buckets alive at this tiny scale
+by_user = ladder_rows(coo, width=8, small=4, use_native=False)
+by_item = ladder_rows(coo.transpose(), width=8, small=4, use_native=False)
+
+slab_sh = NamedSharding(mesh, P(None, "data", None))
+vec_sh = NamedSharding(mesh, P(None, "data"))
+rep_sh = NamedSharding(mesh, P())
+tp_sh = NamedSharding(mesh, P("model", None))
+pidx = jax.process_index()
+mk = jax.make_array_from_process_local_data
+
+
+def stage(bucketed):
+    out = []
+    for b in bucketed.buckets:
+        cols, vals, deg = pad_bucket_slabs(b, rank, data_axis, 1 << 12)
+        half = cols.shape[1] // 2
+        lo, hi = pidx * half, (pidx + 1) * half
+        out.append((
+            mk(rep_sh, b.row_ids, b.row_ids.shape),
+            mk(slab_sh, cols[:, lo:hi], cols.shape),
+            mk(slab_sh, vals[:, lo:hi], vals.shape),
+            mk(vec_sh, deg[:, lo:hi], deg.shape),
+        ))
+    return tuple(out)
+
+
+bu, bi = stage(by_user), stage(by_item)
+
+item0 = (rng.standard_normal((num_cols, rank)) / np.sqrt(rank)).astype(
+    np.float32)
+# model-sharded table: every process holds both model shards locally,
+# so the local contribution is the full table
+item0_dev = mk(tp_sh, item0, item0.shape)
+
+user, item = _als_iterate_fused(
+    item0_dev, bu, bi, iterations, lam, 40.0, False, num_rows, num_cols,
+    bf16=False, cg_steps=None, mesh=mesh, shard_factors=True)
+assert user.sharding.spec[0] == "model", user.sharding
+user_l = np.asarray(jax.jit(lambda x: x, out_shardings=rep_sh)(user))
+item_l = np.asarray(jax.jit(lambda x: x, out_shardings=rep_sh)(item))
+
+
+def oracle_half(V, rows, cols, vals, n_rows, K):
+    out = np.zeros((n_rows, K))
+    for u in range(n_rows):
+        sel = rows == u
+        if not sel.any():
+            continue
+        F = V[cols[sel]].astype(np.float64)
+        r = vals[sel].astype(np.float64)
+        A = F.T @ F + lam * len(r) * np.eye(K)
+        out[u] = np.linalg.solve(A, F.T @ r)
+    return out
+
+
+u_o = np.zeros((num_rows, rank))
+i_o = item0.astype(np.float64)
+for _ in range(iterations):
+    u_o = oracle_half(i_o, coo.rows, coo.cols, coo.vals, num_rows, rank)
+    i_o = oracle_half(u_o, coo.cols, coo.rows, coo.vals, num_cols, rank)
+
+np.testing.assert_allclose(user_l, u_o, rtol=2e-3, atol=2e-3)
+np.testing.assert_allclose(item_l, i_o, rtol=2e-3, atol=2e-3)
+
+print(f"RESULT host={jax.process_index()} fused_tp_ok "
+      f"norm={float(np.linalg.norm(user_l) + np.linalg.norm(item_l)):.4f}",
+      flush=True)
+sys.exit(0)

@@ -685,3 +685,67 @@ def test_stale_native_library_falls_back_to_numpy(monkeypatch):
     monkeypatch.setattr(
         "predictionio_tpu.native.load_bucketize", lambda: None)
     assert sum(int(s.deg.sum()) for s in chunk_rows(coo, (8,)).slabs) == coo.nnz
+
+
+def test_fused_tp_factor_tables_are_model_sharded(mesh8):
+    """The DP×MP tensor-parallel layout on the FUSED (default) path
+    (VERDICT r3 missing #1; BASELINE's sharded-embeddings config): both
+    result tables must be genuinely row-sharded over the "model" axis —
+    per-device shards hold num_rows/model_axis rows — and match the
+    single-device factors."""
+    import jax
+
+    rng = np.random.default_rng(9)
+    nnz = 12_000
+    users, items = 96, 64        # divisible by model axis (2): exact shards
+    coo = RatingsCOO(
+        (users * rng.random(nnz) ** 1.6).astype(np.int32),
+        (items * rng.random(nnz) ** 1.6).astype(np.int32),
+        rng.random(nnz).astype(np.float32) * 5, users, items,
+    )
+    single = als_train(coo, rank=8, iterations=3, lam=0.05, seed=1,
+                       layout="fused", matmul_dtype="float32")
+    tp = als_train(coo, rank=8, iterations=3, lam=0.05, seed=1,
+                   mesh=mesh8, layout="fused", shard_factors=True,
+                   matmul_dtype="float32")
+    np.testing.assert_allclose(
+        np.asarray(single.user), np.asarray(tp.user), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(single.item), np.asarray(tp.item), rtol=2e-4, atol=2e-4)
+
+    model_ax = int(mesh8.shape["model"])
+    for table, n in ((tp.user, users), (tp.item, items)):
+        spec = table.sharding.spec
+        assert spec[0] == "model", f"table not model-sharded: {spec}"
+        shard_rows = {s.data.shape[0] for s in table.addressable_shards}
+        assert shard_rows == {n // model_ax}, (
+            f"expected {n // model_ax}-row shards, got {shard_rows}")
+
+
+def test_fused_tp_handles_nondivisible_rows_and_implicit(mesh8):
+    """Row counts that don't divide the model axis pad internally and
+    slice back; implicit mode's gramian must ignore the pad rows."""
+    rng = np.random.default_rng(11)
+    nnz = 6_000
+    users, items = 91, 53        # NOT divisible by model axis
+    coo = RatingsCOO(
+        (users * rng.random(nnz) ** 1.6).astype(np.int32),
+        (items * rng.random(nnz) ** 1.6).astype(np.int32),
+        (rng.random(nnz) * 4 + 1).astype(np.float32), users, items,
+    )
+    for implicit in (False, True):
+        single = als_train(coo, rank=4, iterations=2, lam=0.05, seed=2,
+                           implicit=implicit, alpha=8.0, layout="fused",
+                           matmul_dtype="float32")
+        tp = als_train(coo, rank=4, iterations=2, lam=0.05, seed=2,
+                       implicit=implicit, alpha=8.0, mesh=mesh8,
+                       layout="fused", shard_factors=True,
+                       matmul_dtype="float32")
+        assert np.asarray(tp.user).shape == (users, 4)
+        assert np.asarray(tp.item).shape == (items, 4)
+        np.testing.assert_allclose(
+            np.asarray(single.user), np.asarray(tp.user),
+            rtol=2e-4, atol=2e-4, err_msg=f"implicit={implicit}")
+        np.testing.assert_allclose(
+            np.asarray(single.item), np.asarray(tp.item),
+            rtol=2e-4, atol=2e-4, err_msg=f"implicit={implicit}")

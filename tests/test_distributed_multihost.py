@@ -17,6 +17,8 @@ import sys
 
 CHILD = os.path.join(os.path.dirname(__file__), "multihost_child.py")
 ALS_CHILD = os.path.join(os.path.dirname(__file__), "multihost_als_child.py")
+FUSED_CHILD = os.path.join(os.path.dirname(__file__),
+                           "multihost_fused_child.py")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -82,6 +84,22 @@ def test_two_process_sharded_als_half_step():
     assert "als_half_ok" in outs[0][1]
     assert "als_half_ok" in outs[1][1]
     # both hosts computed the identical replicated factor table
+    n0 = outs[0][1].split("norm=")[1].strip()
+    n1 = outs[1][1].split("norm=")[1].strip()
+    assert n0 == n1
+
+
+def test_two_process_fused_tp_training_run(
+):
+    """The FUSED (default) layout's full training scan across two
+    processes on a dp×tp mesh (VERDICT r3 item 8): slabs shard over
+    "data" (one process per data index), factor tables shard over
+    "model" (shards span both processes), 2 full ALS iterations run as
+    one device program with XLA's cross-process collectives, and both
+    hosts verify the tables against a per-row NumPy f64 oracle."""
+    outs = _run_children(FUSED_CHILD)
+    assert "fused_tp_ok" in outs[0][1]
+    assert "fused_tp_ok" in outs[1][1]
     n0 = outs[0][1].split("norm=")[1].strip()
     n1 = outs[1][1].split("norm=")[1].strip()
     assert n0 == n1
