@@ -1,0 +1,38 @@
+"""Seed CustomAttrApp: users whose plan correlates with age/education.
+Run after `pio app new CustomAttrApp`."""
+
+import sys
+
+import numpy as np
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.registry import Storage
+
+storage = Storage.default()
+app = storage.get_meta_data_apps().get_by_name("CustomAttrApp")
+if app is None:
+    sys.exit("app 'CustomAttrApp' not found — run "
+             "`pio app new CustomAttrApp` first")
+
+events = storage.get_events()
+rng = np.random.default_rng(23)
+genders = ["Male", "Female"]
+educations = ["No School", "High School", "College"]
+n = 0
+for u in range(120):
+    gender = genders[int(rng.integers(0, 2))]
+    education = educations[int(rng.integers(0, 3))]
+    age = float(rng.integers(18, 70))
+    # plan: college grads and the young skew premium
+    premium = (education == "College") or (age < 30 and rng.random() < 0.7)
+    events.insert(
+        Event(event="$set", entity_type="user", entity_id=f"u{u}",
+              properties=DataMap({
+                  "plan": "premium" if premium else "basic",
+                  "gender": gender, "age": age, "education": education,
+              })),
+        app.id,
+    )
+    n += 1
+print(f"seeded {n} users into CustomAttrApp (app id {app.id})")

@@ -1,0 +1,35 @@
+"""Seed RateEventApp: two taste communities rating 16 items 1-5, with
+some re-rates (only the latest counts). Run after
+`pio app new RateEventApp`."""
+
+import sys
+
+import numpy as np
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.registry import Storage
+
+storage = Storage.default()
+app = storage.get_meta_data_apps().get_by_name("RateEventApp")
+if app is None:
+    sys.exit("app 'RateEventApp' not found — run "
+             "`pio app new RateEventApp` first")
+
+events = storage.get_events()
+rng = np.random.default_rng(17)
+n = 0
+for u in range(20):
+    for i in range(16):
+        if rng.random() < 0.7:
+            liked = i % 2 == u % 2
+            rating = float(rng.integers(4, 6) if liked
+                           else rng.integers(1, 3))
+            events.insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties=DataMap({"rating": rating})),
+                app.id,
+            )
+            n += 1
+print(f"seeded {n} rate events into RateEventApp (app id {app.id})")

@@ -1,0 +1,38 @@
+"""Seed FilterByYearApp: two view communities over 16 items, each item
+$set with a release year. Run after `pio app new FilterByYearApp`."""
+
+import sys
+
+import numpy as np
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.registry import Storage
+
+storage = Storage.default()
+app = storage.get_meta_data_apps().get_by_name("FilterByYearApp")
+if app is None:
+    sys.exit("app 'FilterByYearApp' not found — run "
+             "`pio app new FilterByYearApp` first")
+
+events = storage.get_events()
+rng = np.random.default_rng(11)
+n = 0
+for i in range(16):
+    events.insert(
+        Event(event="$set", entity_type="item", entity_id=f"i{i}",
+              properties=DataMap({"year": 1990 + i})),
+        app.id,
+    )
+    n += 1
+for u in range(20):
+    for i in range(16):
+        if i % 2 == u % 2 and rng.random() < 0.8:
+            events.insert(
+                Event(event="view", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties=DataMap({})),
+                app.id,
+            )
+            n += 1
+print(f"seeded {n} events into FilterByYearApp (app id {app.id})")

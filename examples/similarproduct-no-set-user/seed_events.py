@@ -1,0 +1,31 @@
+"""Seed NoSetUserApp with ONLY view events — no $set of any kind.
+Run after `pio app new NoSetUserApp`."""
+
+import sys
+
+import numpy as np
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.registry import Storage
+
+storage = Storage.default()
+app = storage.get_meta_data_apps().get_by_name("NoSetUserApp")
+if app is None:
+    sys.exit("app 'NoSetUserApp' not found — run "
+             "`pio app new NoSetUserApp` first")
+
+events = storage.get_events()
+rng = np.random.default_rng(19)
+n = 0
+for u in range(20):
+    for i in range(16):
+        if i % 2 == u % 2 and rng.random() < 0.8:
+            events.insert(
+                Event(event="view", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties=DataMap({})),
+                app.id,
+            )
+            n += 1
+print(f"seeded {n} view events into NoSetUserApp (app id {app.id})")

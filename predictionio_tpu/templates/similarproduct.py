@@ -175,6 +175,10 @@ class SimilarALSAlgorithm(ShardedAlgorithm):
 
     params_class = ALSAlgorithmParams
     query_class = Query
+    #: Hu-Koren confidence weighting by default; the add-rateevent
+    #: variant flips this to train explicit ALS-WR on rating values
+    #: (reference ALSAlgorithm.scala:128 ALS.train vs trainImplicit)
+    implicit_prefs = True
 
     def train(self, ctx, pd: SimilarPreparedData) -> SimilarModel:
         p = self.params
@@ -184,7 +188,7 @@ class SimilarALSAlgorithm(ShardedAlgorithm):
             rank=p.rank,
             iterations=p.num_iterations,
             lam=p.lambda_,
-            implicit=True,
+            implicit=self.implicit_prefs,
             alpha=p.alpha,
             seed=p.seed,
             mesh=mesh,

@@ -1,0 +1,158 @@
+"""Scenario test for examples/classification-custom-attributes — the
+reference's custom-attributes classification variant: categorical
+attribute featurization with fixed maps, required-property filtering,
+random-forest algorithm, string-attribute queries. Driven through the
+real train workflow and HTTP serving."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.train import run_train
+
+EXAMPLE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "examples",
+    "classification-custom-attributes",
+)
+
+
+@pytest.fixture
+def example_engine():
+    sys.path.insert(0, EXAMPLE_DIR)
+    sys.modules.pop("engine", None)
+    try:
+        import engine
+
+        yield engine
+    finally:
+        sys.path.remove(EXAMPLE_DIR)
+        sys.modules.pop("engine", None)
+
+
+@pytest.fixture
+def seeded_storage(storage):
+    """Plan correlates hard with education: College -> premium."""
+    app_id = storage.get_meta_data_apps().insert(App(0, "CustomAttrApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(23)
+    genders = ["Male", "Female"]
+    educations = ["No School", "High School", "College"]
+    for u in range(120):
+        gender = genders[int(rng.integers(0, 2))]
+        education = educations[int(rng.integers(0, 3))]
+        age = float(rng.integers(18, 70))
+        premium = education == "College"
+        events.insert(
+            Event(event="$set", entity_type="user", entity_id=f"u{u}",
+                  properties=DataMap({
+                      "plan": "premium" if premium else "basic",
+                      "gender": gender, "age": age,
+                      "education": education,
+                  })), app_id)
+    # incomplete users must be skipped, not crash training (the
+    # reference's required-properties filter)
+    events.insert(
+        Event(event="$set", entity_type="user", entity_id="incomplete",
+              properties=DataMap({"plan": "basic", "age": 40.0})), app_id)
+    return storage
+
+
+def _variant():
+    with open(os.path.join(EXAMPLE_DIR, "engine.json")) as f:
+        variant = json.load(f)
+    return variant
+
+
+def test_categorical_query_over_http(example_engine, seeded_storage):
+    from predictionio_tpu.api.engine_server import EngineServer
+    from predictionio_tpu.workflow.context import EngineContext
+    from predictionio_tpu.workflow.deploy import (
+        DeployedEngine,
+        ServerConfig,
+    )
+    from predictionio_tpu.workflow.persistence import load_models
+
+    variant = _variant()
+    outcome = run_train(variant=variant, storage=seeded_storage)
+    assert outcome.status == "COMPLETED"
+
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(variant)
+    ctx = EngineContext(storage=seeded_storage)
+    _, _, algos, serving = eng.make_components(ep)
+    models = eng.prepare_deploy(
+        ctx, ep, load_models(seeded_storage, outcome.instance_id),
+        algorithms=algos)
+
+    instance = seeded_storage.get_meta_data_engine_instances().get(
+        outcome.instance_id)
+    server = EngineServer(
+        DeployedEngine(None, instance, algos, serving, models),
+        ServerConfig(ip="127.0.0.1", port=0))
+    server.start()
+    try:
+        def query(body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/queries.json",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        grad = query({"gender": "Female", "age": 25,
+                      "education": "College"})
+        assert grad["label"] == "premium", grad
+        dropout = query({"gender": "Male", "age": 55,
+                         "education": "No School"})
+        assert dropout["label"] == "basic", dropout
+        # scores are normalized vote shares over the label set
+        assert set(grad["scores"]) == {"premium", "basic"}
+        assert abs(sum(grad["scores"].values()) - 1.0) < 1e-6
+    finally:
+        server.stop()
+
+
+def test_engine_json_binds_forest_params(example_engine):
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(_variant())
+    params = ep.algorithm_params_list[0][1]
+    assert params.num_trees == 10
+    assert params.max_depth == 5
+
+
+def test_unknown_categorical_query_is_clear_error(
+        example_engine, seeded_storage):
+    variant = _variant()
+    outcome = run_train(variant=variant, storage=seeded_storage)
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(variant)
+    from predictionio_tpu.workflow.context import EngineContext
+    from predictionio_tpu.workflow.persistence import load_models
+
+    ctx = EngineContext(storage=seeded_storage)
+    _, _, algos, _ = eng.make_components(ep)
+    models = eng.prepare_deploy(
+        ctx, ep, load_models(seeded_storage, outcome.instance_id),
+        algorithms=algos)
+    with pytest.raises(ValueError, match="unknown education"):
+        algos[0].predict(models[0], example_engine.Query(
+            gender="Male", age=30, education="PhD"))
+
+
+def test_incomplete_users_are_skipped(example_engine, seeded_storage):
+    from predictionio_tpu.workflow.context import EngineContext
+
+    ds = example_engine.CustomAttrDataSource(
+        example_engine.CustomAttrDataSource.params_class(
+            app_name="CustomAttrApp"))
+    td = ds.read_training(EngineContext(storage=seeded_storage))
+    assert len(td.features) == 120        # not 121

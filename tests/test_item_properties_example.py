@@ -1,0 +1,122 @@
+"""Scenario test for examples/similarproduct-add-and-return-item-properties
+— the reference's add-and-return-item-properties variant: required
+title/date/imdbUrl item properties read at train time, every returned
+score enriched with them. Driven through the real train workflow and
+HTTP serving."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.train import run_train
+
+EXAMPLE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "examples",
+    "similarproduct-add-and-return-item-properties",
+)
+
+
+@pytest.fixture
+def example_engine():
+    sys.path.insert(0, EXAMPLE_DIR)
+    sys.modules.pop("engine", None)
+    try:
+        import engine
+
+        yield engine
+    finally:
+        sys.path.remove(EXAMPLE_DIR)
+        sys.modules.pop("engine", None)
+
+
+def _seed(storage, complete=True):
+    app_id = storage.get_meta_data_apps().insert(App(0, "RichItemApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(12)
+    for i in range(16):
+        props = {"title": f"title for i{i}", "date": str(1990 + i),
+                 "imdbUrl": f"http://imdb.com/i{i}"}
+        if not complete and i == 3:
+            del props["imdbUrl"]
+        events.insert(
+            Event(event="$set", entity_type="item", entity_id=f"i{i}",
+                  properties=DataMap(props)), app_id)
+    for u in range(20):
+        for i in range(16):
+            if i % 2 == u % 2 and rng.random() < 0.8:
+                events.insert(
+                    Event(event="view", entity_type="user",
+                          entity_id=f"u{u}", target_entity_type="item",
+                          target_entity_id=f"i{i}", properties=DataMap({})),
+                    app_id)
+    return storage
+
+
+def _variant():
+    with open(os.path.join(EXAMPLE_DIR, "engine.json")) as f:
+        variant = json.load(f)
+    variant["algorithms"][0]["params"]["use_mesh"] = False
+    return variant
+
+
+def test_results_are_property_enriched(example_engine, storage):
+    from predictionio_tpu.api.engine_server import EngineServer
+    from predictionio_tpu.workflow.context import EngineContext
+    from predictionio_tpu.workflow.deploy import (
+        DeployedEngine,
+        ServerConfig,
+    )
+    from predictionio_tpu.workflow.persistence import load_models
+
+    seeded = _seed(storage)
+    variant = _variant()
+    outcome = run_train(variant=variant, storage=seeded)
+    assert outcome.status == "COMPLETED"
+
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(variant)
+    ctx = EngineContext(storage=seeded)
+    _, _, algos, serving = eng.make_components(ep)
+    models = eng.prepare_deploy(
+        ctx, ep, load_models(seeded, outcome.instance_id), algorithms=algos)
+    # persisted round-trip preserves the properties map
+    assert models[0].item_props["i5"]["title"] == "title for i5"
+
+    instance = seeded.get_meta_data_engine_instances().get(
+        outcome.instance_id)
+    server = EngineServer(
+        DeployedEngine(None, instance, algos, serving, models),
+        ServerConfig(ip="127.0.0.1", port=0))
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/queries.json",
+            data=json.dumps({"items": ["i2"], "num": 4}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            scores = json.loads(r.read())["itemScores"]
+        assert len(scores) == 4
+        for s in scores:
+            i = s["item"]
+            # full enrichment on the wire (reference ItemScore parity:
+            # item, title, date, imdbUrl, score)
+            assert s["title"] == f"title for {i}"
+            assert s["date"] == str(1990 + int(i[1:]))
+            assert s["imdbUrl"] == f"http://imdb.com/{i}"
+            assert np.isfinite(s["score"])
+    finally:
+        server.stop()
+
+
+def test_missing_property_fails_training_loudly(example_engine, storage):
+    seeded = _seed(storage, complete=False)
+    with pytest.raises(ValueError, match="imdbUrl"):
+        run_train(variant=_variant(), storage=seeded)

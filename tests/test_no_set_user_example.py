@@ -1,0 +1,98 @@
+"""Scenario test for examples/similarproduct-no-set-user — the
+reference's no-set-user variant: the engine trains and serves with ZERO
+$set events of any kind (users exist only as view-event subjects). In
+the reference this needed DataSource/ALSAlgorithm changes
+(ALSAlgorithm.scala:75 builds the user index from viewEvents); here it
+is the template default, pinned by this test."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.train import run_train
+
+EXAMPLE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "examples",
+    "similarproduct-no-set-user",
+)
+
+
+@pytest.fixture
+def example_engine():
+    sys.path.insert(0, EXAMPLE_DIR)
+    sys.modules.pop("engine", None)
+    try:
+        import engine
+
+        yield engine
+    finally:
+        sys.path.remove(EXAMPLE_DIR)
+        sys.modules.pop("engine", None)
+
+
+def test_trains_and_serves_with_zero_set_events(example_engine, storage):
+    from predictionio_tpu.api.engine_server import EngineServer
+    from predictionio_tpu.workflow.context import EngineContext
+    from predictionio_tpu.workflow.deploy import (
+        DeployedEngine,
+        ServerConfig,
+    )
+    from predictionio_tpu.workflow.persistence import load_models
+
+    app_id = storage.get_meta_data_apps().insert(App(0, "NoSetUserApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(19)
+    n_events = 0
+    for u in range(20):
+        for i in range(16):
+            if i % 2 == u % 2 and rng.random() < 0.8:
+                events.insert(
+                    Event(event="view", entity_type="user",
+                          entity_id=f"u{u}", target_entity_type="item",
+                          target_entity_id=f"i{i}", properties=DataMap({})),
+                    app_id)
+                n_events += 1
+    # the property under test: NOTHING but view events in the store
+    assert all(e.event == "view" for e in events.find(app_id))
+    assert n_events > 0
+
+    with open(os.path.join(EXAMPLE_DIR, "engine.json")) as f:
+        variant = json.load(f)
+    variant["algorithms"][0]["params"]["use_mesh"] = False
+    outcome = run_train(variant=variant, storage=storage)
+    assert outcome.status == "COMPLETED"
+
+    eng = example_engine.engine_factory()
+    ep = eng.params_from_variant_json(variant)
+    ctx = EngineContext(storage=storage)
+    _, _, algos, serving = eng.make_components(ep)
+    models = eng.prepare_deploy(
+        ctx, ep, load_models(storage, outcome.instance_id), algorithms=algos)
+
+    instance = storage.get_meta_data_engine_instances().get(
+        outcome.instance_id)
+    server = EngineServer(
+        DeployedEngine(None, instance, algos, serving, models),
+        ServerConfig(ip="127.0.0.1", port=0))
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/queries.json",
+            data=json.dumps({"items": ["i2"], "num": 4}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            scores = json.loads(r.read())["itemScores"]
+        recs = [s["item"] for s in scores]
+        assert len(recs) == 4 and "i2" not in recs
+        even = sum(1 for i in recs if int(i[1:]) % 2 == 0)
+        assert even >= 3, recs
+    finally:
+        server.stop()
