@@ -749,3 +749,70 @@ def test_fused_tp_handles_nondivisible_rows_and_implicit(mesh8):
         np.testing.assert_allclose(
             np.asarray(single.item), np.asarray(tp.item),
             rtol=2e-4, atol=2e-4, err_msg=f"implicit={implicit}")
+
+
+class TestBf16CGMatvec:
+    def test_bf16_matvec_within_measured_band_vs_f64_oracle(self):
+        """The bf16 A-matvec CG (rank-200 auto policy) must stay inside
+        the measured ~2.5e-3 relative band vs an f64 oracle on both
+        system families (round-4 probe; _cg_solve_batched docstring)."""
+        from predictionio_tpu.ops.als import _cg_solve_batched
+
+        rng = np.random.default_rng(0)
+        for lo, hi, lam in ((800, 2000, 0.08), (100, 400, 0.01)):
+            A, b = TestHighRankSolver._normal_systems(
+                rng, batch=32, rank=200, deg_lo=lo, deg_hi=hi, lam=lam)
+            exact = np.linalg.solve(
+                A.astype(np.float64), b.astype(np.float64)[..., None]
+            )[..., 0]
+            norm = np.linalg.norm(exact, axis=-1)
+            bf = np.asarray(_cg_solve_batched(
+                jnp.asarray(A), jnp.asarray(b), bf16_matvec=True))
+            err = (np.linalg.norm(bf - exact, axis=-1) / norm).max()
+            assert err < 5e-3, f"bf16-matvec CG rel err {err:.2e}"
+
+    def test_auto_policy_resolves_by_rank(self):
+        from predictionio_tpu.ops.als import (
+            _CG_BF16_RANK,
+            _resolve_cg_matvec,
+        )
+
+        assert _resolve_cg_matvec("auto", 200) is True
+        assert _resolve_cg_matvec("auto", _CG_BF16_RANK) is True
+        assert _resolve_cg_matvec("auto", 32) is False
+        assert _resolve_cg_matvec("float32", 200) is False
+        assert _resolve_cg_matvec("bfloat16", 8) is True
+        with pytest.raises(ValueError, match="cg_matvec_dtype"):
+            _resolve_cg_matvec("fp8", 200)
+
+    def test_high_rank_quality_matches_f32_cg(self):
+        """End-to-end: rank-96 training (auto -> bf16 matvec) reaches
+        the same reconstruction quality as the forced-f32 run. The
+        ITERATES are not compared pointwise — alternation amplifies any
+        per-solve perturbation into different (equally good) factor
+        trajectories; RMSE is the estimator-level gate."""
+        rng = np.random.default_rng(7)
+        coo = _random_coo(rng, users=48, items=30, density=0.5)
+        bf = als_train(coo, rank=96, iterations=3, lam=0.05, seed=1,
+                       matmul_dtype="float32")          # cg auto -> bf16
+        f32 = als_train(coo, rank=96, iterations=3, lam=0.05, seed=1,
+                        matmul_dtype="float32",
+                        cg_matvec_dtype="float32")
+        r_bf, r_f32 = rmse(bf, coo), rmse(f32, coo)
+        assert abs(r_bf - r_f32) < 5e-3, (r_bf, r_f32)
+
+
+def test_cg_survives_singular_system_with_bf16_matvec():
+    """Negative-curvature guard (round-4 review): on a singular system
+    the bf16 matvec's rounding can push p.Ap <= 0 — CG must take a zero
+    step (finite iterate), never an exploding one."""
+    from predictionio_tpu.ops.als import _cg_solve_batched
+
+    rng = np.random.default_rng(2)
+    v = rng.standard_normal(16).astype(np.float32)
+    A = np.outer(v, v)[None] * 1e-4          # rank-1, near-zero: singular
+    b = rng.standard_normal((1, 16)).astype(np.float32)
+    for bf16 in (False, True):
+        x = np.asarray(_cg_solve_batched(
+            jnp.asarray(A), jnp.asarray(b), steps=16, bf16_matvec=bf16))
+        assert np.isfinite(x).all(), (bf16, x)
