@@ -1,0 +1,293 @@
+"""PostgreSQL v3 wire-protocol client — pure stdlib sockets.
+
+The networked-SQL client the reference's JDBC backend role calls for
+(reference: storage/jdbc/src/main/scala/.../jdbc/StorageClient.scala —
+scalikejdbc ConnectionPool over a postgresql:// URL). There is no JVM
+and no JDBC here, so the wire layer is implemented directly against the
+public PostgreSQL frontend/backend protocol (v3.0): StartupMessage,
+trust / cleartext / MD5 password authentication, the simple query
+cycle (Query -> RowDescription / DataRow* / CommandComplete /
+ReadyForQuery), and typed text-format decoding by column OID.
+
+Scope, stated plainly (docs/storage.md "networked-SQL story"): this
+client implements the protocol from its public specification and is
+exercised in-tree against a wire-faithful in-process emulator
+(tests/pg_emulator.py) — zero egress means no real PostgreSQL server
+exists in this environment to integration-test against. SCRAM-SHA-256
+and TLS negotiation are not implemented (documented gaps; MD5 and
+cleartext cover the classic deployments the reference's examples use).
+
+Queries use the SIMPLE protocol with client-side literal binding (the
+extended protocol's Parse/Bind adds round trips the DAO layer never
+amortizes); see :func:`quote_literal` for the escaping rules.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+
+
+class PGError(Exception):
+    """Server ErrorResponse: carries the SQLSTATE in ``code``."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+        self.message = message
+
+
+class PGProtocolError(Exception):
+    """Malformed or unexpected protocol traffic."""
+
+
+def quote_literal(value) -> str:
+    """SQL literal for client-side binding under the simple protocol.
+
+    Strings use standard_conforming escaping (doubled single quotes;
+    backslash is literal). Bytes become a hex bytea cast. NUL bytes are
+    rejected — PostgreSQL text values cannot carry them and silently
+    truncating would corrupt data."""
+    if value is None:
+        return "NULL"
+    if isinstance(value, bool):
+        return "TRUE" if value else "FALSE"
+    if isinstance(value, int):
+        return str(value)
+    if isinstance(value, float):
+        if value != value or value in (float("inf"), float("-inf")):
+            return f"'{value}'::float8"
+        return repr(value)
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return "'\\x" + bytes(value).hex() + "'::bytea"
+    s = str(value)
+    if "\x00" in s:
+        raise ValueError("NUL byte in SQL string literal")
+    return "'" + s.replace("'", "''") + "'"
+
+
+def bind_placeholders(sql: str, params: tuple) -> str:
+    """Replace ``?`` placeholders with quoted literals, skipping quoted
+    regions of the SQL text itself. Placeholder/param count mismatches
+    raise (even for zero params — a bare ``?`` must never ship)."""
+    out = []
+    it = iter(params)
+    i, n = 0, len(sql)
+    used = 0
+    while i < n:
+        ch = sql[i]
+        if ch == "'":
+            j = i + 1
+            while j < n:
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(sql[i:j + 1])
+            i = j + 1
+        elif ch == "?":
+            try:
+                out.append(quote_literal(next(it)))
+            except StopIteration:
+                raise PGProtocolError(
+                    f"more placeholders than params in {sql!r}")
+            used += 1
+            i += 1
+        else:
+            out.append(ch)
+            i += 1
+    if used != len(params):
+        raise PGProtocolError(
+            f"{len(params)} params for {used} placeholders in {sql!r}")
+    return "".join(out)
+
+
+def _decode_value(oid: int, raw: bytes | None):
+    """Text-format value decode by type OID (the ones our SQL surface
+    produces; unknown OIDs come back as str)."""
+    if raw is None:
+        return None
+    text = raw.decode("utf-8")
+    if oid in (20, 21, 23, 26):      # int8/int2/int4/oid
+        return int(text)
+    if oid in (700, 701, 1700):      # float4/float8/numeric
+        return float(text)
+    if oid == 16:                    # bool
+        return text == "t"
+    if oid == 17:                    # bytea (hex form)
+        if text.startswith("\\x"):
+            return bytes.fromhex(text[2:])
+        raise PGProtocolError("bytea escape format not supported; "
+                              "set bytea_output=hex")
+    return text
+
+
+class PGConnection:
+    """One authenticated protocol-v3 session; thread-safe via a lock
+    (one in-flight query cycle at a time — the simple protocol is
+    strictly request/response)."""
+
+    def __init__(self, host: str, port: int, user: str, database: str,
+                 password: str | None = None, timeout: float = 30.0):
+        self.user = user
+        self.password = password
+        self._lock = threading.Lock()
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = b""
+        self._startup(user, database)
+
+    # -- framing ----------------------------------------------------------
+
+    def _send(self, data: bytes) -> None:
+        self._sock.sendall(data)
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise PGProtocolError("server closed the connection")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_message(self) -> tuple[bytes, bytes]:
+        head = self._recv_exact(5)
+        tag = head[:1]
+        (length,) = struct.unpack("!I", head[1:5])
+        if length < 4:
+            raise PGProtocolError(f"bad message length {length}")
+        return tag, self._recv_exact(length - 4)
+
+    @staticmethod
+    def _message(tag: bytes, payload: bytes) -> bytes:
+        return tag + struct.pack("!I", len(payload) + 4) + payload
+
+    # -- session ----------------------------------------------------------
+
+    def _startup(self, user: str, database: str) -> None:
+        params = (f"user\x00{user}\x00database\x00{database}\x00\x00"
+                  ).encode("utf-8")
+        body = struct.pack("!I", 196608) + params     # protocol 3.0
+        self._send(struct.pack("!I", len(body) + 4) + body)
+        while True:
+            tag, payload = self._read_message()
+            if tag == b"R":
+                (kind,) = struct.unpack("!I", payload[:4])
+                if kind == 0:                          # AuthenticationOk
+                    continue
+                if kind == 3:                          # cleartext
+                    self._password_message(self._require_password())
+                    continue
+                if kind == 5:                          # md5
+                    salt = payload[4:8]
+                    inner = hashlib.md5(
+                        self._require_password().encode()
+                        + self.user.encode()).hexdigest()
+                    digest = hashlib.md5(
+                        inner.encode() + salt).hexdigest()
+                    self._password_message("md5" + digest)
+                    continue
+                raise PGProtocolError(
+                    f"unsupported authentication request {kind} "
+                    "(SCRAM/GSS not implemented — use md5, cleartext "
+                    "or trust)")
+            elif tag in (b"S", b"K", b"N"):            # status/key/notice
+                continue
+            elif tag == b"Z":                          # ReadyForQuery
+                return
+            elif tag == b"E":
+                raise self._error(payload)
+            else:
+                raise PGProtocolError(
+                    f"unexpected startup message {tag!r}")
+
+    def _require_password(self) -> str:
+        if self.password is None:
+            raise PGError("28P01", "server requested a password but none "
+                                   "was configured (set PASSWORD)")
+        return self.password
+
+    def _password_message(self, secret: str) -> None:
+        self._send(self._message(b"p", secret.encode("utf-8") + b"\x00"))
+
+    @staticmethod
+    def _error(payload: bytes) -> PGError:
+        code, msg = "XX000", "unknown error"
+        for field in payload.split(b"\x00"):
+            if not field:
+                continue
+            k, v = field[:1], field[1:].decode("utf-8", "replace")
+            if k == b"C":
+                code = v
+            elif k == b"M":
+                msg = v
+        return PGError(code, msg)
+
+    # -- queries ----------------------------------------------------------
+
+    def execute(self, sql: str, params: tuple = ()) -> list[tuple]:
+        """One simple-query cycle; returns the LAST statement's rows."""
+        return self.execute_raw(bind_placeholders(sql, tuple(params)))
+
+    def execute_raw(self, bound: str) -> list[tuple]:
+        """Run SQL whose literals are ALREADY bound — no placeholder
+        scan (batch callers bind row-by-row and join)."""
+        with self._lock:
+            self._send(self._message(b"Q", bound.encode("utf-8") + b"\x00"))
+            rows: list[tuple] = []
+            oids: list[int] = []
+            error: PGError | None = None
+            while True:
+                tag, payload = self._read_message()
+                if tag == b"T":                        # RowDescription
+                    (ncols,) = struct.unpack("!H", payload[:2])
+                    oids, off = [], 2
+                    for _ in range(ncols):
+                        end = payload.index(b"\x00", off)
+                        # name, table oid(4), attnum(2), TYPE OID(4),
+                        # typlen(2), atttypmod(4), format(2)
+                        (oid,) = struct.unpack(
+                            "!I", payload[end + 7:end + 11])
+                        oids.append(oid)
+                        off = end + 19
+                    rows = []
+                elif tag == b"D":                      # DataRow
+                    (ncols,) = struct.unpack("!H", payload[:2])
+                    vals, off = [], 2
+                    for c in range(ncols):
+                        (ln,) = struct.unpack(
+                            "!i", payload[off:off + 4])
+                        off += 4
+                        if ln < 0:
+                            vals.append(None)
+                        else:
+                            vals.append(_decode_value(
+                                oids[c] if c < len(oids) else 25,
+                                payload[off:off + ln]))
+                            off += ln
+                    rows.append(tuple(vals))
+                elif tag in (b"C", b"I", b"N", b"S"):   # complete/empty/…
+                    continue
+                elif tag == b"E":
+                    error = self._error(payload)       # Z still follows
+                elif tag == b"Z":                      # ReadyForQuery
+                    if error is not None:
+                        raise error
+                    return rows
+                else:
+                    raise PGProtocolError(
+                        f"unexpected message {tag!r} in query cycle")
+
+    def close(self) -> None:
+        try:
+            self._send(self._message(b"X", b""))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

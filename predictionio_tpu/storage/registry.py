@@ -76,6 +76,7 @@ def _builtin_backends() -> None:
     from predictionio_tpu.storage.hdfs import HDFSStorageClient
     from predictionio_tpu.storage.localfs import LocalFSStorageClient
     from predictionio_tpu.storage.memory import MemoryStorageClient
+    from predictionio_tpu.storage.postgres import PGStorageClient
     from predictionio_tpu.storage.s3 import S3StorageClient
     from predictionio_tpu.storage.sqlite import SQLiteStorageClient
 
@@ -84,6 +85,11 @@ def _builtin_backends() -> None:
     # "jdbc" maps to the embedded SQL backend so reference pio-env.sh files
     # whose sources say TYPE=jdbc keep working.
     _BACKENDS.setdefault("jdbc", SQLiteStorageClient)
+    # networked SQL over the in-tree PostgreSQL wire client
+    # (storage/pgwire + storage/postgres — the reference's production
+    # JDBC deployment role, StorageClient.scala)
+    _BACKENDS.setdefault("postgres", PGStorageClient)
+    _BACKENDS.setdefault("pg", PGStorageClient)
     _BACKENDS.setdefault("localfs", LocalFSStorageClient)
     # append-only JSONL event store — the reference's hbase role
     # (event-data only)

@@ -483,13 +483,18 @@ class SQLiteChannels(base.Channels):
                     (channel.id, channel.name, channel.appid),
                 )
                 return channel.id
-            self._conn.execute(
-                "INSERT INTO pio_meta_channels (name, appid) VALUES (?,?)",
+            # RETURNING keeps the id fetch on the SAME pooled connection
+            # as the insert — a separate `SELECT last_insert_rowid()`
+            # call can borrow a different connection and return a stale
+            # or zero id (and the function does not exist on PostgreSQL,
+            # where this DAO also runs — storage/postgres.py)
+            rows = self._conn.execute(
+                "INSERT INTO pio_meta_channels (name, appid) VALUES (?,?) "
+                "RETURNING id",
                 (channel.name, channel.appid),
             )
         except sqlite3.IntegrityError:
             return None
-        rows = self._conn.execute("SELECT last_insert_rowid()")
         return int(rows[0][0])
 
     def get(self, channel_id: int) -> Channel | None:

@@ -1,0 +1,286 @@
+"""In-process PostgreSQL wire-protocol emulator for backend tests.
+
+Speaks the SERVER side of protocol v3 — SSLRequest refusal, MD5
+password authentication, ParameterStatus/BackendKeyData, the simple
+query cycle with per-statement RowDescription/DataRow/CommandComplete,
+SQLSTATE-carrying ErrorResponses, implicit per-Query transactions —
+against per-database in-memory sqlite (each ``database`` startup
+parameter gets an isolated store, so tests isolate by database name).
+
+This is the test double for storage/postgres.py: zero egress means no
+real PostgreSQL exists here, so what the suite proves is (a) the
+client implements the documented protocol (framing, auth, decode) and
+(b) the full storage conformance surface works end-to-end OVER THAT
+WIRE. docs/storage.md states the residual gap (no cross-validation
+against a real server) plainly. Used only by tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import socket
+import socketserver
+import sqlite3
+import struct
+import threading
+
+_SERIAL = re.compile(r"\bSERIAL PRIMARY KEY\b", re.IGNORECASE)
+_BYTEA = re.compile(r"\bBYTEA\b", re.IGNORECASE)
+_BYTEA_LIT = re.compile(r"'\\x([0-9a-fA-F]*)'::bytea")
+
+
+def _to_sqlite(stmt: str) -> str:
+    stmt = _SERIAL.sub("INTEGER PRIMARY KEY AUTOINCREMENT", stmt)
+    # hex literals first — the bare-word BYTEA rewrite would otherwise
+    # eat the '::bytea' cast suffix
+    stmt = _BYTEA_LIT.sub(lambda m: f"X'{m.group(1)}'", stmt)
+    stmt = _BYTEA.sub("BLOB", stmt)
+    return stmt
+
+
+def _split_statements(sql: str) -> list[str]:
+    """Split on top-level ';' (single-quote aware)."""
+    out, cur, i, n = [], [], 0, len(sql)
+    while i < n:
+        ch = sql[i]
+        if ch == "'":
+            j = i + 1
+            while j < n:
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        j += 2
+                        continue
+                    break
+                j += 1
+            cur.append(sql[i:j + 1])
+            i = j + 1
+        elif ch == ";":
+            out.append("".join(cur))
+            cur = []
+            i += 1
+        else:
+            cur.append(ch)
+            i += 1
+    out.append("".join(cur))
+    return [s.strip() for s in out if s.strip()]
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _error_msg(code: str, message: str) -> bytes:
+    payload = (b"SERROR\x00" + b"C" + code.encode() + b"\x00"
+               + b"M" + message.encode() + b"\x00\x00")
+    return _msg(b"E", payload)
+
+
+def _oid_of(col_values) -> int:
+    for v in col_values:
+        if v is None:
+            continue
+        if isinstance(v, int):
+            return 20          # int8
+        if isinstance(v, float):
+            return 701         # float8
+        if isinstance(v, (bytes, memoryview)):
+            return 17          # bytea
+        return 25              # text
+    return 25
+
+
+def _encode_value(v) -> bytes | None:
+    if v is None:
+        return None
+    if isinstance(v, (bytes, memoryview)):
+        return b"\\x" + bytes(v).hex().encode()
+    if isinstance(v, float):
+        return repr(v).encode()
+    return str(v).encode()
+
+
+class _Databases:
+    """database name -> (shared in-memory sqlite connection, lock)."""
+
+    def __init__(self):
+        self._dbs: dict[str, tuple[sqlite3.Connection, threading.Lock]] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str):
+        with self._lock:
+            if name not in self._dbs:
+                conn = sqlite3.connect(":memory:", check_same_thread=False)
+                self._dbs[name] = (conn, threading.Lock())
+            return self._dbs[name]
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        self._buf = b""
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.request.recv(65536)
+            if not chunk:
+                raise ConnectionError("client closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_startup(self):
+        while True:
+            (length,) = struct.unpack("!I", self._recv_exact(4))
+            payload = self._recv_exact(length - 4)
+            (code,) = struct.unpack("!I", payload[:4])
+            if code == 80877103:              # SSLRequest: not supported
+                self.request.sendall(b"N")
+                continue
+            if code == 80877102:              # CancelRequest
+                raise ConnectionError("cancel")
+            if code != 196608:
+                raise ConnectionError(f"unsupported protocol {code}")
+            params = {}
+            parts = payload[4:].split(b"\x00")
+            for k, v in zip(parts[::2], parts[1::2]):
+                if k:
+                    params[k.decode()] = v.decode()
+            return params
+
+    def _read_message(self):
+        head = self._recv_exact(5)
+        (length,) = struct.unpack("!I", head[1:5])
+        return head[:1], self._recv_exact(length - 4)
+
+    def handle(self):
+        srv: "PGEmulator" = self.server.emulator   # type: ignore[attr-defined]
+        try:
+            params = self._read_startup()
+        except ConnectionError:
+            return
+        user = params.get("user", "")
+        database = params.get("database", user)
+
+        # MD5 challenge (the auth path worth exercising)
+        salt = os.urandom(4)
+        self.request.sendall(_msg(b"R", struct.pack("!I", 5) + salt))
+        try:
+            tag, payload = self._read_message()
+        except ConnectionError:
+            return
+        if tag != b"p":
+            self.request.sendall(_error_msg("08P01", "expected password"))
+            return
+        supplied = payload.rstrip(b"\x00").decode()
+        inner = hashlib.md5(
+            (srv.password + user).encode()).hexdigest()
+        expected = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+        if supplied != expected:
+            self.request.sendall(_error_msg(
+                "28P01", f'password authentication failed for user "{user}"'))
+            return
+        self.request.sendall(_msg(b"R", struct.pack("!I", 0)))
+        for k, v in (("server_version", "15.0 (pio-emulator)"),
+                     ("standard_conforming_strings", "on"),
+                     ("client_encoding", "UTF8")):
+            self.request.sendall(_msg(
+                b"S", k.encode() + b"\x00" + v.encode() + b"\x00"))
+        self.request.sendall(_msg(b"K", struct.pack("!II", 1, 1)))
+        self.request.sendall(_msg(b"Z", b"I"))
+
+        conn, lock = srv.databases.get(database)
+        while True:
+            try:
+                tag, payload = self._read_message()
+            except ConnectionError:
+                return
+            if tag == b"X":
+                return
+            if tag != b"Q":
+                self.request.sendall(_error_msg(
+                    "08P01", f"unsupported message {tag!r}"))
+                self.request.sendall(_msg(b"Z", b"I"))
+                continue
+            sql = payload.rstrip(b"\x00").decode()
+            self._run_query(conn, lock, sql)
+            self.request.sendall(_msg(b"Z", b"I"))
+
+    def _run_query(self, conn, lock, sql: str) -> None:
+        with lock:
+            try:
+                for stmt in _split_statements(sql):
+                    cur = conn.execute(_to_sqlite(stmt))
+                    if cur.description is not None:
+                        rows = cur.fetchall()
+                        self._send_result(cur.description, rows)
+                        tagline = f"SELECT {len(rows)}"
+                    else:
+                        tagline = f"OK {cur.rowcount}"
+                    self.request.sendall(_msg(
+                        b"C", tagline.encode() + b"\x00"))
+                conn.commit()
+            except sqlite3.Error as err:
+                conn.rollback()
+                text = str(err)
+                if "no such table" in text:
+                    code = "42P01"
+                elif isinstance(err, sqlite3.IntegrityError):
+                    code = "23505"
+                else:
+                    code = "XX000"
+                self.request.sendall(_error_msg(code, text))
+
+    def _send_result(self, description, rows) -> None:
+        ncols = len(description)
+        oids = [_oid_of([r[c] for r in rows]) for c in range(ncols)]
+        desc = struct.pack("!H", ncols)
+        for c in range(ncols):
+            name = (description[c][0] or f"col{c}").encode()
+            desc += (name + b"\x00"
+                     + struct.pack("!IHIhih", 0, 0, oids[c], -1, -1, 0))
+        self.request.sendall(_msg(b"T", desc))
+        for row in rows:
+            body = struct.pack("!H", ncols)
+            for v in row:
+                enc = _encode_value(v)
+                if enc is None:
+                    body += struct.pack("!i", -1)
+                else:
+                    body += struct.pack("!i", len(enc)) + enc
+            self.request.sendall(_msg(b"D", body))
+
+
+class PGEmulator:
+    """Threaded emulator; ``with PGEmulator("pw") as emu: emu.port``."""
+
+    def __init__(self, password: str = "pio-test"):
+        self.password = password
+        self.databases = _Databases()
+        self._server: socketserver.ThreadingTCPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.port = 0
+
+    def start(self) -> "PGEmulator":
+        srv = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), _Handler, bind_and_activate=True)
+        srv.daemon_threads = True
+        srv.emulator = self                      # type: ignore[attr-defined]
+        self._server = srv
+        self.port = srv.server_address[1]
+        self._thread = threading.Thread(target=srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def __enter__(self) -> "PGEmulator":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

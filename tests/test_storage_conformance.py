@@ -28,12 +28,39 @@ from predictionio_tpu.storage.sqlite import SQLiteStorageClient
 T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
 
 
-@pytest.fixture(params=["memory", "sqlite", "sqlite_file"])
-def client(request, tmp_path):
+@pytest.fixture(scope="session")
+def pg_emulator():
+    """One wire-protocol emulator for the whole session; tests isolate
+    by database name (pg_emulator.py gives each database its own
+    store)."""
+    from pg_emulator import PGEmulator
+
+    with PGEmulator(password="conf-pw") as emu:
+        yield emu
+
+
+def _pg_client(emu):
+    import uuid
+
+    from predictionio_tpu.storage.postgres import PGStorageClient
+
+    return PGStorageClient(StorageClientConfig(properties={
+        "HOST": "127.0.0.1", "PORT": str(emu.port),
+        "USERNAME": "pio", "PASSWORD": "conf-pw",
+        "DATABASE": f"conf_{uuid.uuid4().hex[:12]}",
+    }))
+
+
+@pytest.fixture(params=["memory", "sqlite", "sqlite_file", "postgres"])
+def client(request, tmp_path, pg_emulator):
     if request.param == "memory":
         c = MemoryStorageClient()
     elif request.param == "sqlite":
         c = SQLiteStorageClient(StorageClientConfig(test=True))
+    elif request.param == "postgres":
+        # the full metadata/model conformance surface over the REAL
+        # wire client (protocol v3 against the in-process emulator)
+        c = _pg_client(pg_emulator)
     else:
         c = SQLiteStorageClient(
             StorageClientConfig(properties={"PATH": str(tmp_path / "pio.sqlite")})
@@ -44,9 +71,9 @@ def client(request, tmp_path):
 
 @pytest.fixture(params=[
     "memory", "sqlite", "sqlite_file", "fileevents",
-    "binevents", "binevents_py",
+    "binevents", "binevents_py", "postgres",
 ])
-def events_client(request, tmp_path):
+def events_client(request, tmp_path, pg_emulator):
     """Event-store conformance adds the events-only fileevents and
     binevents backends (the reference ran the same LEventsSpec against
     hbase). binevents runs twice: native C++ scan path and the
@@ -76,6 +103,8 @@ def events_client(request, tmp_path):
         c = MemoryStorageClient()
     elif request.param == "sqlite":
         c = SQLiteStorageClient(StorageClientConfig(test=True))
+    elif request.param == "postgres":
+        c = _pg_client(pg_emulator)
     else:
         c = SQLiteStorageClient(
             StorageClientConfig(properties={"PATH": str(tmp_path / "pio.sqlite")})
