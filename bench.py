@@ -264,6 +264,10 @@ def bench_phases(users, items, vals):
 
     @partial(jax.jit, static_argnames=("einsum",))
     def half_variant(V, buckets, base, einsum: bool):
+        # gather from the bf16 table, like the default fused path since
+        # r4 (the cast commutes with the row-gather; phase accounting
+        # must walk the same bytes the real kernel walks)
+        Vb = V.astype(jnp.bfloat16)
         tot = jnp.float32(0.0)
         for row_ids, cols, vals_, deg in buckets:
             L = cols.shape[-1]
@@ -272,18 +276,17 @@ def bench_phases(users, items, vals):
                 c, v, d = xs
                 m = (jnp.arange(L, dtype=jnp.int32)[None, :]
                      < d[:, None]).astype(jnp.float32)
-                F = V[c]
+                F = Vb[c]
                 if einsum:
-                    Fm = F * m[..., None]
-                    Ap = jnp.einsum("blk,blm->bkm", Fm.astype(jnp.bfloat16),
-                                    F.astype(jnp.bfloat16),
+                    Fm = F * m[..., None].astype(jnp.bfloat16)
+                    Ap = jnp.einsum("blk,blm->bkm", Fm, F,
                                     preferred_element_type=jnp.float32)
                     bp = jnp.einsum("bl,blk->bk", (v * m).astype(jnp.bfloat16),
-                                    F.astype(jnp.bfloat16),
-                                    preferred_element_type=jnp.float32)
+                                    F, preferred_element_type=jnp.float32)
                     s = jnp.sum(Ap) + jnp.sum(bp)
                 else:
-                    s = jnp.sum(F * m[..., None]) + jnp.sum(v)
+                    s = (jnp.sum(F.astype(jnp.float32) * m[..., None])
+                         + jnp.sum(v))
                 return carry + s, None
 
             tot, _ = jax.lax.scan(body, tot, (cols, vals_, deg))
