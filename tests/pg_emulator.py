@@ -17,7 +17,9 @@ against a real server) plainly. Used only by tests.
 
 from __future__ import annotations
 
+import base64
 import hashlib
+import hmac
 import os
 import re
 import socket
@@ -162,23 +164,14 @@ class _Handler(socketserver.BaseRequestHandler):
         user = params.get("user", "")
         database = params.get("database", user)
 
-        # MD5 challenge (the auth path worth exercising)
-        salt = os.urandom(4)
-        self.request.sendall(_msg(b"R", struct.pack("!I", 5) + salt))
         try:
-            tag, payload = self._read_message()
+            if srv.auth == "scram":
+                ok = self._auth_scram(srv, user)
+            else:
+                ok = self._auth_md5(srv, user)
         except ConnectionError:
             return
-        if tag != b"p":
-            self.request.sendall(_error_msg("08P01", "expected password"))
-            return
-        supplied = payload.rstrip(b"\x00").decode()
-        inner = hashlib.md5(
-            (srv.password + user).encode()).hexdigest()
-        expected = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
-        if supplied != expected:
-            self.request.sendall(_error_msg(
-                "28P01", f'password authentication failed for user "{user}"'))
+        if not ok:
             return
         self.request.sendall(_msg(b"R", struct.pack("!I", 0)))
         for k, v in (("server_version", "15.0 (pio-emulator)"),
@@ -205,6 +198,100 @@ class _Handler(socketserver.BaseRequestHandler):
             sql = payload.rstrip(b"\x00").decode()
             self._run_query(conn, lock, sql)
             self.request.sendall(_msg(b"Z", b"I"))
+
+    def _auth_md5(self, srv, user: str) -> bool:
+        salt = os.urandom(4)
+        self.request.sendall(_msg(b"R", struct.pack("!I", 5) + salt))
+        tag, payload = self._read_message()
+        if tag != b"p":
+            self.request.sendall(_error_msg("08P01", "expected password"))
+            return False
+        supplied = payload.rstrip(b"\x00").decode()
+        inner = hashlib.md5(
+            (srv.password + user).encode()).hexdigest()
+        expected = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+        if supplied != expected:
+            self.request.sendall(_error_msg(
+                "28P01",
+                f'password authentication failed for user "{user}"'))
+            return False
+        return True
+
+    def _auth_scram(self, srv, user: str) -> bool:
+        """Server side of SCRAM-SHA-256 (RFC 5802): verifies the client
+        proof AND emits the server signature (the client checks it).
+        The stored verifier derives from the SASLprep'd password, like
+        real PostgreSQL at CREATE ROLE time."""
+        hmac_mod = hmac
+
+        self.request.sendall(_msg(
+            b"R", struct.pack("!I", 10) + b"SCRAM-SHA-256\x00\x00"))
+        tag, payload = self._read_message()
+        if tag != b"p":
+            self.request.sendall(_error_msg("08P01", "expected SASL init"))
+            return False
+        mech_end = payload.index(b"\x00")
+        if payload[:mech_end] != b"SCRAM-SHA-256":
+            self.request.sendall(_error_msg("28000", "unknown mechanism"))
+            return False
+        (ln,) = struct.unpack("!i", payload[mech_end + 1:mech_end + 5])
+        client_first = payload[mech_end + 5:mech_end + 5 + ln].decode()
+        if not client_first.startswith("n,,"):
+            self.request.sendall(_error_msg("28000", "bad gs2 header"))
+            return False
+        client_first_bare = client_first[3:]
+        cnonce = dict(f.split("=", 1)
+                      for f in client_first_bare.split(","))["r"]
+
+        salt = os.urandom(16)
+        iters = 4096
+        snonce = cnonce + base64.b64encode(os.urandom(12)).decode()
+        server_first = (f"r={snonce},s={base64.b64encode(salt).decode()},"
+                        f"i={iters}")
+        self.request.sendall(_msg(
+            b"R", struct.pack("!I", 11) + server_first.encode()))
+
+        tag, payload = self._read_message()
+        if tag != b"p":
+            self.request.sendall(_error_msg("08P01", "expected SASL resp"))
+            return False
+        client_final = payload.decode()
+        without_proof, proof_b64 = client_final.rsplit(",p=", 1)
+        fields = dict(f.split("=", 1) for f in without_proof.split(","))
+        if fields.get("r") != snonce:
+            self.request.sendall(_error_msg("28000", "nonce mismatch"))
+            return False
+
+        from predictionio_tpu.storage.pgwire import saslprep
+
+        salted = hashlib.pbkdf2_hmac(
+            "sha256", saslprep(srv.password).encode(), salt, iters)
+        client_key = hmac_mod.new(
+            salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        auth_message = ",".join(
+            (client_first_bare, server_first, without_proof)).encode()
+        client_sig = hmac_mod.new(
+            stored_key, auth_message, hashlib.sha256).digest()
+        proof = base64.b64decode(proof_b64)
+        recovered = bytes(a ^ b for a, b in zip(proof, client_sig))
+        if hashlib.sha256(recovered).digest() != stored_key:
+            self.request.sendall(_error_msg(
+                "28P01",
+                f'password authentication failed for user "{user}"'))
+            return False
+        server_key = hmac_mod.new(
+            salted, b"Server Key", hashlib.sha256).digest()
+        server_sig = hmac_mod.new(
+            server_key, auth_message, hashlib.sha256).digest()
+        # tamper hook: lets tests prove the CLIENT rejects a server
+        # that cannot produce the right signature (mutual auth)
+        sig = (srv.tamper_signature if srv.tamper_signature is not None
+               else server_sig)
+        final = "v=" + base64.b64encode(sig).decode()
+        self.request.sendall(_msg(
+            b"R", struct.pack("!I", 12) + final.encode()))
+        return True
 
     def _run_query(self, conn, lock, sql: str) -> None:
         with lock:
@@ -254,8 +341,13 @@ class _Handler(socketserver.BaseRequestHandler):
 class PGEmulator:
     """Threaded emulator; ``with PGEmulator("pw") as emu: emu.port``."""
 
-    def __init__(self, password: str = "pio-test"):
+    def __init__(self, password: str = "pio-test", auth: str = "md5",
+                 tamper_signature: bytes | None = None):
+        if auth not in ("md5", "scram"):
+            raise ValueError(f"auth must be 'md5' or 'scram', got {auth!r}")
         self.password = password
+        self.auth = auth
+        self.tamper_signature = tamper_signature
         self.databases = _Databases()
         self._server: socketserver.ThreadingTCPServer | None = None
         self._thread: threading.Thread | None = None

@@ -277,3 +277,126 @@ def test_close_during_inflight_query_does_not_leak(emulator):
     assert pool._pool.qsize() == 0
     with pytest.raises(sqlite3.ProgrammingError):
         pool.execute("SELECT 1")
+
+
+class TestScramAuth:
+    """SCRAM-SHA-256 (the modern PostgreSQL default,
+    password_encryption=scram-sha-256): success, wrong password, and —
+    the property MD5 lacks — the CLIENT rejecting a server that cannot
+    produce the right server signature (mutual authentication)."""
+
+    def test_scram_session_works_end_to_end(self):
+        with PGEmulator(password="scr@m-pw", auth="scram") as emu:
+            conn = PGConnection("127.0.0.1", emu.port, user="pio",
+                                database="scram_ok", password="scr@m-pw")
+            try:
+                assert conn.execute("SELECT 40 + 2") == [(42,)]
+            finally:
+                conn.close()
+            # and the full storage surface on top of it
+            c = PGStorageClient(StorageClientConfig(properties={
+                "HOST": "127.0.0.1", "PORT": str(emu.port),
+                "USERNAME": "pio", "PASSWORD": "scr@m-pw",
+                "DATABASE": "scram_store"}))
+            try:
+                a_id = c.apps().insert(App(0, "ScramApp"))
+                assert c.apps().get(a_id).name == "ScramApp"
+            finally:
+                c.close()
+
+    def test_scram_wrong_password_rejected(self):
+        with PGEmulator(password="right", auth="scram") as emu:
+            with pytest.raises(PGError) as ei:
+                PGConnection("127.0.0.1", emu.port, user="pio",
+                             database="x", password="wrong")
+            assert ei.value.code == "28P01"
+
+    def test_client_rejects_forged_server_signature(self):
+        """Mutual auth: a MITM that relays the exchange but cannot
+        compute ServerSignature must be rejected BY THE CLIENT."""
+        from predictionio_tpu.storage.pgwire import PGProtocolError
+
+        with PGEmulator(password="pw", auth="scram",
+                        tamper_signature=b"\x00" * 32) as emu:
+            with pytest.raises(PGProtocolError,
+                               match="server signature"):
+                PGConnection("127.0.0.1", emu.port, user="pio",
+                             database="x", password="pw")
+
+
+class TestSaslPrep:
+    def test_normalization_matches_prepared_server_verifier(self):
+        """A password with a non-breaking space (SASLprep maps U+00A0 to
+        space) and a zero-width space (U+200B maps to nothing) must
+        authenticate against a server whose verifier was derived from
+        the PREPARED form — i.e. client and server agree on RFC 4013."""
+        raw = "p ss​word"
+        from predictionio_tpu.storage.pgwire import saslprep
+
+        assert saslprep(raw) == "p ssword"
+        with PGEmulator(password=raw, auth="scram") as emu:
+            conn = PGConnection("127.0.0.1", emu.port, user="pio",
+                                database="prep", password=raw)
+            try:
+                assert conn.execute("SELECT 1") == [(1,)]
+            finally:
+                conn.close()
+
+    def test_prohibited_characters_rejected(self):
+        from predictionio_tpu.storage.pgwire import saslprep
+
+        with pytest.raises(ValueError, match="prohibited"):
+            saslprep("pass\x00word")       # C.2.1 control char
+
+    def test_iteration_count_bounds(self):
+        """A hostile/broken server cannot pin the client on 2^31 PBKDF2
+        rounds or downgrade to a crackable i=1 (round-4 review): the
+        client rejects the iteration count BEFORE doing the work."""
+        import socket as sk
+        import struct as st
+        import threading
+
+        from predictionio_tpu.storage.pgwire import PGProtocolError
+
+        def fake_server(port_holder, iters):
+            def msg(tag, payload):
+                return tag + st.pack("!I", len(payload) + 4) + payload
+
+            s = sk.socket()
+            s.bind(("127.0.0.1", 0))
+            s.listen(1)
+            port_holder.append(s.getsockname()[1])
+            c, _ = s.accept()
+            (ln,) = st.unpack("!I", c.recv(4))
+            c.recv(ln - 4)                          # startup params
+            c.sendall(msg(b"R", st.pack("!I", 10)
+                          + b"SCRAM-SHA-256\x00\x00"))
+            head = c.recv(5)                        # SASLInitialResponse
+            (ln,) = st.unpack("!I", head[1:5])
+            body = c.recv(ln - 4)
+            # client-first is after mech\0 + int32: extract r=<cnonce>
+            mech_end = body.index(b"\x00")
+            client_first = body[mech_end + 5:].decode()
+            cnonce = client_first.split("r=", 1)[1]
+            # extend the client nonce so the nonce check passes and the
+            # ITERATION bound is what trips
+            server_first = (f"r={cnonce}EXT,s=AAAA,i={iters}").encode()
+            c.sendall(msg(b"R", st.pack("!I", 11) + server_first))
+            try:
+                c.recv(65536)
+            except OSError:
+                pass
+            c.close()
+            s.close()
+
+        for iters in (1, 2**31 - 1):
+            holder = []
+            t = threading.Thread(target=fake_server, args=(holder, iters),
+                                 daemon=True)
+            t.start()
+            while not holder:
+                pass
+            with pytest.raises(PGProtocolError, match="iteration count"):
+                PGConnection("127.0.0.1", holder[0], user="pio",
+                             database="x", password="pw")
+            t.join(timeout=5)
