@@ -121,28 +121,37 @@ def _device_peak():
     return kind, _PEAK_BF16.get(kind)
 
 
-def _chain_time(run, n_short=None, n_long=None, reps=REPS):
-    """Per-step times from differential chains.
+def _chain_time_many(runs: dict, n_short=None, n_long=None, reps=REPS):
+    """Differential chains for one or more run variants, INTERLEAVED.
 
-    Returns (robust, per_rep): ``robust`` differences the MIN short and
-    MIN long endpoint across reps — immune to the tunnel's asymmetric
-    multi-second stalls, which can make a single rep's difference
-    negative — and ``per_rep`` keeps the rep-wise differences for the
-    spread report."""
+    Each rep times every variant's short chain, then every variant's
+    long chain, so variants whose numbers will be SUBTRACTED sample the
+    same load conditions (back-to-back variant measurement lets a
+    host-load shift between them turn the difference negative). The
+    per-variant estimate differences the MIN short and MIN long
+    endpoint across reps — immune to the tunnel's asymmetric
+    multi-second stalls. Returns {name: (robust, per_rep)}."""
     n_short = N_SHORT if n_short is None else n_short
     n_long = N_LONG if n_long is None else n_long
-    shorts, longs = [], []
+    times = {name: {"s": [], "l": []} for name in runs}
     for _ in range(reps):
-        t0 = time.perf_counter()
-        run(n_short)
-        shorts.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        run(n_long)
-        longs.append(time.perf_counter() - t0)
+        for n_calls, key in ((n_short, "s"), (n_long, "l")):
+            for name, run in runs.items():
+                t0 = time.perf_counter()
+                run(n_calls)
+                times[name][key].append(time.perf_counter() - t0)
     dn = n_long - n_short
-    robust = (min(longs) - min(shorts)) / dn
-    per_rep = [(tl - ts) / dn for ts, tl in zip(shorts, longs)]
-    return robust, per_rep
+    out = {}
+    for name, t in times.items():
+        robust = (min(t["l"]) - min(t["s"])) / dn
+        per_rep = [(tl - ts) / dn for ts, tl in zip(t["s"], t["l"])]
+        out[name] = (robust, per_rep)
+    return out
+
+
+def _chain_time(run, n_short=None, n_long=None, reps=REPS):
+    """Single-variant differential chain (see :func:`_chain_time_many`)."""
+    return _chain_time_many({"_": run}, n_short, n_long, reps)["_"]
 
 
 # ---------------------------------------------------------------------------
@@ -253,8 +262,9 @@ def bench_als(users, items, vals, reps=REPS):
 
 def bench_phases(users, items, vals):
     """Per-phase decomposition via chain variants on the ladder layout:
-    G = gather+mask only, E = gather+einsums; the full iteration comes
-    from the headline. Feedback keeps chain inputs varying (protocol)."""
+    G = gather + fused reduce (the lightest full consumer), E = gather
+    + mask + normal-equation einsums; the full iteration comes from the
+    headline. Feedback keeps chain inputs varying (protocol)."""
     import jax
     import jax.numpy as jnp
     from functools import partial
@@ -274,10 +284,10 @@ def bench_phases(users, items, vals):
 
             def body(carry, xs):
                 c, v, d = xs
-                m = (jnp.arange(L, dtype=jnp.int32)[None, :]
-                     < d[:, None]).astype(jnp.float32)
                 F = Vb[c]
                 if einsum:
+                    m = (jnp.arange(L, dtype=jnp.int32)[None, :]
+                         < d[:, None]).astype(jnp.float32)
                     Fm = F * m[..., None].astype(jnp.bfloat16)
                     Ap = jnp.einsum("blk,blm->bkm", Fm, F,
                                     preferred_element_type=jnp.float32)
@@ -285,8 +295,12 @@ def bench_phases(users, items, vals):
                                     F, preferred_element_type=jnp.float32)
                     s = jnp.sum(Ap) + jnp.sum(bp)
                 else:
-                    s = (jnp.sum(F.astype(jnp.float32) * m[..., None])
-                         + jnp.sum(v))
+                    # lightest full consumer: a fused reduce with f32
+                    # accumulation. (An earlier f32-cast-then-mask
+                    # consumer materialized an f32 copy of F that the
+                    # einsum variant never pays, making "gather-only"
+                    # measure SLOWER than gather+einsum.)
+                    s = jnp.sum(F, dtype=jnp.float32) + jnp.sum(v)
                 return carry + s, None
 
             tot, _ = jax.lax.scan(body, tot, (cols, vals_, deg))
@@ -300,8 +314,7 @@ def bench_phases(users, items, vals):
     base_i = jax.device_put(jnp.asarray(
         (rng.standard_normal((ITEMS, RANK)) / np.sqrt(RANK)).astype(np.float32)))
 
-    out = {}
-    for name, einsum in (("gather", False), ("einsum", True)):
+    def make_run(einsum):
         def run(n):
             cur = item0
             for _ in range(n):
@@ -309,12 +322,30 @@ def bench_phases(users, items, vals):
                 cur = half_variant(uf, bi, base_i, einsum)
             return float(jnp.sum(jnp.abs(cur)))
 
-        run(1)
-        out[name] = _chain_time(run, reps=3)[0] * 1e3
-    return {
-        "phase_gather_ms": round(out["gather"], 1),
-        "phase_einsum_ms": round(out["einsum"] - out["gather"], 1),
+        return run
+
+    runs = {name: make_run(einsum)
+            for name, einsum in (("gather", False), ("einsum", True))}
+    # interleaved: the einsum number is a DIFFERENCE of the two
+    # variants, so they must sample the same load conditions (observed
+    # otherwise under a concurrently loaded host: gather 194.7, einsum
+    # delta -53.7 — see _chain_time_many)
+    for run in runs.values():
+        run(N_SHORT)
+        run(N_LONG)
+    timed = _chain_time_many(runs, reps=3)
+    gather_s = timed["gather"][0]
+    delta_s = timed["einsum"][0] - gather_s
+    result = {
+        "phase_gather_ms": round(gather_s * 1e3, 1),
+        "phase_einsum_ms": round(delta_s * 1e3, 1),
     }
+    if delta_s < 0:
+        # still possible under violent load shifts; flag rather than
+        # silently report an impossible negative phase (guard on the
+        # RAW difference — round() can hide small negatives as -0.0)
+        result["phase_warning"] = "negative einsum delta (noisy session)"
+    return result
 
 
 RANK200 = 200
