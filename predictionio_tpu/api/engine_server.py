@@ -177,6 +177,8 @@ class EngineService:
         self.plugins = plugin_context or EngineServerPluginContext()
         #: set by the HTTP wrapper; called on authorized POST /stop
         self.on_stop = lambda: None
+        #: set by the HTTP wrapper; mid-request client-disconnect count
+        self.client_disconnects = lambda: 0
 
     # -- auth (KeyAuthentication.withAccessKeyFromFile) ---------------------
     def _check_server_key(self, params: Mapping[str, str]) -> None:
@@ -237,6 +239,7 @@ class EngineService:
             "requestCount": d.request_count,
             "avgServingSec": d.avg_serving_sec,
             "lastServingSec": d.last_serving_sec,
+            "clientDisconnects": self.client_disconnects(),
         }
 
     def status_html(self) -> str:
@@ -447,6 +450,7 @@ class EngineServer(RestServer):
             config.ip, config.port,
         )
         self.service.on_stop = self.stop
+        self.service.client_disconnects = lambda: self.client_disconnects
 
     def _on_bind_failure(self, attempt: int, ip: str, port: int) -> None:
         if attempt == 0 and port:

@@ -10,6 +10,7 @@ background-thread or blocking serve, and clean shutdown.
 from __future__ import annotations
 
 import logging
+import sys
 import threading
 import time
 from http.server import ThreadingHTTPServer
@@ -24,6 +25,25 @@ class _PioHTTPServer(ThreadingHTTPServer):
     # ingest clients batch-fire dozens of posts (confirmed by a 16-thread
     # stress test); match a production accept queue
     request_queue_size = 128
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.client_disconnects = 0
+        self._disconnect_lock = threading.Lock()
+
+    def handle_error(self, request, client_address):
+        # A client that goes away mid-request/response is a non-event in
+        # the serving plane (reference: fire-and-forget discipline,
+        # CreateServer.scala:557-566) — log at debug and count, never
+        # traceback-and-die on the handler thread.
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            with self._disconnect_lock:
+                self.client_disconnects += 1
+            logger.debug("client %s disconnected mid-request: %r",
+                         client_address, exc)
+            return
+        super().handle_error(request, client_address)
 
 
 class RestServer:
@@ -61,6 +81,11 @@ class RestServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    @property
+    def client_disconnects(self) -> int:
+        """How many clients vanished mid-request (never an error)."""
+        return self._httpd.client_disconnects
 
     def start(self) -> None:
         """Serve on a background thread (returns immediately)."""

@@ -35,6 +35,10 @@ def tiny_bench(monkeypatch):
     real_ingest = bench.bench_ingest
     monkeypatch.setattr(bench, "bench_ingest",
                         lambda: real_ingest(n_events=100, batch=25))
+    # keep calibration real but tiny (2048^3 bf16 chains are for the chip)
+    real_calib = bench.bench_calibration
+    monkeypatch.setattr(bench, "bench_calibration",
+                        lambda: real_calib(n=128, rounds=2))
     return bench
 
 
@@ -52,8 +56,11 @@ def test_single_json_line_with_primary_contract(tiny_bench, capsys, monkeypatch)
     # round-over-round comparison keys
     for key in ("stdev_pct", "iter_ms", "padding_x", "p50_ms",
                 "map10_tpu", "seqrec_tokens_per_sec",
-                "ingest_events_per_sec"):
+                "ingest_events_per_sec", "ingest_events_per_sec_stdev_pct",
+                "calibration_matmul_ms"):
         assert key in line, key
+    # a complete artifact says so explicitly (VERDICT r4 weak #7)
+    assert line["sections_failed"] == []
 
 
 def test_section_failure_keeps_primary_metric(tiny_bench, capsys, monkeypatch):
@@ -68,3 +75,5 @@ def test_section_failure_keeps_primary_metric(tiny_bench, capsys, monkeypatch):
     assert line["value"] > 0
     assert "error_quality" in line and "boom" in line["error_quality"]
     assert "map10_tpu" not in line
+    # the hole in the contract is marked at the artifact top level
+    assert line["sections_failed"] == ["quality"]

@@ -354,3 +354,51 @@ def test_wire_bare_tuple_coercion():
     assert q.white_list == ("i1",)
     assert q.black_list == ()
     hash(q)  # frozen dataclass stays hashable
+
+
+class TestClientDisconnect:
+    """A client that vanishes mid-request must be a non-event
+    (CreateServer.scala:557-566 fire-and-forget discipline): no
+    traceback, a bumped counter, and the next query unaffected."""
+
+    @staticmethod
+    def _rst_query(port: int) -> None:
+        """Send a full query, then RST the socket (SO_LINGER 0) so the
+        server's response write — or its next keep-alive read — fails."""
+        import socket
+        import struct
+
+        body = json.dumps({"x": 3}).encode()
+        req = (
+            b"POST /queries.json HTTP/1.1\r\n"
+            b"Host: 127.0.0.1\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+            b"\r\n" + body
+        )
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        try:
+            s.sendall(req)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         struct.pack("ii", 1, 0))
+        finally:
+            s.close()  # linger-0 close sends RST, not FIN
+
+    def test_mid_response_disconnect_is_survivable(self, server, capfd):
+        deadline = time.time() + 20
+        while server.client_disconnects == 0 and time.time() < deadline:
+            self._rst_query(server.port)
+            time.sleep(0.05)
+        assert server.client_disconnects > 0
+
+        # the serving plane is unharmed: next query succeeds and the
+        # status page carries the count
+        status, r = _post(
+            f"http://127.0.0.1:{server.port}/queries.json", {"x": 3}
+        )
+        assert status == 200 and r["value"] == 6
+        _, doc = _get(f"http://127.0.0.1:{server.port}/")
+        assert doc["clientDisconnects"] >= 1
+
+        # and no handler thread printed a traceback
+        assert "Traceback" not in capfd.readouterr().err
