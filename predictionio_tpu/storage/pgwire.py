@@ -183,7 +183,36 @@ class PGConnection:
         self._lock = threading.Lock()
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._buf = b""
-        self._startup(user, database)
+        self.parameters: dict[str, str] = {}   # ParameterStatus reports
+        try:
+            self._startup(user, database)
+        except BaseException:
+            # a rejected startup (bad auth, scs=off, protocol error)
+            # must not leak the socket
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise
+
+    def _param_status(self, payload: bytes) -> None:
+        """Track ParameterStatus ('S') reports. quote_literal assumes
+        standard_conforming_strings=on (doubled quotes, literal
+        backslash); under =off backslashes in user data become escapes
+        — data corruption AND an injection vector (ADVICE r4) — so a
+        server reporting off is rejected outright, at startup or on a
+        mid-session SET."""
+        parts = payload.split(b"\x00")
+        if len(parts) < 2 or not parts[0]:
+            return
+        key = parts[0].decode("utf-8", "replace")
+        val = parts[1].decode("utf-8", "replace")
+        self.parameters[key] = val
+        if key == "standard_conforming_strings" and val != "on":
+            raise PGProtocolError(
+                "server reports standard_conforming_strings=off; this "
+                "client's literal quoting is only safe with it on "
+                "(set standard_conforming_strings=on server-side)")
 
     # -- framing ----------------------------------------------------------
 
@@ -245,7 +274,9 @@ class PGConnection:
                 raise PGProtocolError(
                     f"unsupported authentication request {kind} "
                     "(use scram-sha-256, md5, cleartext or trust)")
-            elif tag in (b"S", b"K", b"N"):            # status/key/notice
+            elif tag == b"S":                          # ParameterStatus
+                self._param_status(payload)
+            elif tag in (b"K", b"N"):                  # key/notice
                 continue
             elif tag == b"Z":                          # ReadyForQuery
                 return
@@ -358,7 +389,9 @@ class PGConnection:
         scan (batch callers bind row-by-row and join)."""
         with self._lock:
             self._send(self._message(b"Q", bound.encode("utf-8") + b"\x00"))
-            rows: list[tuple] = []
+            rows: list[tuple] = []      # current statement's result set
+            last: list[tuple] = []      # last COMPLETED statement's rows
+            saw_rowdesc = False
             oids: list[int] = []
             error: PGError | None = None
             while True:
@@ -374,7 +407,7 @@ class PGConnection:
                             "!I", payload[end + 7:end + 11])
                         oids.append(oid)
                         off = end + 19
-                    rows = []
+                    rows, saw_rowdesc = [], True
                 elif tag == b"D":                      # DataRow
                     (ncols,) = struct.unpack("!H", payload[:2])
                     vals, off = [], 2
@@ -390,14 +423,23 @@ class PGConnection:
                                 payload[off:off + ln]))
                             off += ln
                     rows.append(tuple(vals))
-                elif tag in (b"C", b"I", b"N", b"S"):   # complete/empty/…
+                elif tag in (b"C", b"I"):     # CommandComplete/EmptyQuery
+                    # per-statement result boundary: only a statement
+                    # that produced a RowDescription contributes rows,
+                    # so a trailing row-less statement yields [] rather
+                    # than an earlier SELECT's leftovers (ADVICE r4)
+                    last = rows if saw_rowdesc else []
+                    rows, saw_rowdesc = [], False
+                elif tag == b"S":                      # ParameterStatus
+                    self._param_status(payload)
+                elif tag == b"N":                      # NoticeResponse
                     continue
                 elif tag == b"E":
                     error = self._error(payload)       # Z still follows
                 elif tag == b"Z":                      # ReadyForQuery
                     if error is not None:
                         raise error
-                    return rows
+                    return last
                 else:
                     raise PGProtocolError(
                         f"unexpected message {tag!r} in query cycle")

@@ -41,6 +41,13 @@ _OR_REPLACE = re.compile(
     r"^\s*INSERT\s+OR\s+REPLACE\s+INTO\s+(\S+)\s*\(([^)]*)\)\s*(.*)$",
     re.IGNORECASE | re.DOTALL,
 )
+# explicit-id inserts into the SERIAL tables desync the sequence on
+# real PostgreSQL (a later auto-id insert then collides — ADVICE r4);
+# detect them so execute() can re-sync with setval on the same session
+_EXPLICIT_SERIAL_ID = re.compile(
+    r"^\s*INSERT\s+INTO\s+(pio_meta_apps|pio_meta_channels)\s*\(\s*id\b",
+    re.IGNORECASE,
+)
 
 
 def translate_sql(sql: str) -> str:
@@ -77,6 +84,7 @@ class _PGPool:
     interface (execute/executemany/close) the DAO classes consume."""
 
     POOL_SIZE = 4
+    BORROW_TIMEOUT = 60.0
 
     def __init__(self, host: str, port: int, user: str,
                  password: str | None, database: str):
@@ -109,7 +117,14 @@ class _PGPool:
                 with self._lock:
                     self._created -= 1
                 raise
-        return self._pool.get(timeout=60)
+        try:
+            return self._pool.get(timeout=self.BORROW_TIMEOUT)
+        except queue.Empty:
+            # surface exhaustion through the backend's documented
+            # exception contract, not a bare queue.Empty
+            raise sqlite3.OperationalError(
+                f"connection pool exhausted ({self.POOL_SIZE} connections "
+                f"busy for {self.BORROW_TIMEOUT}s)") from None
 
     def _drop(self, conn) -> None:
         with self._lock:
@@ -147,8 +162,29 @@ class _PGPool:
         return out
 
     def execute(self, sql: str, params: tuple = ()) -> list[tuple]:
-        return self._run(
-            lambda c: c.execute(translate_sql(sql), tuple(params)))
+        sql_t = translate_sql(sql)
+        m = _EXPLICIT_SERIAL_ID.match(sql_t)
+
+        def run(c):
+            out = c.execute(sql_t, tuple(params))
+            if m:
+                # re-sync the sequence past the explicitly inserted id
+                # so the next auto-id insert cannot collide (skipped on
+                # failure: an exception above bypasses this). GREATEST
+                # against nextval keeps the re-sync MONOTONIC: a plain
+                # setval(MAX(id)) could move the sequence backward past
+                # ids a concurrent uncommitted auto-insert already drew
+                # (its row is not visible to MAX), recreating the
+                # collision; nextval always reads >= the current value
+                # (one burned id, harmless)
+                t = m.group(1)
+                c.execute(
+                    f"SELECT setval(pg_get_serial_sequence('{t}', 'id'), "
+                    f"GREATEST((SELECT COALESCE(MAX(id), 1) FROM {t}), "
+                    f"nextval(pg_get_serial_sequence('{t}', 'id'))))")
+            return out
+
+        return self._run(run)
 
     def executemany(self, sql: str, seq) -> None:
         sql_t = translate_sql(sql)

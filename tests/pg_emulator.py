@@ -31,6 +31,23 @@ import threading
 _SERIAL = re.compile(r"\bSERIAL PRIMARY KEY\b", re.IGNORECASE)
 _BYTEA = re.compile(r"\bBYTEA\b", re.IGNORECASE)
 _BYTEA_LIT = re.compile(r"'\\x([0-9a-fA-F]*)'::bytea")
+# sequence-semantics plumbing (see _SerialState): which CREATE TABLE
+# declares a serial column, INSERTs into such tables, and the
+# setval(pg_get_serial_sequence(...)) form the client issues
+_CREATE_SERIAL = re.compile(
+    r"CREATE\s+TABLE\s+(?:IF\s+NOT\s+EXISTS\s+)?(\w+)\s*\(\s*(\w+)\s+"
+    r"SERIAL\s+PRIMARY\s+KEY", re.IGNORECASE | re.DOTALL)
+_INSERT = re.compile(
+    r"^(INSERT\s+INTO\s+(\w+)\s*\(([^)]*)\)\s*VALUES\s*\()",
+    re.IGNORECASE | re.DOTALL)
+_SETVAL = re.compile(
+    r"^SELECT\s+setval\s*\(\s*pg_get_serial_sequence\s*\(\s*"
+    r"'(\w+)'\s*,\s*'(\w+)'\s*\)\s*,\s*(.*)\)\s*$",
+    re.IGNORECASE | re.DOTALL)
+_NEXTVAL = re.compile(
+    r"nextval\s*\(\s*pg_get_serial_sequence\s*\(\s*"
+    r"'(\w+)'\s*,\s*'(\w+)'\s*\)\s*\)",
+    re.IGNORECASE)
 
 
 def _to_sqlite(stmt: str) -> str:
@@ -40,6 +57,86 @@ def _to_sqlite(stmt: str) -> str:
     stmt = _BYTEA_LIT.sub(lambda m: f"X'{m.group(1)}'", stmt)
     stmt = _BYTEA.sub("BLOB", stmt)
     return stmt
+
+
+class _SerialState:
+    """Faithful PostgreSQL SERIAL semantics per database.
+
+    sqlite's AUTOINCREMENT allocates max(id)+1 and is advanced by
+    EXPLICIT id inserts too — which hides the real-PostgreSQL failure
+    mode where an explicit-id insert leaves the sequence behind and a
+    later auto-id insert collides (ADVICE r4). So the emulator keeps
+    its own per-table counters with PostgreSQL's rules: auto-id
+    inserts draw nextval (counter, not table contents); explicit-id
+    inserts do NOT advance it; setval() sets it."""
+
+    def __init__(self):
+        self.columns: dict[str, str] = {}   # table -> serial column
+        self.next: dict[str, int] = {}      # table -> last value handed out
+
+    def observe_create(self, stmt: str) -> None:
+        m = _CREATE_SERIAL.search(stmt)
+        if m:
+            table, col = m.group(1).lower(), m.group(2).lower()
+            self.columns[table] = col
+            self.next.setdefault(table, 0)
+
+    def rewrite_insert(self, stmt: str) -> str:
+        """Inject nextval into auto-id inserts; leave explicit ones
+        (and their sequence) alone."""
+        m = _INSERT.match(stmt)
+        if not m:
+            return stmt
+        table = m.group(2).lower()
+        col = self.columns.get(table)
+        if col is None:
+            return stmt
+        cols = [c.strip().lower() for c in m.group(3).split(",")]
+        if col in cols:
+            return stmt                     # explicit id: seq untouched
+        self.next[table] += 1
+        head = m.group(1)
+        head_new = head.replace("(" + m.group(3), f"({col}, " + m.group(3),
+                                1)
+        return (head_new + f"{self.next[table]}, "
+                + stmt[len(head):])
+
+    def setval(self, conn, stmt: str):
+        """Handle SELECT setval(pg_get_serial_sequence('t','c'), expr)
+        → evaluates expr against sqlite, sets the counter, returns the
+        value (like PostgreSQL). ``nextval(pg_get_serial_sequence(...))``
+        inside the expr draws from (and advances) the counter, and
+        GREATEST maps to sqlite's scalar MAX. Returns None if stmt is
+        not setval."""
+        m = _SETVAL.match(stmt.strip())
+        if not m:
+            return None
+        table, col, expr = m.group(1).lower(), m.group(2).lower(), m.group(3)
+        if self.columns.get(table) != col:
+            raise sqlite3.OperationalError(
+                f"no serial sequence for {table}.{col}")
+        is_called = True
+        expr = expr.strip()
+        for suffix, flag in ((", true", True), (", false", False)):
+            if expr.lower().endswith(suffix):
+                expr, is_called = expr[: -len(suffix)], flag
+                break
+
+        def draw_nextval(nm):
+            t2, c2 = nm.group(1).lower(), nm.group(2).lower()
+            if self.columns.get(t2) != c2:
+                raise sqlite3.OperationalError(
+                    f"no serial sequence for {t2}.{c2}")
+            self.next[t2] += 1
+            return str(self.next[t2])
+
+        expr = _NEXTVAL.sub(draw_nextval, expr)
+        expr = re.sub(r"\bGREATEST\b", "MAX", expr, flags=re.IGNORECASE)
+        (val,) = conn.execute(f"SELECT {_to_sqlite(expr)}").fetchone()
+        val = int(val)
+        # is_called=true: nextval returns val+1; false: returns val
+        self.next[table] = val if is_called else val - 1
+        return val
 
 
 def _split_statements(sql: str) -> list[str]:
@@ -104,17 +201,20 @@ def _encode_value(v) -> bytes | None:
 
 
 class _Databases:
-    """database name -> (shared in-memory sqlite connection, lock)."""
+    """database name -> (shared in-memory sqlite connection, lock,
+    serial-sequence state)."""
 
     def __init__(self):
-        self._dbs: dict[str, tuple[sqlite3.Connection, threading.Lock]] = {}
+        self._dbs: dict[
+            str, tuple[sqlite3.Connection, threading.Lock, _SerialState]
+        ] = {}
         self._lock = threading.Lock()
 
     def get(self, name: str):
         with self._lock:
             if name not in self._dbs:
                 conn = sqlite3.connect(":memory:", check_same_thread=False)
-                self._dbs[name] = (conn, threading.Lock())
+                self._dbs[name] = (conn, threading.Lock(), _SerialState())
             return self._dbs[name]
 
 
@@ -175,14 +275,15 @@ class _Handler(socketserver.BaseRequestHandler):
             return
         self.request.sendall(_msg(b"R", struct.pack("!I", 0)))
         for k, v in (("server_version", "15.0 (pio-emulator)"),
-                     ("standard_conforming_strings", "on"),
+                     ("standard_conforming_strings",
+                      srv.standard_conforming_strings),
                      ("client_encoding", "UTF8")):
             self.request.sendall(_msg(
                 b"S", k.encode() + b"\x00" + v.encode() + b"\x00"))
         self.request.sendall(_msg(b"K", struct.pack("!II", 1, 1)))
         self.request.sendall(_msg(b"Z", b"I"))
 
-        conn, lock = srv.databases.get(database)
+        conn, lock, serial = srv.databases.get(database)
         while True:
             try:
                 tag, payload = self._read_message()
@@ -196,7 +297,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 self.request.sendall(_msg(b"Z", b"I"))
                 continue
             sql = payload.rstrip(b"\x00").decode()
-            self._run_query(conn, lock, sql)
+            self._run_query(conn, lock, serial, sql)
             self.request.sendall(_msg(b"Z", b"I"))
 
     def _auth_md5(self, srv, user: str) -> bool:
@@ -293,10 +394,18 @@ class _Handler(socketserver.BaseRequestHandler):
             b"R", struct.pack("!I", 12) + final.encode()))
         return True
 
-    def _run_query(self, conn, lock, sql: str) -> None:
+    def _run_query(self, conn, lock, serial: _SerialState,
+                   sql: str) -> None:
         with lock:
             try:
                 for stmt in _split_statements(sql):
+                    val = serial.setval(conn, stmt)
+                    if val is not None:
+                        self._send_result((("setval",),), [(val,)])
+                        self.request.sendall(_msg(b"C", b"SELECT 1\x00"))
+                        continue
+                    serial.observe_create(stmt)
+                    stmt = serial.rewrite_insert(stmt)
                     cur = conn.execute(_to_sqlite(stmt))
                     if cur.description is not None:
                         rows = cur.fetchall()
@@ -342,12 +451,15 @@ class PGEmulator:
     """Threaded emulator; ``with PGEmulator("pw") as emu: emu.port``."""
 
     def __init__(self, password: str = "pio-test", auth: str = "md5",
-                 tamper_signature: bytes | None = None):
+                 tamper_signature: bytes | None = None,
+                 standard_conforming_strings: str = "on"):
         if auth not in ("md5", "scram"):
             raise ValueError(f"auth must be 'md5' or 'scram', got {auth!r}")
         self.password = password
         self.auth = auth
         self.tamper_signature = tamper_signature
+        # lets tests prove the client REJECTS the legacy unsafe setting
+        self.standard_conforming_strings = standard_conforming_strings
         self.databases = _Databases()
         self._server: socketserver.ThreadingTCPServer | None = None
         self._thread: threading.Thread | None = None

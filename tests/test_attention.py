@@ -159,10 +159,11 @@ class TestBlockwiseAttention:
 
 
 class TestPallasFlashAttention:
-    """Pallas flash kernel — force-only since the round-3 re-measurement
-    (ops/pallas_attention docstring: XLA wins at every serving shape);
-    on the CPU test backend force=True exercises it in interpret
-    mode."""
+    """Pallas flash kernel — auto-dispatched for causal compiled-mode
+    calls in the measured 2048<=S<=16384 envelope since the round-5
+    causal-KV-skip + tile-sweep pass (ops/pallas_attention docstring
+    has the A/B table); on the CPU test backend force=True exercises
+    it in interpret mode."""
 
     def test_matches_full_attention(self):
         from predictionio_tpu.ops.pallas_attention import flash_attention
@@ -189,11 +190,11 @@ class TestPallasFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
                                    atol=1e-6, rtol=1e-6)
 
-    def test_auto_dispatch_disabled_force_routes(self, monkeypatch):
-        """Auto-dispatch is OFF (round-3 envelope re-measurement): the
-        kernel must never engage unforced, even in compiled mode at the
-        depths the round-2 envelope would have claimed; force=True
-        routes to the kernel inside its buildable range (mode and
+    def test_auto_dispatch_causal_envelope(self, monkeypatch):
+        """r5 dispatch rules: unforced compiled-mode calls engage the
+        kernel ONLY for causal attention inside the measured
+        2048<=S<=16384 envelope; non-causal and out-of-envelope depths
+        fall back; force=True routes anywhere buildable (mode and
         kernel stubbed — no TPU in CI; the point is routing)."""
         from predictionio_tpu.ops import pallas_attention as pa
 
@@ -201,17 +202,24 @@ class TestPallasFlashAttention:
         monkeypatch.setattr(pa, "_mode", lambda: "compiled")
         monkeypatch.setattr(
             pa, "_flash_call",
-            lambda q, k, v, m, causal, interp: calls.append(q.shape) or q,
+            lambda q, k, v, m, causal, interp, *t: calls.append(q.shape) or q,
         )
         # stub the fallback too: at these sizes the real full_attention
         # would materialize (S, S) logits (~4 GB at 32768)
         monkeypatch.setattr(pa, "full_attention",
                             lambda q, k, v, **kw: q)
-        for S in (1024, 2048, 16384, 32768):
+        for S, causal, expect in (
+            (1024, True, 0),      # below the envelope
+            (2048, True, 1),      # measured win
+            (4096, True, 1),
+            (16384, True, 1),     # envelope top
+            (32768, True, 0),     # beyond VMEM-resident K/V
+            (4096, False, 0),     # non-causal: the KV-skip win is causal-only
+        ):
             calls.clear()
             q = jnp.zeros((1, 1, S, 8), jnp.float32)
-            pa.flash_attention(q, q, q, causal=True)
-            assert len(calls) == 0, S
+            pa.flash_attention(q, q, q, causal=causal)
+            assert len(calls) == expect, (S, causal)
         for S, expect in ((2048, 1), (16384, 1)):
             calls.clear()
             q = jnp.zeros((1, 1, S, 8), jnp.float32)

@@ -16,6 +16,7 @@ import pytest
 
 from predictionio_tpu.storage.base import (
     App,
+    Channel,
     EventFilter,
     Model,
     StorageClientConfig,
@@ -400,3 +401,110 @@ class TestSaslPrep:
                 PGConnection("127.0.0.1", holder[0], user="pio",
                              database="x", password="pw")
             t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# ADVICE r4 regressions: serial-sequence re-sync, scs pin, per-statement
+# results, pool-exhaustion contract
+# ---------------------------------------------------------------------------
+
+
+class TestSerialSequenceSync:
+    def test_auto_id_after_explicit_id_insert(self, emulator):
+        """On real PostgreSQL an explicit-id insert leaves the SERIAL
+        sequence behind; the backend must setval past it or the next
+        auto-id insert collides and returns None (ADVICE r4 medium).
+        The emulator models PostgreSQL's sequence rules, so without
+        the client-side re-sync this test fails."""
+        client = _client(emulator)
+        apps = client.apps()
+        assert apps.insert(App(7, "explicit")) == 7
+        for i, name in enumerate(("a", "b", "c")):
+            new_id = apps.insert(App(0, name))
+            assert new_id is not None, f"auto-id insert {i} collided"
+            assert new_id > 7
+        client.close()
+
+    def test_channels_explicit_then_auto(self, emulator):
+        client = _client(emulator)
+        channels = client.channels()
+        assert channels.insert(Channel(5, "pinned", 1)) == 5
+        got = channels.insert(Channel(0, "auto", 1))
+        assert got is not None and got > 5
+        client.close()
+
+    def test_emulator_is_faithful_without_the_fix(self, emulator):
+        """Meta-test: the raw wire path (no setval) DOES collide — the
+        emulator reproduces the PostgreSQL failure mode, so the
+        conformance suite can detect this bug class."""
+        conn = PGConnection("127.0.0.1", emulator.port, user="pio",
+                            database=f"raw_{uuid.uuid4().hex[:8]}",
+                            password="s3cret")
+        try:
+            conn.execute("CREATE TABLE t (id SERIAL PRIMARY KEY, "
+                         "name TEXT UNIQUE)")
+            conn.execute("INSERT INTO t (id, name) VALUES (1, 'explicit')")
+            with pytest.raises(PGError) as ei:
+                conn.execute("INSERT INTO t (name) VALUES ('auto')")
+            assert ei.value.code.startswith("23")
+        finally:
+            conn.close()
+
+
+class TestParameterStatus:
+    def test_scs_off_is_rejected_at_startup(self):
+        from predictionio_tpu.storage.pgwire import PGProtocolError
+
+        with PGEmulator(password="pw",
+                        standard_conforming_strings="off") as emu:
+            with pytest.raises(PGProtocolError,
+                               match="standard_conforming_strings"):
+                PGConnection("127.0.0.1", emu.port, user="pio",
+                             database="x", password="pw")
+
+    def test_parameters_are_recorded(self, emulator):
+        conn = PGConnection("127.0.0.1", emulator.port, user="pio",
+                            database="ps_t", password="s3cret")
+        try:
+            assert conn.parameters["standard_conforming_strings"] == "on"
+        finally:
+            conn.close()
+
+
+class TestPerStatementResults:
+    def test_trailing_rowless_statement_returns_empty(self, emulator):
+        """'SELECT ...; INSERT ...' must NOT return the SELECT's rows
+        (ADVICE r4 low: rows was only reset on RowDescription)."""
+        conn = PGConnection("127.0.0.1", emulator.port, user="pio",
+                            database=f"ls_{uuid.uuid4().hex[:8]}",
+                            password="s3cret")
+        try:
+            conn.execute("CREATE TABLE t (i INTEGER)")
+            rows = conn.execute(
+                "INSERT INTO t VALUES (1); SELECT i FROM t; "
+                "INSERT INTO t VALUES (2)")
+            assert rows == []
+            # and the last-result-set contract still holds
+            assert conn.execute("SELECT COUNT(*) FROM t") == [(2,)]
+        finally:
+            conn.close()
+
+
+class TestPoolExhaustion:
+    def test_exhaustion_raises_operational_error(self, emulator):
+        import sqlite3 as sq3
+
+        from predictionio_tpu.storage.postgres import _PGPool
+
+        pool = _PGPool("127.0.0.1", emulator.port, "pio", "s3cret",
+                       f"px_{uuid.uuid4().hex[:8]}")
+        pool.BORROW_TIMEOUT = 0.2
+        held = [pool._borrow() for _ in range(pool.POOL_SIZE)]
+        try:
+            with pytest.raises(sq3.OperationalError,
+                               match="connection pool exhausted"):
+                pool.execute("SELECT 1")
+        finally:
+            for c in held:
+                pool._give_back(c)
+            pool.close()
