@@ -146,6 +146,11 @@ def _flash_call(q, k, v, kv_mask, causal: bool, interpret: bool,
     big = S >= _TILE_BIG_FROM and S % _TILE_BIG == 0
     tile_q = min(tile_q_ or (_TILE_BIG if big else _TILE_Q), S)
     tile_k = min(tile_k_ or (_TILE_BIG if big else _TILE_K), S)
+    if S % tile_q or S % tile_k:
+        # an explicit override must never silently truncate the grid
+        # (grid = S // tile_q drops trailing query tiles otherwise)
+        raise ValueError(
+            f"S={S} not divisible by tiles ({tile_q}, {tile_k})")
     grid = (bh, S // tile_q)
     kernel = functools.partial(
         _flash_kernel, causal=causal, seq_len=S, tile_k=tile_k)

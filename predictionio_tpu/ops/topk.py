@@ -216,9 +216,6 @@ def recommend_topk_sharded(
     a second ``top_k`` picks the global winners in global item
     coordinates. Per-device traffic is O(B_local * n_model * k), the
     classic distributed top-k merge; ICI carries only candidates."""
-    from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
-
     I = item_f.shape[0]
     n_model = int(mesh.shape["model"])
     if I % n_model:
@@ -234,8 +231,8 @@ def _sharded_topk_fn(mesh, k: int, shard_rows: int):
     """Cached jitted shard_map program — jit caches by function
     identity, so rebuilding the closure per call would retrace and
     recompile the eval hot path on every invocation."""
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
     def local(uv, itf, sc, sm, al):
         start = jax.lax.axis_index("model") * shard_rows

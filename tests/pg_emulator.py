@@ -48,6 +48,8 @@ _NEXTVAL = re.compile(
     r"nextval\s*\(\s*pg_get_serial_sequence\s*\(\s*"
     r"'(\w+)'\s*,\s*'(\w+)'\s*\)\s*\)",
     re.IGNORECASE)
+_DROP_TABLE = re.compile(
+    r"DROP\s+TABLE\s+(?:IF\s+EXISTS\s+)?(\w+)", re.IGNORECASE)
 
 
 def _to_sqlite(stmt: str) -> str:
@@ -80,6 +82,13 @@ class _SerialState:
             table, col = m.group(1).lower(), m.group(2).lower()
             self.columns[table] = col
             self.next.setdefault(table, 0)
+        d = _DROP_TABLE.match(stmt.strip())
+        if d:
+            # DROP TABLE drops the owned sequence on real PostgreSQL —
+            # a recreate starts over at 1
+            t = d.group(1).lower()
+            self.columns.pop(t, None)
+            self.next.pop(t, None)
 
     def rewrite_insert(self, stmt: str) -> str:
         """Inject nextval into auto-id inserts; leave explicit ones

@@ -1,0 +1,223 @@
+"""One-command LIVE-service conformance (VERDICT r4 next #7).
+
+The in-tree suites prove the networked clients against wire-faithful
+fakes (tests/pg_emulator.py, the fake ES/S3 servers) because this
+environment has zero egress. When real services ARE reachable, this
+module points the SAME conformance spec at them — the reference's
+model exactly (one spec, live dockerized stores;
+reference tests/docker-compose.yml:3-40, storage/jdbc/src/test/...).
+
+Configure with env vars and run ``tests/live_backends.sh`` (or
+``pytest tests/test_live_backends.py -v``):
+
+- PostgreSQL: ``PIO_TEST_LIVE_PG_HOST``, ``_PORT`` (5432),
+  ``_USERNAME`` (pio), ``_PASSWORD``, ``_DATABASE`` (pio)
+- Elasticsearch 5.x: ``PIO_TEST_LIVE_ES_URL`` (e.g. http://host:9200)
+- S3/MinIO: ``PIO_TEST_LIVE_S3_ENDPOINT``, ``_BUCKET``,
+  ``_ACCESS_KEY``, ``_SECRET_KEY``, ``_REGION`` (us-east-1)
+
+Unconfigured or unreachable services SKIP cleanly — the module is
+always collected, so CI without services stays green and a laptop with
+docker-compose up gets real-service validation with one command. The
+suite is validated in-tree by pointing the PG path at the emulator as
+a stand-in live endpoint (``test_live_script_against_pg_emulator``
+below drives the script itself that way).
+
+WARNING: the suite creates and deletes tables/indexes/objects with
+``pio_``-prefixed names — point it at scratch databases only.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import uuid
+
+import pytest
+
+from predictionio_tpu.storage.base import StorageClientConfig
+
+# the one spec, re-exported — pytest resolves this module's fixtures
+from test_storage_conformance import (  # noqa: F401
+    TestAccessKeys,
+    TestApps,
+    TestChannels,
+    TestEngineInstances,
+    TestEvaluationInstances,
+    TestEvents,
+    TestModels,
+)
+
+
+def _reachable(host: str, port: int, timeout: float = 3.0) -> bool:
+    try:
+        with socket.create_connection((host, port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def _pg_config() -> dict | None:
+    host = os.environ.get("PIO_TEST_LIVE_PG_HOST")
+    if not host:
+        return None
+    return {
+        "HOST": host,
+        "PORT": os.environ.get("PIO_TEST_LIVE_PG_PORT", "5432"),
+        "USERNAME": os.environ.get("PIO_TEST_LIVE_PG_USERNAME", "pio"),
+        "PASSWORD": os.environ.get("PIO_TEST_LIVE_PG_PASSWORD"),
+        "DATABASE": os.environ.get("PIO_TEST_LIVE_PG_DATABASE", "pio"),
+    }
+
+
+def _es_url() -> str | None:
+    return os.environ.get("PIO_TEST_LIVE_ES_URL")
+
+
+def _s3_config() -> dict | None:
+    endpoint = os.environ.get("PIO_TEST_LIVE_S3_ENDPOINT")
+    if not endpoint:
+        return None
+    return {
+        "ENDPOINT": endpoint,
+        "BUCKET_NAME": os.environ.get("PIO_TEST_LIVE_S3_BUCKET", "pio-test"),
+        "ACCESS_KEY_ID": os.environ.get("PIO_TEST_LIVE_S3_ACCESS_KEY", ""),
+        "SECRET_ACCESS_KEY": os.environ.get(
+            "PIO_TEST_LIVE_S3_SECRET_KEY", ""),
+        "REGION": os.environ.get("PIO_TEST_LIVE_S3_REGION", "us-east-1"),
+        "BASE_PATH": f"pio-live-{uuid.uuid4().hex[:8]}",
+    }
+
+
+def _skip_unless(cond: bool, reason: str) -> None:
+    if not cond:
+        pytest.skip(reason)
+
+
+#: every table the SQL DAO layer creates (closed set; event tables are
+#: per-(app, channel) — conformance tests stay within small ids)
+_PG_TABLES = (
+    "pio_meta_apps", "pio_meta_accesskeys", "pio_meta_channels",
+    "pio_meta_engineinstances", "pio_meta_evaluationinstances",
+    "pio_model_data",
+    *[f"pio_event_{a}" for a in range(1, 33)],
+    *[f"pio_event_{a}_{c}" for a in range(1, 9) for c in range(1, 9)],
+)
+
+
+def _live_pg_client():
+    cfg = _pg_config()
+    _skip_unless(cfg is not None,
+                 "live postgres not configured (PIO_TEST_LIVE_PG_HOST)")
+    _skip_unless(_reachable(cfg["HOST"], int(cfg["PORT"])),
+                 f"live postgres unreachable at {cfg['HOST']}:{cfg['PORT']}")
+    from predictionio_tpu.storage.postgres import PGStorageClient
+
+    client = PGStorageClient(StorageClientConfig(properties=dict(cfg)))
+    # the conformance spec assumes a FRESH store per test (the in-tree
+    # params get one); a live database persists across tests — reset it
+    for t in _PG_TABLES:
+        client._conn.execute(f"DROP TABLE IF EXISTS {t}")
+    return client
+
+
+def _live_es_client():
+    url = _es_url()
+    _skip_unless(url is not None,
+                 "live elasticsearch not configured (PIO_TEST_LIVE_ES_URL)")
+    from urllib.parse import urlparse
+
+    u = urlparse(url)
+    _skip_unless(_reachable(u.hostname, u.port or 9200),
+                 f"live elasticsearch unreachable at {url}")
+    from predictionio_tpu.storage.elasticsearch import ESStorageClient
+
+    # isolate per run via the INDEX prefix (every index the client
+    # creates is "<INDEX>_..."-named)
+    return ESStorageClient(StorageClientConfig(properties={
+        "HOSTS": u.hostname,
+        "PORTS": str(u.port or 9200),
+        "SCHEMES": u.scheme or "http",
+        "INDEX": f"pio_live_{uuid.uuid4().hex[:8]}",
+    }))
+
+
+@pytest.fixture(params=["postgres_live", "elasticsearch_live"])
+def client(request):
+    c = (_live_pg_client() if request.param == "postgres_live"
+         else _live_es_client())
+    yield c
+    c.close()
+
+
+@pytest.fixture(params=["postgres_live", "elasticsearch_live"])
+def events_client(request):
+    c = (_live_pg_client() if request.param == "postgres_live"
+         else _live_es_client())
+    yield c
+    c.close()
+
+
+class TestLiveS3Models:
+    """Model-repository CRUD against a live S3/MinIO endpoint (the only
+    repository the s3 backend implements, like the reference's
+    S3Models.scala:36-95)."""
+
+    def test_model_roundtrip(self):
+        cfg = _s3_config()
+        _skip_unless(cfg is not None,
+                     "live s3 not configured (PIO_TEST_LIVE_S3_ENDPOINT)")
+        from urllib.parse import urlparse
+
+        u = urlparse(cfg["ENDPOINT"])
+        _skip_unless(
+            _reachable(u.hostname, u.port or (443 if u.scheme == "https"
+                                              else 80)),
+            f"live s3 unreachable at {cfg['ENDPOINT']}")
+        from predictionio_tpu.storage.base import Model
+        from predictionio_tpu.storage.s3 import S3StorageClient
+
+        client = S3StorageClient(StorageClientConfig(properties=dict(cfg)))
+        try:
+            models = client.models()
+            mid = f"live-{uuid.uuid4().hex[:12]}"
+            blob = os.urandom(4096)
+            models.insert(Model(id=mid, models=blob))
+            got = models.get(mid)
+            assert got is not None and bytes(got.models) == blob
+            models.delete(mid)
+            assert models.get(mid) is None
+        finally:
+            client.close()
+
+
+def test_live_script_against_pg_emulator(tmp_path):
+    """The one-command path, validated in-tree: live_backends.sh with
+    the PG env pointed at the wire emulator (a stand-in live endpoint)
+    must run the postgres_live conformance params to PASS — proving the
+    script + fixtures work end-to-end before anyone points them at a
+    genuine server."""
+    import subprocess
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from pg_emulator import PGEmulator
+
+    with PGEmulator(password="live-pw", auth="scram") as emu:
+        env = dict(os.environ)
+        env.update({
+            "PIO_TEST_LIVE_PG_HOST": "127.0.0.1",
+            "PIO_TEST_LIVE_PG_PORT": str(emu.port),
+            "PIO_TEST_LIVE_PG_PASSWORD": "live-pw",
+            "PIO_TEST_LIVE_PG_DATABASE": f"live_{uuid.uuid4().hex[:8]}",
+        })
+        out = subprocess.run(
+            ["bash", os.path.join(os.path.dirname(__file__),
+                                  "live_backends.sh"),
+             "-x", "-k", "postgres_live", "-p", "no:cacheprovider"],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    # the live params actually RAN (not skipped): the summary line
+    # reports passes and the es/s3 skips
+    assert " passed" in out.stdout, out.stdout[-1500:]

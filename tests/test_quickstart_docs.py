@@ -1,0 +1,59 @@
+"""Per-template quickstart docs are EXECUTED, not trusted (VERDICT r4
+next #8): every ```bash block of each walk-through runs verbatim, in
+order, in one shell — the same contract the reference's manual template
+guides promised and its integration harness checked
+(tests/pio_tests/scenarios/quickstart_test.py).
+
+Each doc isolates its own storage (PIO_FS_BASEDIR=mktemp) and uses
+distinct ports, so the four docs can run in any order.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOCS = (
+    "quickstart-recommendation.md",
+    "quickstart-classification.md",
+    "quickstart-similarproduct.md",
+    "quickstart-ecommerce.md",
+)
+
+
+def _bash_blocks(text: str) -> list[str]:
+    return re.findall(r"```bash\n(.*?)```", text, re.S)
+
+
+@pytest.mark.parametrize("doc", DOCS)
+def test_quickstart_doc_runs_verbatim(doc):
+    with open(os.path.join(REPO, "docs", doc)) as f:
+        blocks = _bash_blocks(f.read())
+    assert len(blocks) >= 4, f"{doc}: expected a full walk-through"
+    # harness preamble (not doc content): strict mode + orphan cleanup
+    # if a middle step fails
+    script = (
+        "set -euo pipefail\n"
+        "trap 'kill $(jobs -p) 2>/dev/null || true' EXIT\n"
+        + "\n".join(blocks)
+    )
+    env = dict(os.environ)
+    # subprocesses must compute on CPU: drop the TPU plugin's trigger
+    # and select the cpu platform (tiny shapes; remote compiles would
+    # take minutes per process)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PIO_FS_BASEDIR", None)       # each doc sets its own
+    out = subprocess.run(
+        ["bash", "-c", script], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert out.returncode == 0, (
+        f"{doc} failed (rc={out.returncode})\n--- stdout:\n"
+        f"{out.stdout[-4000:]}\n--- stderr:\n{out.stderr[-4000:]}"
+    )
