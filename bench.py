@@ -617,7 +617,21 @@ def bench_serving(user_f, item_f, users, items, n_queries=SERVE_QUERIES):
         np_serve(uix)
     nplat = np.asarray([np_serve(u) for u in query_uix[SERVE_WARMUP:]])
 
+    # the tunnel's dispatch+fetch floor: a minimal varying device op
+    # with a forced scalar fetch. p50 minus this is the framework's own
+    # serving cost — so a cross-session p50 drift is attributable to
+    # the link, like calibration_matmul_ms for kernel time
+    one = jax.device_put(jnp.ones((8, 8), jnp.float32))
+    float(jnp.sum(one))                       # compile
+    rtts = []
+    for j in range(30):
+        t0 = time.perf_counter()
+        float(jnp.sum(one * (1.0 + j)))
+        rtts.append(time.perf_counter() - t0)
+    rtt_floor = round(float(np.percentile(rtts, 50)) * 1e3, 2)
+
     return {
+        "serve_rtt_floor_ms": rtt_floor,
         "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
         "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
         "serve_inproc_p50_ms": round(float(np.percentile(inlat, 50)) * 1e3, 2),
@@ -1073,6 +1087,13 @@ def main() -> None:
         line["phase_solve_ms"] = round(
             line["iter_ms"] - line["phase_gather_ms"]
             - line["phase_einsum_ms"], 1)
+    if {"rank200_iter_ms", "calibration_matmul_ms"} <= line.keys():
+        # session-normalized rank-200 quote (VERDICT r4 weak #6):
+        # identical programs measured 330-497 ms/iter across sessions;
+        # dividing by the constant-workload anchor makes a
+        # round-over-round comparison regime-adjusted
+        line["rank200_iter_per_calib"] = round(
+            line["rank200_iter_ms"] / line["calibration_matmul_ms"], 1)
 
     print(json.dumps(line))
 

@@ -133,13 +133,30 @@ def _live_es_client():
     from predictionio_tpu.storage.elasticsearch import ESStorageClient
 
     # isolate per run via the INDEX prefix (every index the client
-    # creates is "<INDEX>_..."-named)
-    return ESStorageClient(StorageClientConfig(properties={
+    # creates is "<INDEX>_..."-named); the prefix is kept on the
+    # client so teardown can drop the indexes it created
+    prefix = f"pio_live_{uuid.uuid4().hex[:8]}"
+    client = ESStorageClient(StorageClientConfig(properties={
         "HOSTS": u.hostname,
         "PORTS": str(u.port or 9200),
         "SCHEMES": u.scheme or "http",
-        "INDEX": f"pio_live_{uuid.uuid4().hex[:8]}",
+        "INDEX": prefix,
     }))
+    client._live_index_prefix = prefix
+    return client
+
+
+def _close_live_client(c) -> None:
+    """Teardown: drop the run's ES indexes (the documented 'suite drops
+    pio_-prefixed tables/indexes' contract — wildcard DELETE covers the
+    meta index and every per-app event index the prefix spawned)."""
+    prefix = getattr(c, "_live_index_prefix", None)
+    if prefix is not None:
+        try:
+            c._client.request("DELETE", f"/{prefix}*")
+        except Exception:
+            pass  # best-effort: never fail teardown on cleanup
+    c.close()
 
 
 @pytest.fixture(params=["postgres_live", "elasticsearch_live"])
@@ -147,15 +164,14 @@ def client(request):
     c = (_live_pg_client() if request.param == "postgres_live"
          else _live_es_client())
     yield c
-    c.close()
+    _close_live_client(c)
 
 
-@pytest.fixture(params=["postgres_live", "elasticsearch_live"])
-def events_client(request):
-    c = (_live_pg_client() if request.param == "postgres_live"
-         else _live_es_client())
-    yield c
-    c.close()
+@pytest.fixture
+def events_client(client):
+    # same live stores run the event-store conformance (the PG/ES
+    # backends implement both roles)
+    return client
 
 
 class TestLiveS3Models:

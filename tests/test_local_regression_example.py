@@ -1,0 +1,84 @@
+"""Scenario test for examples/local-regression — the pure-LocalAlgorithm
+pattern (reference: experimental/scala-local-regression): closed-form
+host ridge regression over $set properties, no mesh involvement."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.context import EngineContext
+from predictionio_tpu.workflow.train import run_train
+
+EXAMPLE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "examples", "local-regression",
+)
+
+
+@pytest.fixture
+def example_engine():
+    sys.path.insert(0, EXAMPLE_DIR)
+    sys.modules.pop("engine", None)
+    try:
+        import engine
+
+        yield engine
+    finally:
+        sys.path.remove(EXAMPLE_DIR)
+        sys.modules.pop("engine", None)
+
+
+@pytest.fixture
+def seeded_storage(storage):
+    """Points on the exact plane y = 2*x0 - 3*x1 + 5."""
+    app_id = storage.get_meta_data_apps().insert(App(0, "RegressionApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(11)
+    for k in range(40):
+        x0, x1 = float(rng.uniform(-5, 5)), float(rng.uniform(-5, 5))
+        events.insert(
+            Event(event="$set", entity_type="point", entity_id=f"pt{k}",
+                  properties=DataMap({"x0": x0, "x1": x1,
+                                      "y": 2 * x0 - 3 * x1 + 5})),
+            app_id,
+        )
+    return storage
+
+
+def test_recovers_the_plane(example_engine, seeded_storage):
+    algo = example_engine.RidgeRegressionAlgorithm(
+        example_engine.RidgeParams(lambda_=1e-8))
+    ds = example_engine.PointDataSource(
+        example_engine.DSParams(app_name="RegressionApp"))
+    ctx = EngineContext(storage=seeded_storage)
+    model = algo.train(ctx, ds.read_training(ctx))
+    np.testing.assert_allclose(model.weights, [2.0, -3.0], atol=1e-6)
+    assert model.intercept == pytest.approx(5.0, abs=1e-6)
+
+    out = algo.predict(model, example_engine.Query(features=(2.0, 3.0)))
+    assert out.prediction == pytest.approx(2 * 2.0 - 3 * 3.0 + 5, abs=1e-6)
+
+    with pytest.raises(ValueError, match="features"):
+        algo.predict(model, example_engine.Query(features=(1.0,)))
+
+
+def test_placement_is_local(example_engine):
+    assert example_engine.RidgeRegressionAlgorithm.placement == "local"
+
+
+def test_query_class_declared_for_wire_binding(example_engine):
+    assert example_engine.RidgeRegressionAlgorithm.query_class \
+        is example_engine.Query
+
+
+def test_full_train_workflow_from_variant(example_engine, seeded_storage):
+    with open(os.path.join(EXAMPLE_DIR, "engine.json")) as f:
+        variant = json.load(f)
+    outcome = run_train(variant=variant, storage=seeded_storage)
+    assert outcome.status == "COMPLETED"

@@ -1,0 +1,104 @@
+"""Scenario test for examples/markov-nextpage — the e2.MarkovChain
+experimental-pattern engine: time-ordered view streams become page
+transitions; queries return row-normalized next-page probabilities."""
+
+import json
+import os
+import sys
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.context import EngineContext
+from predictionio_tpu.workflow.train import run_train
+
+EXAMPLE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "examples", "markov-nextpage",
+)
+
+
+@pytest.fixture
+def example_engine():
+    sys.path.insert(0, EXAMPLE_DIR)
+    sys.modules.pop("engine", None)
+    try:
+        import engine
+
+        yield engine
+    finally:
+        sys.path.remove(EXAMPLE_DIR)
+        sys.modules.pop("engine", None)
+
+
+@pytest.fixture
+def seeded_storage(storage):
+    app_id = storage.get_meta_data_apps().insert(App(0, "NextPageApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    t0 = datetime(2024, 5, 1, tzinfo=timezone.utc)
+    # deterministic streams: p1 -> p2 three times, p1 -> p3 once
+    streams = {
+        "u0": ["p1", "p2", "p1", "p3"],
+        "u1": ["p1", "p2", "p4"],
+        "u2": ["p2", "p4", "p1", "p2"],
+    }
+    for u, pages in streams.items():
+        for k, page in enumerate(pages):
+            events.insert(
+                Event(event="view", entity_type="user", entity_id=u,
+                      target_entity_type="page", target_entity_id=page,
+                      properties=DataMap({}),
+                      event_time=t0 + timedelta(minutes=k)),
+                app_id,
+            )
+    return storage
+
+
+def test_datasource_orders_streams_by_time(example_engine, seeded_storage):
+    ds = example_engine.PageViewDataSource(
+        example_engine.DSParams(app_name="NextPageApp"))
+    td = ds.read_training(EngineContext(storage=seeded_storage))
+    assert td.transitions.count(("p1", "p2")) == 3
+    assert td.transitions.count(("p1", "p3")) == 1
+    assert td.transitions.count(("p2", "p4")) == 2
+
+
+def test_trains_and_predicts_next_pages(example_engine, seeded_storage):
+    algo = example_engine.MarkovChainAlgorithm(
+        example_engine.MCParams(top_n=3))
+    ds = example_engine.PageViewDataSource(
+        example_engine.DSParams(app_name="NextPageApp"))
+    ctx = EngineContext(storage=seeded_storage)
+    model = algo.train(ctx, ds.read_training(ctx))
+
+    # from p1: p1->p2 three times, p1->p3 once -> 0.75 / 0.25
+    out = algo.predict(model, example_engine.Query(page="p1", num=3))
+    pages = {s.page: s.prob for s in out.pages}
+    assert set(pages) == {"p2", "p3"}
+    assert pages["p2"] == pytest.approx(0.75)
+    assert pages["p3"] == pytest.approx(0.25)
+    probs = [s.prob for s in out.pages]
+    assert probs == sorted(probs, reverse=True)   # ranked
+
+    # num caps the result; unseen page is empty, not an error
+    assert len(algo.predict(
+        model, example_engine.Query(page="p1", num=1)).pages) == 1
+    assert algo.predict(
+        model, example_engine.Query(page="nope")).pages == ()
+
+
+def test_query_class_declared_for_wire_binding(example_engine):
+    """Without query_class the engine server hands predict a raw dict
+    (caught driving the real CLI: AttributeError on query.page)."""
+    assert example_engine.MarkovChainAlgorithm.query_class \
+        is example_engine.Query
+
+
+def test_full_train_workflow_from_variant(example_engine, seeded_storage):
+    with open(os.path.join(EXAMPLE_DIR, "engine.json")) as f:
+        variant = json.load(f)
+    outcome = run_train(variant=variant, storage=seeded_storage)
+    assert outcome.status == "COMPLETED"
