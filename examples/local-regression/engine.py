@@ -111,7 +111,6 @@ def engine_factory() -> Engine:
     return Engine(
         data_source_class_map=PointDataSource,
         preparator_class_map=IdentityPreparator,
-        algorithm_class_map={"ridge": RidgeRegressionAlgorithm,
-                             "": RidgeRegressionAlgorithm},
+        algorithm_class_map={"ridge": RidgeRegressionAlgorithm},
         serving_class_map=FirstServing,
     )

@@ -133,7 +133,6 @@ def engine_factory() -> Engine:
     return Engine(
         data_source_class_map=PageViewDataSource,
         preparator_class_map=IdentityPreparator,
-        algorithm_class_map={"markov": MarkovChainAlgorithm,
-                             "": MarkovChainAlgorithm},
+        algorithm_class_map={"markov": MarkovChainAlgorithm},
         serving_class_map=FirstServing,
     )

@@ -77,3 +77,14 @@ def test_section_failure_keeps_primary_metric(tiny_bench, capsys, monkeypatch):
     assert "map10_tpu" not in line
     # the hole in the contract is marked at the artifact top level
     assert line["sections_failed"] == ["quality"]
+
+
+def test_skip_heavy_lists_skipped_sections(tiny_bench, capsys, monkeypatch):
+    """--skip-heavy artifacts are INCOMPLETE and must say so: the
+    skipped sections land in sections_failed (README contract)."""
+    monkeypatch.setattr("sys.argv", ["bench.py", "--skip-heavy"])
+    tiny_bench.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert set(line["sections_failed"]) == {
+        "phases", "rank200", "serving", "attention", "seqrec"}
+    assert "ingest_events_per_sec" in line and "map10_tpu" in line

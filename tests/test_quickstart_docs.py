@@ -23,6 +23,7 @@ DOCS = (
     "quickstart-classification.md",
     "quickstart-similarproduct.md",
     "quickstart-ecommerce.md",
+    "quickstart-evaluation.md",
 )
 
 
