@@ -584,6 +584,11 @@ def bench_serving(user_f, item_f, users, items, n_queries=SERVE_QUERIES):
     finally:
         server.stop()
 
+    # concurrent-clients HTTP throughput with the micro-batcher
+    # (ServerConfig.batching, r5): N clients' queries coalesce into one
+    # device dispatch, amortizing the tunnel RTT that dominates p50
+    batched = _bench_batched_serving(deployed, query_uix)
+
     # in-process p50: the identical serve flow minus HTTP + loopback,
     # so the link's share of p50 is measured rather than asserted
     # (VERDICT r2 weak #5)
@@ -634,12 +639,67 @@ def bench_serving(user_f, item_f, users, items, n_queries=SERVE_QUERIES):
         "serve_rtt_floor_ms": rtt_floor,
         "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
         "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+        **batched,
         "serve_inproc_p50_ms": round(float(np.percentile(inlat, 50)) * 1e3, 2),
         "baseline_serve_inproc_p50_ms": round(
             float(np.percentile(nplat, 50)) * 1e3, 3),
         "serve_queries": int(len(lat)),
         **bench_batch_predict(),
     }
+
+
+def _bench_batched_serving(deployed, query_uix, clients: int = 32,
+                           per_client: int = 8):
+    """HTTP throughput with ``clients`` concurrent connections against
+    a batching engine server (one device dispatch per coalesced batch).
+    Sequential HTTP tops out at ~1000/p50 qps on the tunnel; this is
+    the number that shows the dispatch RTT amortizing."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    from predictionio_tpu.api.engine_server import EngineServer
+    from predictionio_tpu.workflow.deploy import ServerConfig
+
+    # 25ms wait: on this 1-core host 32 client threads need more than
+    # the 5ms default to get their requests enqueued past the GIL
+    server = EngineServer(deployed, ServerConfig(
+        ip="127.0.0.1", port=0, batching=True,
+        batch_max=clients, batch_wait_ms=25.0))
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/queries.json"
+        uixs = np.asarray(query_uix)
+
+        def client(cid, count):
+            for j in range(count):
+                body = _json.dumps({
+                    "user": f"u{int(uixs[(cid * per_client + j) % len(uixs)])}",
+                    "num": 10}).encode()
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    r.read()
+
+        def run(count):
+            threads = [threading.Thread(target=client, args=(c, count))
+                       for c in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0
+
+        run(2)                                  # warm the batched path
+        dt = run(per_client)
+        # key carries the client count so the metric always describes
+        # its own measurement
+        return {f"serve_batched_qps_{clients}c":
+                round(clients * per_client / dt, 1)}
+    finally:
+        server.stop()
 
 
 def bench_batch_predict(n_items: int = 2_000_000, batch: int = 256,

@@ -42,6 +42,7 @@ from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.workflow.context import EngineContext
 from predictionio_tpu.workflow.deploy import (
     DeployedEngine,
+    QueryBatcher,
     ServerConfig,
     load_deployed_engine,
 )
@@ -179,6 +180,14 @@ class EngineService:
         self.on_stop = lambda: None
         #: set by the HTTP wrapper; mid-request client-disconnect count
         self.client_disconnects = lambda: 0
+        #: opt-in micro-batching: concurrent queries coalesce into one
+        #: device dispatch (ServerConfig.batching; QueryBatcher docs)
+        self.batcher: QueryBatcher | None = (
+            QueryBatcher(lambda: self.deployed,
+                         batch_max=config.batch_max,
+                         batch_wait_ms=config.batch_wait_ms)
+            if config.batching else None
+        )
 
     # -- auth (KeyAuthentication.withAccessKeyFromFile) ---------------------
     def _check_server_key(self, params: Mapping[str, str]) -> None:
@@ -240,6 +249,12 @@ class EngineService:
             "avgServingSec": d.avg_serving_sec,
             "lastServingSec": d.last_serving_sec,
             "clientDisconnects": self.client_disconnects(),
+            **({"batching": {
+                "batches": self.batcher.batches,
+                "batchedQueries": self.batcher.batched_queries,
+                "batchMax": self.config.batch_max,
+                "batchWaitMs": self.config.batch_wait_ms,
+            }} if self.batcher is not None else {}),
         }
 
     def status_html(self) -> str:
@@ -277,7 +292,10 @@ class EngineService:
             raise _Reject(400, f"invalid query: {e}")
 
         try:
-            prediction = self.deployed.query(query)
+            if self.batcher is not None:
+                prediction = self.batcher.submit(query)
+            else:
+                prediction = self.deployed.query(query)
         except Exception as e:
             logger.exception("query failed")
             raise _Reject(500, f"query failed: {e}")
@@ -458,6 +476,8 @@ class EngineServer(RestServer):
             undeploy(ip, port, self.config.server_key)
 
     def _on_close(self) -> None:
+        if self.service.batcher is not None:
+            self.service.batcher.close()
         self.service.plugins.close()
 
 

@@ -331,8 +331,25 @@ class ALSAlgorithm(ShardedAlgorithm):
                     break
             while pad < widest:
                 pad *= 2
-        cols = np.zeros((len(known), pad), dtype=np.int32)
-        mask = np.zeros((len(known), pad), dtype=np.float32)
+        B = len(known)
+        # pad the BATCH dimension to a power-of-two menu: every
+        # distinct B is a fresh jit signature, and on a
+        # remote-compile backend each costs tens of seconds — the
+        # serving micro-batcher produces arbitrary batch sizes, so
+        # without this a varying-concurrency workload compiles
+        # forever instead of dispatching (padding rows repeat row 0
+        # and are sliced off the result). Only serving-scale batches
+        # pad: a large one-shot EVAL batch (engine.eval routes whole
+        # folds here) compiles once anyway, and padding it would
+        # inflate the (B, n_items) score matmul by up to 2x for
+        # nothing
+        padB = (B if B > 256 or (B & (B - 1)) == 0
+                else 1 << B.bit_length())
+        if padB != B:
+            uixs = np.concatenate(
+                [uixs, np.full(padB - B, uixs[0], dtype=np.int32)])
+        cols = np.zeros((padB, pad), dtype=np.int32)
+        mask = np.zeros((padB, pad), dtype=np.float32)
         if self.params.exclude_seen:
             for j, (_, u, _) in enumerate(known):
                 s = model.seen_by_user.get(int(u), np.empty(0, dtype=np.int32))[:pad]
@@ -352,8 +369,8 @@ class ALSAlgorithm(ShardedAlgorithm):
             allow,
             k,
         )
-        vals = np.asarray(vals)
-        idxs = np.asarray(idxs)
+        vals = np.asarray(vals)[:B]
+        idxs = np.asarray(idxs)[:B]
         inv = model.item_ids.inverse
         for j, (qi, _, num) in enumerate(known):
             scores = []

@@ -194,6 +194,12 @@ def _configure_deploy(sub) -> None:
     p.add_argument("--accesskey", default="", help="access key for feedback events")
     p.add_argument("--server-key", default=None,
                    help="when set, /stop and /reload require this key")
+    p.add_argument("--batching", action="store_true",
+                   help="coalesce concurrent queries into one device "
+                        "dispatch (micro-batching; adds up to "
+                        "--batch-wait-ms latency to a lone query)")
+    p.add_argument("--batch-max", type=int, default=64)
+    p.add_argument("--batch-wait-ms", type=float, default=5.0)
 
 
 def _cmd_deploy(args, storage) -> int:
@@ -217,6 +223,9 @@ def _cmd_deploy(args, storage) -> int:
         event_server_port=args.event_server_port,
         access_key=args.accesskey,
         server_key=args.server_key,
+        batching=args.batching,
+        batch_max=args.batch_max,
+        batch_wait_ms=args.batch_wait_ms,
     )
     server = create_engine_server(storage=storage, config=config)
     return _serve(

@@ -1,0 +1,142 @@
+"""Serving micro-batcher (ServerConfig.batching): concurrent queries
+coalesce into one batch_predict dispatch — the TPU-first answer to
+per-query dispatch RTT (QueryBatcher docstring; beyond reference)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api.engine_server import create_engine_server
+from predictionio_tpu.workflow.deploy import ServerConfig
+from predictionio_tpu.workflow.train import run_train
+
+from tests.sample_engine import AlgoParams, DSParams
+
+
+def _train(storage, mult=2):
+    from predictionio_tpu.controller import EngineParams
+
+    params = EngineParams.of(
+        data_source=DSParams(id=7, n_train=5),
+        algorithms=[("sample", AlgoParams(id=0, mult=mult))],
+    )
+    return run_train(
+        engine_factory="tests.sample_engine.engine_factory",
+        engine_params=params,
+        variant={"id": "sample-engine"},
+        storage=storage,
+    )
+
+
+@pytest.fixture
+def batching_server(storage):
+    _train(storage, mult=2)
+    server = create_engine_server(
+        storage=storage,
+        config=ServerConfig(ip="127.0.0.1", port=0, batching=True,
+                            batch_max=32, batch_wait_ms=60.0),
+    )
+    server.start()
+    yield server
+    server.stop()
+
+
+def _post(port, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _concurrent_posts(port, payloads):
+    """Fire all payloads at once; returns results in payload order."""
+    results = [None] * len(payloads)
+    barrier = threading.Barrier(len(payloads))
+
+    def go(i):
+        barrier.wait()
+        try:
+            results[i] = _post(port, payloads[i])
+        except urllib.error.HTTPError as e:
+            results[i] = (e.code, json.loads(e.read()))
+
+    threads = [threading.Thread(target=go, args=(i,))
+               for i in range(len(payloads))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    return results
+
+
+class TestQueryBatching:
+    def test_concurrent_queries_coalesce_and_answer_correctly(
+            self, batching_server):
+        server = batching_server
+        n = 12
+        results = _concurrent_posts(
+            server.port, [{"x": i} for i in range(n)])
+        for i, (status, body) in enumerate(results):
+            assert status == 200
+            assert body["value"] == 2 * i, (i, body)   # mult=2, per query
+        # the status page proves coalescing happened: fewer dispatches
+        # than queries
+        doc = server.service.status_doc()
+        b = doc["batching"]
+        assert b["batchedQueries"] == n
+        assert 1 <= b["batches"] < n
+        assert doc["requestCount"] == n
+
+    def test_single_query_still_served(self, batching_server):
+        status, body = _post(batching_server.port, {"x": 5})
+        assert status == 200 and body["value"] == 10
+
+    def test_poisoned_query_fails_alone(self, batching_server, monkeypatch):
+        """A query that raises inside predict must 500 by itself — the
+        batch retries individually (QueryBatcher._finish)."""
+        server = batching_server
+        algo = server.service.deployed.algorithms[0]
+        orig = algo.predict
+
+        def poisoned(model, query):
+            if query.x == 13:
+                raise RuntimeError("poisoned query")
+            return orig(model, query)
+
+        monkeypatch.setattr(algo, "predict", poisoned)
+        results = _concurrent_posts(
+            server.port, [{"x": x} for x in (11, 12, 13, 14)])
+        by_x = dict(zip((11, 12, 13, 14), results))
+        assert by_x[13][0] == 500
+        for x in (11, 12, 14):
+            assert by_x[x] == (200, {"value": 2 * x,
+                                     "tags": ["algo0", "served"]}), x
+
+    def test_reload_applies_to_next_batch(self, batching_server, storage):
+        server = batching_server
+        _, body = _post(server.port, {"x": 3})
+        assert body["value"] == 6                       # mult=2
+        _train(storage, mult=10)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/reload", timeout=10):
+            pass
+        _, body = _post(server.port, {"x": 3})
+        assert body["value"] == 30                      # mult=10
+
+    def test_stop_closes_batcher(self, storage):
+        _train(storage, mult=2)
+        server = create_engine_server(
+            storage=storage,
+            config=ServerConfig(ip="127.0.0.1", port=0, batching=True))
+        server.start()
+        server.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            server.service.batcher.submit(object())
