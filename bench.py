@@ -661,15 +661,30 @@ def _bench_batched_serving(deployed, query_uix, clients: int = 32,
     from predictionio_tpu.api.engine_server import EngineServer
     from predictionio_tpu.workflow.deploy import ServerConfig
 
-    # 25ms wait: on this 1-core host 32 client threads need more than
-    # the 5ms default to get their requests enqueued past the GIL
+    from predictionio_tpu.templates import recommendation as rec
+
+    uixs = np.asarray(query_uix)
+    # pre-compile EVERY padded batch signature the coalescer can
+    # produce (batch dims pad to powers of two): a partial batch whose
+    # signature first appears inside the timed loop would bill a
+    # multi-second remote compile as serving time (observed: 24 vs
+    # ~113 qps)
+    for b in (1, 2, 4, 8, 16, 32):
+        if b <= clients:
+            deployed.query_batch([
+                rec.Query(user=f"u{int(uixs[j % len(uixs)])}", num=10)
+                for j in range(b)
+            ])
+
     server = EngineServer(deployed, ServerConfig(
         ip="127.0.0.1", port=0, batching=True,
+        # 25ms wait: on this 1-core host 32 client threads need more
+        # than the 5ms default to get their requests enqueued past
+        # the GIL
         batch_max=clients, batch_wait_ms=25.0))
     server.start()
     try:
         url = f"http://127.0.0.1:{server.port}/queries.json"
-        uixs = np.asarray(query_uix)
 
         def client(cid, count):
             for j in range(count):

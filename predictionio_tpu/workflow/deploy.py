@@ -220,7 +220,11 @@ class QueryBatcher:
         import queue as _queue
 
         self._get_deployed = get_deployed
-        self._batch_max = max(1, int(batch_max))
+        # clamped to 256: the ALS batch_predict pads batch dims to a
+        # power-of-two menu only up to 256 (above, every distinct size
+        # would be a fresh jit signature — the retrace stall the menu
+        # exists to prevent); 256 queries per dispatch is plenty
+        self._batch_max = max(1, min(int(batch_max), 256))
         self._wait_s = max(0.0, batch_wait_ms) / 1e3
         self._queue: "_queue.Queue" = _queue.Queue()
         self._stopped = False

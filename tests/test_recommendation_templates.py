@@ -153,6 +153,17 @@ class TestRecommendation:
             s.item for s in single.item_scores
         ]
 
+        # heterogeneous per-query num: the batch computes one
+        # menu-ized top_k width (k/num are serving-client-controlled
+        # and static jit args — r5 micro-batcher hardening) but each
+        # query still gets exactly its own count back
+        mixed = dict(algo.batch_predict(model, [
+            (0, Query(user="u1", num=2)), (1, Query(user="u2", num=5))]))
+        assert len(mixed[0].item_scores) == 2
+        assert len(mixed[1].item_scores) == 5
+        assert [s.item for s in mixed[0].item_scores] == [
+            s.item for s in single.item_scores[:2]]
+
 
 class TestSimilarProduct:
     VARIANT = {
