@@ -24,6 +24,7 @@ DOCS = (
     "quickstart-similarproduct.md",
     "quickstart-ecommerce.md",
     "quickstart-evaluation.md",
+    "quickstart-sessionrec.md",
 )
 
 
