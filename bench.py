@@ -33,8 +33,15 @@ round is comparable on all axes:
   latency over HTTP loopback (reference counter:
   CreateServer.scala:583-590) AND the in-process serve path (same
   query flow minus HTTP + tunnel), so the link share is measured, not
-  asserted. ``batch_predict_qps_2m`` — batched top-k scoring rate
-  against a 2M-item catalog (the eval hot path).
+  asserted. ``serve_rtt_floor_ms`` — the tunnel's minimal
+  dispatch+fetch p50, so cross-session p50 drift is attributable to
+  the link. ``serve_batched_qps_32c`` — 32-concurrent-client HTTP
+  throughput through the query micro-batcher
+  (ServerConfig.batching; r5). ``batch_predict_qps_2m`` — batched
+  top-k scoring rate against a 2M-item catalog (the eval hot path).
+  ``calibration_matmul_ms`` — fixed bf16 matmul anchor; quote
+  ``rank200_iter_per_calib`` for regime-adjusted comparison.
+  ``sections_failed`` — ALWAYS present; [] means complete.
 - ``flash_s4096_ms``/``xla_s4096_ms`` — pallas flash (force=True) vs
   XLA attention forward at S=4096. Tracking this pair is what caught
   the round-2 envelope claim being wrong (XLA wins at every measured
