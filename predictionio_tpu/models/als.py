@@ -312,9 +312,9 @@ def build_allow_vector(
 
 
 def _serving_k(k: int) -> int:
-    """Round k up to a small fixed menu so serving never retraces on a new
-    ``num`` (SURVEY.md §7 hard-parts: fixed top-k buckets)."""
-    for cap in (10, 20, 50, 100, 500):
-        if k <= cap:
-            return cap
-    return k
+    """Round k up to the shared serving top-k menu so a new ``num``
+    never retraces (SURVEY.md §7 hard-parts: fixed top-k buckets;
+    ops/topk.serving_k is the one menu for every serving path)."""
+    from predictionio_tpu.ops.topk import serving_k
+
+    return serving_k(k, 1 << 62)   # call sites clamp to the catalog

@@ -133,6 +133,28 @@ def recommend_topk_chunked(
 #: fixed set keeps the number of compiled kernel shapes bounded
 _SEEN_WIDTHS = (8, 32, 128, 512)
 
+#: static top_k widths shared by every serving path — k is a jit
+#: signature arg fed by client-controlled ``query.num``
+_K_WIDTHS = (10, 32, 100, 320, 1000)
+
+
+def serving_k(k: int, n_max: int) -> int:
+    """Round a requested top-k width up to the ``_K_WIDTHS`` menu
+    (power of two beyond it), clamped to the catalog/vocab size.
+
+    ``k`` feeds jit signatures as a STATIC argument, and ``query.num``
+    is client-controlled: without the menu, a client cycling num
+    values retraces the serving program per distinct value — behind
+    the query micro-batcher that stalls every other client's batch
+    for the compile. Callers already trim results to each query's own
+    num, so a wider k only widens the ``top_k``. One helper for all
+    serving paths (ALS single-query, recommendation batch, sessionrec
+    batch) so the trace-width buckets can't drift apart."""
+    for cap in _K_WIDTHS:
+        if k <= cap:
+            return min(cap, n_max)
+    return min(1 << (max(k, 2) - 1).bit_length(), n_max)
+
 #: catalog/batch envelope where the chunked-scan formulation beats the
 #: flat materialize+top_k (measured with the forcing protocol:
 #: B=256 x I=2M, chunked 73ms vs flat 141ms; at B=32 x I=1M the flat

@@ -357,19 +357,9 @@ class ALSAlgorithm(ShardedAlgorithm):
                 mask[j, : len(s)] = 1.0
         allow = jnp.ones((model.item_factors.shape[0],), dtype=jnp.float32)
         n_items = model.item_factors.shape[0]
-        k = min(max_num, n_items)
-        # menu-ize the top_k width too: k is a STATIC jit arg and
-        # query.num is client-controlled — under the serving
-        # micro-batcher a client cycling num values would otherwise
-        # retrace per distinct value, stalling every other client
-        # behind remote compiles. Results are already trimmed to each
-        # query's own num below, so a wider k only widens the top_k.
-        for cap in (10, 32, 100, 320, 1000):
-            if k <= cap:
-                k = min(cap, n_items)
-                break
-        else:
-            k = min(1 << (k - 1).bit_length(), n_items)
+        # menu-ized STATIC top_k width (ops/topk.serving_k: client-
+        # controlled num must not retrace; results trim per query below)
+        k = topk_ops.serving_k(min(max_num, n_items), n_items)
         # dispatcher picks flat vs chunked-scan (ops/topk docstring
         # records the measurements)
         vals, idxs = topk_ops.recommend_topk_fused(

@@ -271,7 +271,13 @@ class SeqRecAlgorithm(HostModelAlgorithm):
         if not prepared:
             return out
 
-        k = min(max(q.num for _, q, _, _ in prepared), model.cfg.vocab - 1)
+        # menu-ized STATIC top-k width (ops/topk.serving_k: client-
+        # controlled num must not retrace predict_topk_batch; results
+        # trim per query below)
+        from predictionio_tpu.ops.topk import serving_k
+
+        k = serving_k(max(q.num for _, q, _, _ in prepared),
+                      model.cfg.vocab - 1)
         inv = model.item_index.inverse
         pos = 0
         while pos < len(prepared):
