@@ -143,13 +143,14 @@ def forward(
             from predictionio_tpu.ops.pallas_attention import flash_attention
 
             att = flash_attention(q, k, v, causal=True, kv_mask=mask)
-        elif S >= 4096 and any(S % b == 0 for b in (512, 256, 128)):
+        elif S >= 4096 and S % 128 == 0:
             # single-device long-context TRAINING: full_attention's
             # (S, S) logits OOM from ~16k; blockwise is differentiable
-            # with O(S * q_block) peak (ops/attention.blockwise_attention)
-            qb = next(b for b in (512, 256, 128) if S % b == 0)
+            # with O(S * q_block) peak. q_block=128 from the r5 sweep
+            # (1.8x over 512 at S=4096; table in the
+            # ops/attention.blockwise_attention docstring)
             att = blockwise_attention(q, k, v, causal=True, kv_mask=mask,
-                                      q_block=qb)
+                                      q_block=128)
         else:
             att = full_attention(q, k, v, causal=True, kv_mask=mask)
         att = att.transpose(0, 2, 1, 3).reshape(B, S, d)

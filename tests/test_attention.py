@@ -155,7 +155,9 @@ class TestBlockwiseAttention:
         params = seqrec.init_params(jax.random.PRNGKey(0), cfg)
         seqs = jnp.ones((1, 4096), jnp.int32)
         seqrec.forward(params, seqs, cfg)
-        assert calls == [512]
+        # smallest dividing tile: the r5 sweep measured q_block=128
+        # 1.8x faster than 512 at S=4096
+        assert calls == [128]
 
 
 class TestPallasFlashAttention:
