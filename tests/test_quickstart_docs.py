@@ -51,11 +51,18 @@ def test_quickstart_doc_runs_verbatim(doc):
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PIO_FS_BASEDIR", None)       # each doc sets its own
-    out = subprocess.run(
-        ["bash", "-c", script], cwd=REPO, env=env,
-        capture_output=True, text=True, timeout=900,
-    )
+    # one retry: the walk-throughs are honest wall-clock scripts with
+    # fixed ports and readiness windows, and a saturated 1-core CI
+    # host occasionally overruns a window or holds a port in teardown
+    # (observed as rare one-off failures that pass in isolation)
+    for attempt in (1, 2):
+        out = subprocess.run(
+            ["bash", "-c", script], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=900,
+        )
+        if out.returncode == 0:
+            break
     assert out.returncode == 0, (
-        f"{doc} failed (rc={out.returncode})\n--- stdout:\n"
+        f"{doc} failed twice (rc={out.returncode})\n--- stdout:\n"
         f"{out.stdout[-4000:]}\n--- stderr:\n{out.stderr[-4000:]}"
     )
