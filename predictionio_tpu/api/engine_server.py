@@ -14,6 +14,15 @@ Route and behavior parity with the reference deploy server
                          (:316-342; key-authenticated)
 - ``POST /stop``         shutdown (:633-646; key-authenticated)
 - ``GET /plugins.json``  plugin listing (:648-671)
+- ``GET /healthz``       liveness (beyond reference; k8s-style contract)
+- ``GET /readyz``        readiness: model loaded + storage reachable
+
+Graceful degradation (beyond reference, docs/operations-resilience.md):
+storage-unavailable failures map to ``503`` + ``Retry-After`` instead of
+``500``; a failed ``/reload`` keeps serving the last-known-good model;
+``ServerConfig.request_deadline_ms`` (or an ``X-PIO-Deadline-Ms``
+request header) bounds each query's time budget, propagated to the
+micro-batcher and the storage resilience layer.
 
 The reference's MasterActor/ServerActor pair collapses to
 ``EngineServer`` (HTTP lifecycle, bind retry ×3 — :347-357) over
@@ -25,24 +34,37 @@ fire-and-forget thread, tagging responses with a ``prId``.
 from __future__ import annotations
 
 import abc
+import contextlib
+import contextvars
 import dataclasses
 import json
 import logging
+import math
 import queue
 import threading
 import time
 import uuid
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from http.server import BaseHTTPRequestHandler
 from typing import Any, Mapping
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.api.http_base import RestServer
+from predictionio_tpu.api.http_base import RestServer, bounded_probe
+from predictionio_tpu.api.stats import resilience_snapshot
 from predictionio_tpu.core.wire import from_wire, to_wire
 from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.utils.resilience import (
+    STORAGE_UNAVAILABLE_ERRORS,
+    deadline_scope,
+    record_fallback,
+    retry_after_hint,
+)
 from predictionio_tpu.workflow.context import EngineContext
 from predictionio_tpu.workflow.deploy import (
     DeployedEngine,
     QueryBatcher,
+    QueryDeadlineExceeded,
     ServerConfig,
     load_deployed_engine,
 )
@@ -154,9 +176,11 @@ class _HtmlPage(str):
 
 
 class _Reject(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: dict[str, str] | None = None):
         self.status = status
         self.message = message
+        self.headers = headers
 
 
 class EngineService:
@@ -188,6 +212,11 @@ class EngineService:
                          batch_wait_ms=config.batch_wait_ms)
             if config.batching else None
         )
+        #: deadline enforcement for the NON-batched path: the query runs
+        #: on a pool thread so a blown budget returns 503 instead of
+        #: holding the socket (threads spawn lazily; idle pool is free)
+        self._query_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="pio-query-deadline")
 
     # -- auth (KeyAuthentication.withAccessKeyFromFile) ---------------------
     def _check_server_key(self, params: Mapping[str, str]) -> None:
@@ -204,22 +233,40 @@ class EngineService:
         params: Mapping[str, str],
         headers: Mapping[str, str],
         body: Any,
-    ) -> tuple[int, Any]:
+    ) -> tuple:
+        """Returns ``(status, payload)`` or ``(status, payload, headers)``
+        (the 3-tuple form carries e.g. ``Retry-After`` on 503s)."""
         try:
             if method == "GET" and path == "/":
                 if "text/html" in headers.get("accept", ""):
                     return (200, _HtmlPage(self.status_html()))
                 return (200, self.status_doc())
             if method == "POST" and path == "/queries.json":
-                return self.handle_query(body)
+                return self.handle_query(body, headers)
             if method == "GET" and path == "/plugins.json":
                 return (200, self.plugins.describe())
+            if method == "GET" and path == "/healthz":
+                # liveness: the process answers; nothing else implied
+                return (200, {"status": "ok"})
+            if method == "GET" and path == "/readyz":
+                return self.readyz()
             if path == "/reload" and method in ("GET", "POST"):
                 self._check_server_key(params)
                 try:
                     self.reload()
                 except LookupError as e:
                     raise _Reject(404, str(e))
+                except Exception as e:
+                    # keep serving the last-known-good model instead of
+                    # wedging: the old instance stays deployed
+                    keep = self.deployed.instance.id
+                    logger.exception(
+                        "reload failed; still serving instance %s", keep)
+                    record_fallback("serving/reload")
+                    raise _Reject(
+                        503,
+                        f"reload failed ({e}); still serving instance {keep}",
+                        {"Retry-After": f"{retry_after_hint(e):.0f}"})
                 return (200, {"message": "Reloading"})
             if method == "POST" and path == "/stop":
                 self._check_server_key(params)
@@ -227,10 +274,50 @@ class EngineService:
                 return (200, {"message": "Shutting down"})
             return (404, {"message": f"no route for {method} {path}"})
         except _Reject as r:
+            if r.headers:
+                return (r.status, {"message": r.message}, r.headers)
             return (r.status, {"message": r.message})
+        except STORAGE_UNAVAILABLE_ERRORS as e:
+            logger.warning("storage unavailable in %s %s: %s", method, path, e)
+            return (503, {"message": f"storage unavailable: {e}"},
+                    {"Retry-After": f"{retry_after_hint(e):.0f}"})
         except Exception as e:
             logger.exception("unhandled error in %s %s", method, path)
             return (500, {"message": f"internal error: {e}"})
+
+    def readyz(self) -> tuple:
+        """Readiness: a deployed model AND reachable storage. 503 (with
+        Retry-After) until both hold — load balancers drain, clients
+        back off, and a wedged dependency never looks like a live
+        replica."""
+        checks: dict[str, str] = {}
+        ready = True
+        if self.deployed is not None:
+            checks["model"] = self.deployed.instance.id
+        else:
+            checks["model"] = "missing"
+            ready = False
+        if self.storage is not None:
+            probe_id = checks["model"]  # a cheap keyed metadata read
+
+            def probe() -> None:
+                # inner deadline stops retry sleeps; bounded_probe walls
+                # off a blackholed backend's socket timeout
+                with deadline_scope(1.0):
+                    self.storage.get_meta_data_engine_instances().get(probe_id)
+
+            err = bounded_probe(probe, timeout=1.0)
+            if err is None:
+                checks["storage"] = "ok"
+            else:
+                checks["storage"] = f"unavailable: {err}"
+                ready = False
+        else:
+            checks["storage"] = "skipped"
+        if ready:
+            return (200, {"status": "ready", **checks})
+        return (503, {"status": "unavailable", **checks},
+                {"Retry-After": "1"})
 
     def status_doc(self) -> dict:
         """The GET / status page content (CreateServer.scala:442-469)."""
@@ -255,6 +342,7 @@ class EngineService:
                 "batchMax": self.config.batch_max,
                 "batchWaitMs": self.config.batch_wait_ms,
             }} if self.batcher is not None else {}),
+            **({"resilience": snap} if (snap := resilience_snapshot()) else {}),
         }
 
     def status_html(self) -> str:
@@ -276,7 +364,27 @@ class EngineService:
             f"<table>{rows}</table></body></html>"
         )
 
-    def handle_query(self, body: Any) -> tuple[int, Any]:
+    def _deadline_budget(self, headers: Mapping[str, str]) -> float | None:
+        """Per-request budget (seconds): X-PIO-Deadline-Ms header may only
+        TIGHTEN the configured request_deadline_ms."""
+        budget = (self.config.request_deadline_ms / 1e3
+                  if self.config.request_deadline_ms > 0 else None)
+        raw = headers.get("x-pio-deadline-ms")
+        if raw:
+            try:
+                value = float(raw)
+            except ValueError:
+                value = float("nan")
+            if not math.isfinite(value) or value <= 0:
+                # nan/inf/zero/negative are malformed requests, not
+                # budgets — a silent 1ms budget would 503 forever
+                raise _Reject(400, f"invalid X-PIO-Deadline-Ms: {raw!r}")
+            client = max(0.001, value / 1e3)
+            budget = client if budget is None else min(budget, client)
+        return budget
+
+    def handle_query(self, body: Any,
+                     headers: Mapping[str, str] = {}) -> tuple[int, Any]:
         """POST /queries.json (CreateServer.scala:470-621)."""
         if body is None or not isinstance(body, dict):
             raise _Reject(400, "the request body must be a JSON object")
@@ -291,11 +399,25 @@ class EngineService:
         except (ValueError, TypeError) as e:
             raise _Reject(400, f"invalid query: {e}")
 
+        budget = self._deadline_budget(headers)
         try:
-            if self.batcher is not None:
-                prediction = self.batcher.submit(query)
-            else:
-                prediction = self.deployed.query(query)
+            with deadline_scope(budget) if budget is not None \
+                    else contextlib.nullcontext():
+                if self.batcher is not None:
+                    prediction = self.batcher.submit(
+                        query, timeout=budget if budget is not None else 300.0)
+                elif budget is not None:
+                    prediction = self._query_with_deadline(query, budget)
+                else:
+                    prediction = self.deployed.query(query)
+        except QueryDeadlineExceeded as e:
+            # a blown deadline is overload/degradation, not an
+            # application error: 503 so the client retries later
+            raise _Reject(503, str(e), {"Retry-After": "1"})
+        except STORAGE_UNAVAILABLE_ERRORS as e:
+            logger.warning("query failed on unavailable storage: %s", e)
+            raise _Reject(503, f"storage unavailable: {e}",
+                          {"Retry-After": f"{retry_after_hint(e):.0f}"})
         except Exception as e:
             logger.exception("query failed")
             raise _Reject(500, f"query failed: {e}")
@@ -324,6 +446,22 @@ class EngineService:
             response["prId"] = pr_id
             self._post_feedback(pr_id, body, response)
         return (200, response)
+
+    def _query_with_deadline(self, query: Any, budget: float) -> Any:
+        """Non-batched predict under a hard budget: run on a pool thread
+        (copying this request's contextvars so the ambient deadline
+        still reaches storage retries) and 503 when the wait expires —
+        an in-flight slow predict cannot be interrupted, but it must
+        not hold the client socket past the budget."""
+        ctx = contextvars.copy_context()
+        fut = self._query_pool.submit(ctx.run, self.deployed.query, query)
+        try:
+            return fut.result(timeout=budget)
+        except FuturesTimeoutError:
+            if not fut.done():
+                fut.cancel()
+                raise QueryDeadlineExceeded(budget) from None
+            raise  # the work itself raised a TimeoutError (3.11 alias)
 
     def reload(self) -> None:
         """Hot-swap to the latest completed instance
@@ -394,12 +532,13 @@ class _Handler(BaseHTTPRequestHandler):
                     return
         # header names are case-insensitive (RFC 9110); normalise once
         headers = {k.lower(): v for k, v in self.headers.items()}
-        status, payload = self.service.handle(
+        result = self.service.handle(
             method, path, self._params(), headers, body
         )
-        self._respond(status, payload)
+        self._respond(*result)
 
-    def _respond(self, status: int, payload: Any) -> None:
+    def _respond(self, status: int, payload: Any,
+                 extra_headers: Mapping[str, str] | None = None) -> None:
         if isinstance(payload, _HtmlPage):
             data = str(payload).encode()
             ctype = "text/html; charset=UTF-8"
@@ -409,6 +548,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -478,6 +619,7 @@ class EngineServer(RestServer):
     def _on_close(self) -> None:
         if self.service.batcher is not None:
             self.service.batcher.close()
+        self.service._query_pool.shutdown(wait=False)
         self.service.plugins.close()
 
 

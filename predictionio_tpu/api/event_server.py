@@ -11,6 +11,13 @@ Route and status-code parity with the reference
 - ``POST /batch/events.json``    ≤50 events, per-event statuses (:376-460)
 - ``GET /stats.json``            hourly stats when enabled (:463-489)
 - ``POST|GET /webhooks/{site}.json|.form``  connectors (:491-592)
+- ``GET /healthz``               liveness (beyond reference)
+- ``GET /readyz``                readiness: storage reachable
+
+Graceful degradation (beyond reference, docs/operations-resilience.md):
+storage-backend failures on the ingest/read paths map to ``503`` +
+``Retry-After`` — clients can distinguish a retryable outage from a bad
+request — instead of a generic ``500``.
 
 Auth (:88-131): ``accessKey`` query param, else HTTP Basic user part;
 ``channel`` query param selects a named channel. Event-name whitelists on
@@ -35,9 +42,9 @@ from http.server import BaseHTTPRequestHandler
 from typing import Any, Mapping
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.api.http_base import RestServer
+from predictionio_tpu.api.http_base import RestServer, bounded_probe
 from predictionio_tpu.api.plugins import EventInfo, EventServerPluginContext
-from predictionio_tpu.api.stats import StatsKeeper
+from predictionio_tpu.api.stats import StatsKeeper, resilience_snapshot
 from predictionio_tpu.api.webhooks import (
     FORM_CONNECTORS,
     JSON_CONNECTORS,
@@ -52,6 +59,11 @@ from predictionio_tpu.core.json_codec import (
 )
 from predictionio_tpu.storage.base import EventFilter
 from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.utils.resilience import (
+    STORAGE_UNAVAILABLE_ERRORS,
+    deadline_scope,
+    retry_after_hint,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -82,7 +94,8 @@ class _Reject(Exception):
         self.message = message
 
 
-Response = tuple[int, Any]  # (HTTP status, JSON-serializable body)
+#: (HTTP status, JSON body) or (status, body, extra response headers)
+Response = tuple
 
 
 class EventService:
@@ -134,6 +147,28 @@ class EventService:
     # -- route handlers ------------------------------------------------------
     def alive(self) -> Response:
         return 200, {"status": "alive"}
+
+    def healthz(self) -> Response:
+        """Liveness: the process answers; nothing else implied."""
+        return 200, {"status": "ok"}
+
+    def readyz(self) -> Response:
+        """Readiness: the metadata store answers a cheap keyed read.
+        503 + Retry-After while the backend is down (or its breaker
+        open) so load balancers drain this replica instead of feeding
+        it traffic that will 503 anyway."""
+        def probe() -> None:
+            # inner deadline stops retry sleeps; bounded_probe walls off
+            # a blackholed backend's socket timeout
+            with deadline_scope(1.0):
+                self.access_keys.get("__readyz_probe__")
+
+        err = bounded_probe(probe, timeout=1.0)
+        if err is not None:
+            return (503,
+                    {"status": "unavailable", "storage": f"{err}"},
+                    {"Retry-After": f"{retry_after_hint(err):.0f}"})
+        return 200, {"status": "ready", "storage": "ok"}
 
     def plugins_json(self) -> Response:
         return 200, self.plugin_context.describe()
@@ -261,6 +296,10 @@ class EventService:
                 continue
             try:
                 event_id = self.events.insert(event, auth.app_id, auth.channel_id)
+            except STORAGE_UNAVAILABLE_ERRORS as exc:
+                # retryable outage, not a bad event: clients resubmit
+                results.append({"status": 503, "message": str(exc)})
+                continue
             except Exception as exc:  # per-event insert failure (scala :440-444)
                 results.append({"status": 500, "message": str(exc)})
                 continue
@@ -280,7 +319,11 @@ class EventService:
             return 404, {
                 "message": "To see stats, launch Event Server with --stats argument."
             }
-        return 200, self.stats.get(auth.app_id)
+        doc = self.stats.get(auth.app_id)
+        snap = resilience_snapshot()
+        if snap:
+            doc["resilience"] = snap
+        return 200, doc
 
     def post_webhook(
         self,
@@ -330,6 +373,10 @@ class EventService:
         try:
             if path == "/" and method == "GET":
                 return self.alive()
+            if path == "/healthz" and method == "GET":
+                return self.healthz()
+            if path == "/readyz" and method == "GET":
+                return self.readyz()
             if path == "/plugins.json" and method == "GET":
                 return self.plugins_json()
             if path == "/events.json":
@@ -362,6 +409,13 @@ class EventService:
             return 404, {"message": "Not Found"}
         except _Reject as r:
             return r.status, {"message": r.message}
+        except STORAGE_UNAVAILABLE_ERRORS as exc:
+            # a flaky/unreachable backend is a retryable outage, not a
+            # server bug: 503 + Retry-After (never a bare 500)
+            logger.warning("storage unavailable handling %s %s: %s",
+                           method, path, exc)
+            return (503, {"message": f"storage unavailable: {exc}"},
+                    {"Retry-After": f"{retry_after_hint(exc):.0f}"})
         except Exception as exc:  # Common.exceptionHandler parity
             logger.exception("internal error handling %s %s", method, path)
             return 500, {"message": str(exc)}
@@ -392,11 +446,14 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError:
             return _MALFORMED
 
-    def _respond(self, status: int, payload: Any) -> None:
+    def _respond(self, status: int, payload: Any,
+                 extra_headers: Mapping[str, str] | None = None) -> None:
         data = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json; charset=UTF-8")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -406,10 +463,10 @@ class _Handler(BaseHTTPRequestHandler):
         if body is _MALFORMED:
             self._respond(400, {"message": "the request body is not valid JSON"})
             return
-        status, payload = self.service.handle(
+        result = self.service.handle(
             method, path, self._params(), dict(self.headers.items()), body
         )
-        self._respond(status, payload)
+        self._respond(*result)
 
     def do_GET(self) -> None:  # noqa: N802
         self._dispatch("GET")

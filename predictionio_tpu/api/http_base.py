@@ -10,11 +10,13 @@ background-thread or blocking serve, and clean shutdown.
 from __future__ import annotations
 
 import logging
+import random
 import sys
 import threading
 import time
 from http.server import ThreadingHTTPServer
 
+from predictionio_tpu.utils.resilience import RetryPolicy
 from predictionio_tpu.utils.ssl_config import maybe_enable_ssl
 
 logger = logging.getLogger(__name__)
@@ -46,6 +48,33 @@ class _PioHTTPServer(ThreadingHTTPServer):
         super().handle_error(request, client_address)
 
 
+def bounded_probe(fn, timeout: float = 1.0) -> BaseException | None:
+    """Run a readiness probe with a HARD wall-clock bound.
+
+    ``deadline_scope`` only suppresses retry sleeps — a blackholed
+    backend still blocks one attempt for its own socket timeout (10-60s
+    on these backends), which would park a handler thread per probe.
+    The probe runs on a daemon thread instead; this returns within
+    ``timeout`` regardless. Returns None on success, the probe's
+    exception on failure, or a TimeoutError if it outlived the bound
+    (the abandoned thread unblocks on its socket timeout and exits)."""
+    result: list[BaseException | None] = []
+
+    def run() -> None:
+        try:
+            fn()
+            result.append(None)
+        except Exception as exc:  # noqa: BLE001 — reported, not raised
+            result.append(exc)
+
+    t = threading.Thread(target=run, name="pio-readyz-probe", daemon=True)
+    t.start()
+    t.join(timeout)
+    if not result:
+        return TimeoutError(f"probe exceeded {timeout:.1f}s")
+    return result[0]
+
+
 class RestServer:
     """Subclasses set ``log_label``/``thread_name`` and may override the
     bind-failure and close hooks."""
@@ -53,11 +82,21 @@ class RestServer:
     log_label = "Server"
     thread_name = "pio-server"
     bind_retries = 1
+    #: jittered exponential DELAY SCHEDULE between bind attempts (equal
+    #: jitter: uniform(cap/2, cap) — parallel servers racing for the
+    #: same port don't retry in lockstep the way the old fixed 1s sleep
+    #: made them, while the floor still guarantees enough total wait,
+    #: >=1.5s over two retries, for a stopping predecessor to release
+    #: the port). The attempt COUNT is ``bind_retries`` above; this
+    #: policy's max_attempts is not consulted.
+    bind_backoff = RetryPolicy(base_delay=1.0, max_delay=2.0,
+                               jitter_floor=0.5)
 
     def __init__(self, handler_cls: type, service, ip: str, port: int):
         self.ip = ip
         self.service = service
         handler = type("BoundHandler", (handler_cls,), {"service": service})
+        rng = random.Random()
         for attempt in range(self.bind_retries):
             try:
                 self._httpd = _PioHTTPServer((ip, port), handler)
@@ -66,7 +105,10 @@ class RestServer:
                 if attempt == self.bind_retries - 1:
                     raise
                 self._on_bind_failure(attempt, ip, port)
-                time.sleep(1.0)
+                delay = self.bind_backoff.backoff(attempt, rng)
+                logger.info("%s bind attempt %d failed; retrying in %.2fs",
+                            self.log_label, attempt + 1, delay)
+                time.sleep(delay)
         maybe_enable_ssl(self._httpd)
         self._thread: threading.Thread | None = None
 

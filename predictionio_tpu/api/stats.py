@@ -5,6 +5,10 @@ Parity: data/src/main/scala/.../data/api/{Stats.scala:30-82,
 StatsActor.scala} — the reference rotates a ``Stats`` per hour inside
 ``StatsActor``; here ``StatsKeeper`` owns the rotation under a lock
 instead of an actor mailbox.
+
+Beyond reference: :func:`resilience_snapshot` surfaces the per-backend
+retry/circuit-breaker counters (utils/resilience registry) so both
+servers' stats/status documents show backend health alongside traffic.
 """
 
 from __future__ import annotations
@@ -16,6 +20,15 @@ from datetime import datetime, timezone
 
 from predictionio_tpu.core.event import Event
 from predictionio_tpu.core.json_codec import format_datetime
+
+
+def resilience_snapshot() -> dict:
+    """Per-backend resilience counters: attempts, retries, failures,
+    short-circuits, breaker state/opens — keyed by policy name
+    (``<backend>/<source>``). Empty until a resilient backend is used."""
+    from predictionio_tpu.utils.resilience import registry_snapshot
+
+    return registry_snapshot()
 
 
 @dataclasses.dataclass(frozen=True)

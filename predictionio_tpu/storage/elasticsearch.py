@@ -24,7 +24,12 @@ scrolled pages — the conformance semantics match every other backend.
 
 Config properties: ``HOSTS`` (comma list, default ``localhost``),
 ``PORTS`` (default ``9200``), ``SCHEMES`` (default ``http``), ``INDEX``
-(prefix, default ``pio``), ``USERNAME``/``PASSWORD`` (basic auth).
+(prefix, default ``pio``), ``USERNAME``/``PASSWORD`` (basic auth), plus
+the ``RETRY_*``/``BREAKER_*`` resilience knobs
+(docs/operations-resilience.md). Every HTTP round trip routes through
+``resilient()``: connection errors and 5xx responses retry with jittered
+backoff and feed the per-source circuit breaker; non-transient HTTP
+errors (4xx) surface unchanged as :class:`ESError`.
 """
 
 from __future__ import annotations
@@ -51,6 +56,12 @@ from predictionio_tpu.storage.base import (
     EventFilter,
     StorageClientConfig,
 )
+from predictionio_tpu.utils.resilience import (
+    Resilience,
+    TransientError,
+    is_transient_http_status,
+    resilient,
+)
 
 
 class ESError(RuntimeError):
@@ -68,6 +79,7 @@ class ESClient:
         username: str = "",
         password: str = "",
         timeout: float = 10.0,
+        resilience: Resilience | None = None,
     ):
         self._base = f"{scheme}://{host}:{port}"
         self._timeout = timeout
@@ -75,8 +87,15 @@ class ESClient:
         if username:
             token = base64.b64encode(f"{username}:{password}".encode()).decode()
             self._headers["Authorization"] = f"Basic {token}"
+        self._resilience = resilience or Resilience("elasticsearch")
 
     def request(self, method: str, path: str, body: Any = None) -> dict | None:
+        return resilient(self._resilience, self._raw_request, method, path, body)
+
+    def _raw_request(self, method: str, path: str, body: Any = None) -> dict | None:
+        """One HTTP round trip. Only reachable through ``resilient()``:
+        transport failures and 5xx raise TransientError (retried under
+        the policy), 4xx raise ESError (application errors, no retry)."""
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             self._base + path, data=data, method=method, headers=self._headers
@@ -87,9 +106,13 @@ class ESClient:
         except urllib.error.HTTPError as exc:
             if exc.code == 404:
                 return None
+            if is_transient_http_status(exc.code):
+                raise TransientError(
+                    f"{method} {path}: HTTP {exc.code}") from exc
             raise ESError(f"{method} {path}: HTTP {exc.code}") from exc
         except urllib.error.URLError as exc:
-            raise ESError(f"{method} {path}: {exc.reason}") from exc
+            # connection refused / DNS / timeout: the retryable class
+            raise TransientError(f"{method} {path}: {exc.reason}") from exc
         return json.loads(payload) if payload else {}
 
     # -- document ops -------------------------------------------------------
@@ -406,12 +429,15 @@ class ESStorageClient(base.BaseStorageClient):
         host = props.get("HOSTS", "localhost").split(",")[0]
         port = int(props.get("PORTS", "9200").split(",")[0])
         scheme = props.get("SCHEMES", "http").split(",")[0]
+        source = props.get("SOURCE_NAME", f"{host}:{port}")
         self._client = ESClient(
             host=host,
             port=port,
             scheme=scheme,
             username=props.get("USERNAME", ""),
             password=props.get("PASSWORD", ""),
+            resilience=Resilience.from_properties(
+                f"elasticsearch/{source}", props),
         )
         prefix = props.get("INDEX", "pio")
         meta = f"{prefix}_meta"

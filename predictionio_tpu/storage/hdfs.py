@@ -13,10 +13,15 @@ durability discipline a shared filesystem needs:
   host crash (NFS close-to-open consistency makes this observable to
   other hosts — e.g. a trainer writing a model that a serving host on
   another VM loads);
-- reads retry once on ESTALE-style transient errors.
+- every operation routes through ``resilient()``: ESTALE/EIO-class
+  transient errors retry with jittered backoff under the shared
+  RetryPolicy (replacing the old hand-rolled retry-once) and feed the
+  per-source circuit breaker.
 
 Config properties: ``PATH`` (mount-point directory; default
-``~/.pio_store/hdfs_models``), ``PREFIX`` (file-name prefix).
+``~/.pio_store/hdfs_models``), ``PREFIX`` (file-name prefix), plus the
+``RETRY_*``/``BREAKER_*`` resilience knobs
+(docs/operations-resilience.md).
 """
 
 from __future__ import annotations
@@ -26,6 +31,15 @@ import os
 
 from predictionio_tpu.storage import base
 from predictionio_tpu.storage.base import Model, StorageClientConfig
+from predictionio_tpu.utils.resilience import Resilience, resilient
+
+#: errno values a shared network filesystem emits transiently (stale NFS
+#: handle between open and read; EIO on a flapping mount)
+_TRANSIENT_ERRNOS = (errno.ESTALE, errno.EIO)
+
+
+def _is_transient_fs_error(exc: BaseException) -> bool:
+    return isinstance(exc, OSError) and exc.errno in _TRANSIENT_ERRNOS
 
 
 def _fsync_dir(path: str) -> None:
@@ -40,9 +54,12 @@ def _fsync_dir(path: str) -> None:
 
 
 class NetworkFSModels(base.Models):
-    def __init__(self, path: str, prefix: str = ""):
+    def __init__(self, path: str, prefix: str = "",
+                 resilience: Resilience | None = None):
         self._path = path
         self._prefix = prefix
+        self._resilience = resilience or Resilience(
+            "hdfs", classify=_is_transient_fs_error)
         os.makedirs(path, exist_ok=True)
 
     def _file(self, model_id: str) -> str:
@@ -50,6 +67,9 @@ class NetworkFSModels(base.Models):
         return os.path.join(self._path, f"{self._prefix}{safe}")
 
     def insert(self, model: Model) -> None:
+        resilient(self._resilience, self._write, model)
+
+    def _write(self, model: Model) -> None:
         target = self._file(model.id)
         tmp = target + ".tmp"
         with open(tmp, "wb") as f:
@@ -60,20 +80,19 @@ class NetworkFSModels(base.Models):
         _fsync_dir(self._path)
 
     def get(self, model_id: str) -> Model | None:
-        for attempt in (0, 1):
-            try:
-                with open(self._file(model_id), "rb") as f:
-                    return Model(model_id, f.read())
-            except FileNotFoundError:
-                return None
-            except OSError as exc:
-                # NFS handle went stale between open and read — retry once
-                if attempt == 0 and exc.errno in (errno.ESTALE, errno.EIO):
-                    continue
-                raise
-        return None
+        return resilient(self._resilience, self._read, model_id)
+
+    def _read(self, model_id: str) -> Model | None:
+        try:
+            with open(self._file(model_id), "rb") as f:
+                return Model(model_id, f.read())
+        except FileNotFoundError:
+            return None
 
     def delete(self, model_id: str) -> None:
+        resilient(self._resilience, self._remove, model_id)
+
+    def _remove(self, model_id: str) -> None:
         try:
             os.remove(self._file(model_id))
         except FileNotFoundError:
@@ -88,12 +107,16 @@ class HDFSStorageClient(base.BaseStorageClient):
 
     def __init__(self, config: StorageClientConfig = StorageClientConfig()):
         super().__init__(config)
-        path = config.properties.get(
+        props = config.properties
+        path = props.get(
             "PATH",
             os.path.join(os.path.expanduser("~"), ".pio_store", "hdfs_models"),
         )
+        source = props.get("SOURCE_NAME", os.path.abspath(path))
         self._models = NetworkFSModels(
-            os.path.abspath(path), config.properties.get("PREFIX", "")
+            os.path.abspath(path), props.get("PREFIX", ""),
+            resilience=Resilience.from_properties(
+                f"hdfs/{source}", props, classify=_is_transient_fs_error),
         )
 
     def models(self) -> NetworkFSModels:

@@ -88,6 +88,15 @@ class PGProtocolError(Exception):
     """Malformed or unexpected protocol traffic."""
 
 
+def _open_socket(host: str, port: int, timeout: float) -> socket.socket:
+    """The module's single raw network call site. Connection
+    establishment is routed through ``resilient()`` by the pool layer
+    (storage/postgres.py ``_PGPool._connect``) — the retry/breaker
+    policy lives there, not here, so one policy covers socket + auth
+    (enforced by tests/test_resilience_static.py)."""
+    return socket.create_connection((host, port), timeout=timeout)
+
+
 def quote_literal(value) -> str:
     """SQL literal for client-side binding under the simple protocol.
 
@@ -181,7 +190,7 @@ class PGConnection:
         self.user = user
         self.password = password
         self._lock = threading.Lock()
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock = _open_socket(host, port, timeout)
         self._buf = b""
         self.parameters: dict[str, str] = {}   # ParameterStatus reports
         try:

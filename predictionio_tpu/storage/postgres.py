@@ -18,7 +18,11 @@ handles (42P01 "relation does not exist" -> OperationalError carrying
 "no such table" for the auto-init path; 23xxx -> IntegrityError).
 
 Config (PIO_STORAGE_SOURCES_<NAME>_*): HOST (localhost), PORT (5432),
-USERNAME (pio), PASSWORD, DATABASE (pio). Conformance-tested over the
+USERNAME (pio), PASSWORD, DATABASE (pio), plus RETRY_*/BREAKER_*
+resilience knobs (docs/operations-resilience.md) — connection
+establishment retries with jittered backoff and feeds a circuit
+breaker; query cycles are never auto-retried (no idempotency guarantee
+under the simple protocol). Conformance-tested over the
 real wire protocol against the in-process emulator
 (tests/pg_emulator.py) — see docs/storage.md for what that does and
 does not prove in a zero-egress environment.
@@ -34,6 +38,7 @@ import threading
 from predictionio_tpu.storage import base, sqlite as sq
 from predictionio_tpu.storage.base import StorageClientConfig
 from predictionio_tpu.storage.pgwire import PGConnection, PGError
+from predictionio_tpu.utils.resilience import Resilience, resilient
 
 _AUTOINC = re.compile(r"INTEGER PRIMARY KEY AUTOINCREMENT", re.IGNORECASE)
 _BLOB = re.compile(r"\bBLOB\b", re.IGNORECASE)
@@ -87,14 +92,26 @@ class _PGPool:
     BORROW_TIMEOUT = 60.0
 
     def __init__(self, host: str, port: int, user: str,
-                 password: str | None, database: str):
+                 password: str | None, database: str,
+                 resilience: Resilience | None = None):
         self._args = (host, port, user, database, password)
         self._pool: "queue.Queue[PGConnection]" = queue.Queue()
         self._created = 0
         self._lock = threading.Lock()
         self._closed = False
+        # connection ESTABLISHMENT is the resilient boundary: a down
+        # server manifests here, and a fresh connect is always safe to
+        # retry. Query cycles are NOT retried — the simple protocol
+        # gives no idempotency guarantee for a re-sent INSERT — so
+        # retryable covers OSError (refused/reset/timeout), while
+        # PGError (bad auth, SQL errors) passes through untouched.
+        self._resilience = resilience or Resilience(
+            "postgres", retryable=(OSError,))
 
     def _connect(self) -> PGConnection:
+        return resilient(self._resilience, self._open_connection)
+
+    def _open_connection(self) -> PGConnection:
         host, port, user, database, password = self._args
         return PGConnection(host, port, user=user, database=database,
                             password=password)
@@ -220,12 +237,17 @@ class PGStorageClient(base.BaseStorageClient):
     def __init__(self, config: StorageClientConfig = StorageClientConfig()):
         super().__init__(config)
         p = config.properties
+        host = p.get("HOST", "localhost")
+        port = int(p.get("PORT", "5432"))
+        source = p.get("SOURCE_NAME", f"{host}:{port}")
         self._conn = _PGPool(
-            host=p.get("HOST", "localhost"),
-            port=int(p.get("PORT", "5432")),
+            host=host,
+            port=port,
             user=p.get("USERNAME", "pio"),
             password=p.get("PASSWORD"),
             database=p.get("DATABASE", "pio"),
+            resilience=Resilience.from_properties(
+                f"postgres/{source}", p, retryable=(OSError,)),
         )
         self._lock = threading.RLock()
         self._cache: dict[str, object] = {}

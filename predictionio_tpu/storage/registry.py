@@ -111,6 +111,11 @@ def _builtin_backends() -> None:
     # was metadata-only — this one is a superset).
     _BACKENDS.setdefault("elasticsearch", ESStorageClient)
     _BACKENDS.setdefault("elasticsearch1", ESStorageClient)
+    # fault-injection wrapper around any registered backend (TARGET_TYPE
+    # + forwarded TARGET_* props) — chaos-test the whole stack end to end
+    from predictionio_tpu.storage.chaos import ChaosStorageClient
+
+    _BACKENDS.setdefault("chaos", ChaosStorageClient)
 
 
 class Storage:
@@ -192,6 +197,8 @@ class Storage:
                 if k.startswith(prefix) and k != type_key
                 and not any(k.startswith(lp) for lp in longer)
             }
+            # backends label their resilience metrics/breakers by source
+            props.setdefault("SOURCE_NAME", name)
             sources[name] = (
                 self._env[type_key],
                 StorageClientConfig(

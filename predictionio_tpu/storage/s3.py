@@ -13,7 +13,12 @@ Config properties:
   ``REGION`` (default ``us-east-1``), ``ENDPOINT`` (default
   ``https://s3.<region>.amazonaws.com``; path-style addressing is used so
   custom endpoints work), ``ACCESS_KEY_ID`` / ``SECRET_ACCESS_KEY``
-  (fall back to ``AWS_ACCESS_KEY_ID`` / ``AWS_SECRET_ACCESS_KEY`` env).
+  (fall back to ``AWS_ACCESS_KEY_ID`` / ``AWS_SECRET_ACCESS_KEY`` env),
+  plus the ``RETRY_*``/``BREAKER_*`` resilience knobs
+  (docs/operations-resilience.md). Every object round trip routes
+  through ``resilient()``: transport failures and 5xx retry with
+  jittered backoff and feed the circuit breaker; 404 and other 4xx pass
+  through unchanged for the callers' not-found handling.
 """
 
 from __future__ import annotations
@@ -28,6 +33,12 @@ import urllib.request
 
 from predictionio_tpu.storage import base
 from predictionio_tpu.storage.base import Model, StorageClientConfig
+from predictionio_tpu.utils.resilience import (
+    Resilience,
+    TransientError,
+    is_transient_http_status,
+    resilient,
+)
 
 
 class S3Error(RuntimeError):
@@ -113,6 +124,7 @@ class S3Models(base.Models):
         access_key: str | None = None,
         secret_key: str | None = None,
         timeout: float = 30.0,
+        resilience: Resilience | None = None,
     ):
         self._bucket = bucket
         self._base_path = base_path.strip("/")
@@ -121,6 +133,7 @@ class S3Models(base.Models):
         self._access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
         self._secret_key = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", "")
         self._timeout = timeout
+        self._resilience = resilience or Resilience("s3")
 
     def _url(self, model_id: str) -> str:
         safe = urllib.parse.quote(model_id, safe="")
@@ -128,6 +141,14 @@ class S3Models(base.Models):
         return f"{self._endpoint}/{self._bucket}/{key}"
 
     def _request(self, method: str, model_id: str, payload: bytes = b""):
+        return resilient(
+            self._resilience, self._raw_request, method, model_id, payload)
+
+    def _raw_request(self, method: str, model_id: str, payload: bytes = b""):
+        """One signed object round trip. Only reachable through
+        ``resilient()``: transport failures and 5xx raise TransientError
+        (retried under the policy); 4xx — including the 404s the callers
+        map to not-found — pass through untouched."""
         url = self._url(model_id)
         headers = {}
         if self._access_key:
@@ -136,7 +157,15 @@ class S3Models(base.Models):
             )
         req = urllib.request.Request(url, data=payload or None, method=method,
                                      headers=headers)
-        return urllib.request.urlopen(req, timeout=self._timeout)
+        try:
+            return urllib.request.urlopen(req, timeout=self._timeout)
+        except urllib.error.HTTPError as exc:
+            if is_transient_http_status(exc.code):
+                raise TransientError(
+                    f"{method} {model_id}: HTTP {exc.code}") from exc
+            raise
+        except urllib.error.URLError as exc:
+            raise TransientError(f"{method} {model_id}: {exc.reason}") from exc
 
     def insert(self, model: Model) -> None:
         with self._request("PUT", model.id, model.models) as resp:
@@ -170,6 +199,7 @@ class S3StorageClient(base.BaseStorageClient):
         bucket = props.get("BUCKET_NAME")
         if not bucket:
             raise S3Error("s3 storage source requires a BUCKET_NAME property")
+        source = props.get("SOURCE_NAME", bucket)
         self._models = S3Models(
             bucket=bucket,
             base_path=props.get("BASE_PATH", ""),
@@ -177,6 +207,7 @@ class S3StorageClient(base.BaseStorageClient):
             endpoint=props.get("ENDPOINT"),
             access_key=props.get("ACCESS_KEY_ID"),
             secret_key=props.get("SECRET_ACCESS_KEY"),
+            resilience=Resilience.from_properties(f"s3/{source}", props),
         )
 
     def models(self) -> S3Models:

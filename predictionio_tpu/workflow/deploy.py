@@ -16,15 +16,23 @@ no per-query compilation or device handoff beyond the query tensors.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import threading
 import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Sequence
 
 from predictionio_tpu.controller.engine import Engine, resolve_engine_factory
 from predictionio_tpu.storage.base import EngineInstance
 from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.utils.resilience import (
+    deadline_scope,
+    record_fallback,
+    remaining_deadline,
+)
 from predictionio_tpu.workflow.context import EngineContext, WorkflowParams
 from predictionio_tpu.workflow.persistence import load_models
 
@@ -60,6 +68,25 @@ class ServerConfig:
     batching: bool = False
     batch_max: int = 64
     batch_wait_ms: float = 5.0
+    #: graceful degradation (beyond reference): per-request time budget
+    #: for /queries.json. Propagated as the ambient resilience deadline
+    #: (utils/resilience.deadline_scope — storage retries stop sleeping
+    #: when the budget can't cover them) and into QueryBatcher.submit.
+    #: Clients may lower it per request with an X-PIO-Deadline-Ms
+    #: header; exhaustion maps to 503 + Retry-After, not a hung socket.
+    #: 0 disables (legacy behavior: 300s batcher wait, no deadline).
+    request_deadline_ms: float = 0.0
+
+
+class QueryDeadlineExceeded(RuntimeError):
+    """A query's time budget expired while WAITING for its result — as
+    distinct from the work itself raising TimeoutError (which, on
+    Python 3.11+, is the same class as concurrent.futures.TimeoutError
+    and must not be misreported as a blown deadline)."""
+
+    def __init__(self, budget: float):
+        super().__init__(f"query deadline exceeded ({budget:.3f}s budget)")
+        self.budget = budget
 
 
 class DeployedEngine:
@@ -235,13 +262,18 @@ class QueryBatcher:
         self._thread.start()
 
     def submit(self, query: Any, timeout: float = 300.0) -> Any:
-        """Enqueue and wait; raises whatever the predict path raised."""
-        from concurrent.futures import Future
+        """Enqueue and wait; raises whatever the predict path raised.
 
+        The caller's ambient resilience deadline (deadline_scope) rides
+        along into the dispatcher thread — contextvars do not cross
+        threads, so the remaining budget is captured here and re-entered
+        around the batch dispatch and any per-query fallbacks."""
         if self._stopped:
             raise RuntimeError("query batcher is stopped")
+        rem = remaining_deadline()
+        deadline = time.monotonic() + rem if rem is not None else None
         fut: Future = Future()
-        self._queue.put((query, fut))
+        self._queue.put((query, fut, deadline))
         if self._stopped and not fut.done():
             # close() raced the enqueue: the dispatcher (or close's
             # drain) may never see this entry — fail fast instead of
@@ -251,7 +283,15 @@ class QueryBatcher:
                 fut.set_exception(RuntimeError("query batcher is stopped"))
             except Exception:
                 pass
-        return fut.result(timeout=timeout)
+        try:
+            return fut.result(timeout=timeout)
+        except FuturesTimeoutError:
+            if not fut.done():
+                # the WAIT expired (a blown budget) — not an exception
+                # from the predict path, which fut.done() distinguishes
+                # even on 3.11 where the two classes are aliased
+                raise QueryDeadlineExceeded(timeout) from None
+            raise
 
     def close(self) -> None:
         self._stopped = True
@@ -271,7 +311,7 @@ class QueryBatcher:
                 return
             if item is None:
                 continue
-            _, fut = item
+            _, fut, _ = item
             if not fut.done():
                 try:
                     fut.set_exception(
@@ -303,11 +343,22 @@ class QueryBatcher:
                 batch.append(nxt)
             self._finish(batch)
 
+    @staticmethod
+    def _scope(deadline_abs: float | None):
+        """Re-enter a caller's deadline (absolute monotonic) on the
+        dispatcher thread; nested scopes only ever shrink."""
+        if deadline_abs is None:
+            return contextlib.nullcontext()
+        return deadline_scope(max(0.0, deadline_abs - time.monotonic()))
+
     def _finish(self, batch) -> None:
         deployed = self._get_deployed()
+        deadlines = [d for _, _, d in batch if d is not None]
         try:
-            results = deployed.query_batch([q for q, _ in batch])
-            for (_, fut), served in zip(batch, results):
+            # the batch shares one dispatch: honor its tightest deadline
+            with self._scope(min(deadlines) if deadlines else None):
+                results = deployed.query_batch([q for q, _, _ in batch])
+            for (_, fut, _), served in zip(batch, results):
                 fut.set_result(served)
             self.batches += 1
             self.batched_queries += len(batch)
@@ -315,10 +366,15 @@ class QueryBatcher:
             logger.exception(
                 "batched predict failed; retrying %d queries individually",
                 len(batch))
-            for q, fut in batch:
+            record_fallback("serving/query-batcher")
+            for q, fut, deadline in batch:
                 if fut.done():
                     continue
                 try:
-                    fut.set_result(deployed.query(q))
+                    # re-resolve per query: a /reload mid-batch must not
+                    # pin the whole fallback pass to the dead instance
+                    # the batch dispatch captured
+                    with self._scope(deadline):
+                        fut.set_result(self._get_deployed().query(q))
                 except Exception as e:          # noqa: BLE001
                     fut.set_exception(e)

@@ -1,0 +1,556 @@
+"""Chaos-backend tests: the storage conformance suite under seeded fault
+injection, plus the end-to-end survival scenario from the resilience
+acceptance criteria — 30% transient faults, zero lost events, zero 500s
+(503s allowed while the breaker is open), deterministic breaker
+transitions on the injectable clock, /reload keeping last-known-good,
+and the per-request deadline budget."""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.storage.base import StorageClientConfig
+from predictionio_tpu.storage.chaos import ChaosError, ChaosStorageClient
+from predictionio_tpu.storage.memory import MemoryStorageClient
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.storage.sqlite import SQLiteStorageClient
+from predictionio_tpu.utils.resilience import (
+    CircuitBreaker,
+    ManualClock,
+    Resilience,
+    RetryPolicy,
+    StorageUnavailableError,
+)
+
+# the full storage conformance surface, re-run against chaos-wrapped
+# backends (pytest resolves our module-local fixtures for the inherited
+# test methods) — any injected fault escaping the resilience layer, or
+# any lost/duplicated write, fails the same assertions every other
+# backend must satisfy
+from test_storage_conformance import (  # noqa: F401
+    TestAccessKeys,
+    TestApps,
+    TestChannels,
+    TestEngineInstances,
+    TestEvaluationInstances,
+    TestEvents,
+    TestModels,
+    ev,
+)
+
+pytestmark = pytest.mark.chaos
+
+#: one fixed seed for the whole module: the fault sequence — and thus
+#: every retry path these tests exercise — is identical on every run
+SEED = 20260803
+
+
+def _chaos_client(kind: str, tmp_path) -> ChaosStorageClient:
+    if kind == "chaos_memory":
+        inner = MemoryStorageClient()
+    else:
+        inner = SQLiteStorageClient(
+            StorageClientConfig(properties={"PATH": str(tmp_path / "pio.sqlite")})
+        )
+    return ChaosStorageClient.wrap(inner, fault_rate=0.3, seed=SEED)
+
+
+@pytest.fixture(params=["chaos_memory", "chaos_sqlite"])
+def client(request, tmp_path):
+    c = _chaos_client(request.param, tmp_path)
+    yield c
+    c.close()
+
+
+@pytest.fixture(params=["chaos_memory", "chaos_sqlite"])
+def events_client(request, tmp_path):
+    c = _chaos_client(request.param, tmp_path)
+    yield c
+    c.close()
+
+
+class TestChannels(TestChannels):  # noqa: F811 — shadow the import
+    """The sqlite Channels DAO needs RETURNING (sqlite >= 3.35); on older
+    runtimes the PLAIN sqlite conformance test already fails identically,
+    so the chaos wrapper skips rather than double-reporting seed noise."""
+
+    @pytest.fixture(autouse=True)
+    def _skip_pre_returning_sqlite(self, request):
+        if ("chaos_sqlite" in request.node.name
+                and sqlite3.sqlite_version_info < (3, 35)):
+            pytest.skip("sqlite lacks RETURNING — known seed-level failure "
+                        "of the unwrapped sqlite backend")
+
+
+# ---------------------------------------------------------------------------
+# injector determinism + invariants
+# ---------------------------------------------------------------------------
+
+class TestChaosInjector:
+    def test_fault_sequence_is_deterministic(self):
+        from predictionio_tpu.storage.chaos import ChaosInjector
+
+        def stream(seed):
+            inj = ChaosInjector(fault_rate=0.4, seed=seed)
+            out = []
+            for _ in range(50):
+                try:
+                    inj.before("op")
+                    out.append(0)
+                except ChaosError:
+                    out.append(1)
+            return out
+
+        assert stream(7) == stream(7)
+        assert stream(7) != stream(8)
+        assert sum(stream(7)) > 0
+
+    def test_error_class_selection(self):
+        from predictionio_tpu.storage.chaos import ChaosInjector
+
+        inj = ChaosInjector(fault_rate=1.0, seed=0, error="connection")
+        with pytest.raises(ConnectionError):
+            inj.before("op")
+        with pytest.raises(ValueError, match="unknown chaos ERROR"):
+            ChaosInjector(error="nope")
+
+    def test_no_unwrapped_faults_and_no_data_loss(self):
+        """200 inserts at 35% fault rate: every accepted insert is
+        durably in the INNER store exactly once (faults fire before the
+        inner op, so retries never duplicate), and no raw ChaosError
+        crosses the resilience layer."""
+        inner = MemoryStorageClient()
+        c = ChaosStorageClient.wrap(inner, fault_rate=0.35, seed=99)
+        events = c.events()
+        events.init(1)
+        ids = [events.insert(ev(entity=f"u{i}", minutes=i), 1)
+               for i in range(200)]
+        assert c.injector.faults_injected > 0       # chaos was active
+        raw = [e.event_id for e in inner.events().find(1)]
+        assert sorted(raw) == sorted(ids)
+        assert len(ids) == len(set(ids)) == 200
+
+
+class TestChaosRegistryIntegration:
+    def test_chaos_source_wraps_target_type(self, tmp_path):
+        env = {
+            "PIO_STORAGE_SOURCES_C_TYPE": "chaos",
+            "PIO_STORAGE_SOURCES_C_TARGET": "sqlite",
+            "PIO_STORAGE_SOURCES_C_TARGET_PATH": str(tmp_path / "pio.sqlite"),
+            "PIO_STORAGE_SOURCES_C_FAULT_RATE": "0.3",
+            "PIO_STORAGE_SOURCES_C_SEED": str(SEED),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "C",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "C",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "C",
+        }
+        storage = Storage(env)
+        storage.verify_all_data_objects()
+        client = storage.client_for_source("C")
+        assert isinstance(client, ChaosStorageClient)
+        assert client.injector.seed == SEED
+        eid = storage.get_events().insert(ev(), 1)
+        assert storage.get_events().get(eid, 1) is not None
+        storage.close()
+
+    def test_missing_target_rejected(self):
+        with pytest.raises(ValueError, match="TARGET"):
+            ChaosStorageClient(StorageClientConfig(properties={}))
+
+
+# ---------------------------------------------------------------------------
+# deterministic breaker transitions driven through the chaos backend
+# ---------------------------------------------------------------------------
+
+class TestBreakerTransitionsThroughChaos:
+    def test_open_half_open_closed_on_manual_clock(self):
+        clock = ManualClock()
+        resilience = Resilience(
+            "chaos-breaker-test",
+            policy=RetryPolicy(max_attempts=1),   # surface every fault
+            breaker=CircuitBreaker("chaos-breaker-test",
+                                   failure_threshold=2,
+                                   reset_timeout=30.0, clock=clock),
+            clock=clock,
+            register=False,
+        )
+        c = ChaosStorageClient.wrap(
+            MemoryStorageClient(), fault_rate=1.0, seed=5,
+            resilience=resilience, clock=clock)
+        apps = c.apps()
+
+        for _ in range(2):                        # two faults -> open
+            with pytest.raises(StorageUnavailableError):
+                apps.get(1)
+        assert resilience.breaker.state == "open"
+
+        attempts_before = resilience.snapshot()["attempts"]
+        with pytest.raises(StorageUnavailableError) as e:
+            apps.get(1)                           # short-circuited
+        assert resilience.snapshot()["attempts"] == attempts_before
+        assert resilience.snapshot()["short_circuits"] == 1
+        assert e.value.retry_after == pytest.approx(30.0)
+
+        clock.advance(30.0)
+        assert resilience.breaker.state == "half_open"
+        c.injector.fault_rate = 0.0               # backend recovers
+        assert apps.get(1) is None                # probe succeeds
+        assert resilience.breaker.state == "closed"
+        assert resilience.breaker.opens == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end survival: both servers over a 30%-fault chaos store
+# ---------------------------------------------------------------------------
+
+def _chaos_storage(tmp_path, fault_rate="0.3") -> Storage:
+    env = {
+        "PIO_STORAGE_SOURCES_C_TYPE": "chaos",
+        "PIO_STORAGE_SOURCES_C_TARGET": "sqlite",
+        "PIO_STORAGE_SOURCES_C_TARGET_PATH": str(tmp_path / "pio.sqlite"),
+        "PIO_STORAGE_SOURCES_C_FAULT_RATE": fault_rate,
+        "PIO_STORAGE_SOURCES_C_SEED": str(SEED),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "C",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "C",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "C",
+    }
+    return Storage(env)
+
+
+def _train(storage, mult=2):
+    from predictionio_tpu.controller import EngineParams
+    from predictionio_tpu.workflow.train import run_train
+    from tests.sample_engine import AlgoParams, DSParams
+
+    params = EngineParams.of(
+        data_source=DSParams(id=7, n_train=5),
+        algorithms=[("sample", AlgoParams(id=0, mult=mult))],
+    )
+    return run_train(
+        engine_factory="tests.sample_engine.engine_factory",
+        engine_params=params,
+        variant={"id": "sample-engine"},
+        storage=storage,
+    )
+
+
+def _post_json(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestEndToEndSurvival:
+    def test_ingest_and_serving_survive_30pct_faults(self, tmp_path):
+        """The acceptance scenario: seeded 30% transient faults on every
+        storage operation; event ingestion loses nothing, serving never
+        500s (503 + Retry-After is the only degradation allowed)."""
+        from predictionio_tpu.api.engine_server import create_engine_server
+        from predictionio_tpu.api.event_server import EventServer, EventServerConfig
+        from predictionio_tpu.storage.base import AccessKey, App
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        storage = _chaos_storage(tmp_path)
+        # setup writes also run through chaos (resilient underneath)
+        app_id = storage.get_meta_data_apps().insert(App(0, "chaosapp"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey("chaoskey", app_id, ()))
+        storage.get_events().init(app_id)
+
+        event_server = EventServer(
+            storage, EventServerConfig(ip="127.0.0.1", port=0))
+        event_server.start()
+        _train(storage, mult=3)
+        engine_server = create_engine_server(
+            storage=storage, config=ServerConfig(ip="127.0.0.1", port=0))
+        engine_server.start()
+        try:
+            ingest_url = (f"http://127.0.0.1:{event_server.port}"
+                          f"/events.json?accessKey=chaoskey")
+            accepted = 0
+            for i in range(60):
+                payload = {"event": "rate", "entityType": "user",
+                           "entityId": f"u{i}",
+                           "properties": {"rating": i % 5}}
+                for _ in range(20):               # clients retry 503s
+                    status, body = _post_json(ingest_url, payload)
+                    assert status in (201, 503), (
+                        f"event {i}: got {status} {body} — only 201 or "
+                        f"503 (breaker open) are acceptable, never a 500")
+                    if status == 201:
+                        accepted += 1
+                        break
+                    time.sleep(0.01)
+                else:
+                    pytest.fail(f"event {i} never accepted")
+            assert accepted == 60
+
+            # zero lost events: every accepted event is durably stored
+            stored = list(storage.get_events().find(app_id))
+            assert len(stored) == 60
+            assert {e.entity_id for e in stored} == {f"u{i}" for i in range(60)}
+
+            # serving: every query answers, none 500
+            query_url = f"http://127.0.0.1:{engine_server.port}/queries.json"
+            for x in range(30):
+                status, body = _post_json(query_url, {"x": x})
+                assert status in (200, 503), (status, body)
+                if status == 200:
+                    assert body["value"] == x * 3
+            # the steady-state predict path holds no storage dependency,
+            # so with a loaded model every query must in fact be a 200
+            assert status == 200
+
+            # both health surfaces answer over the chaotic store
+            for server in (event_server, engine_server):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{server.port}/healthz",
+                        timeout=10) as r:
+                    assert r.status == 200
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{server.port}/readyz",
+                        timeout=10) as r:
+                    assert r.status == 200
+
+            chaos_client = storage.client_for_source("C")
+            assert chaos_client.injector.faults_injected > 20
+        finally:
+            engine_server.stop()
+            event_server.stop()
+            storage.close()
+
+    def test_hard_outage_maps_to_503_with_retry_after(self, tmp_path):
+        """fault_rate=1.0 with a tight retry budget: ingest must degrade
+        to 503 + Retry-After — clients can tell an outage from a bad
+        request — never a 500."""
+        from predictionio_tpu.api.event_server import EventServer, EventServerConfig
+        from predictionio_tpu.storage.base import AccessKey, App
+
+        storage = _chaos_storage(tmp_path, fault_rate="0.0")
+        app_id = storage.get_meta_data_apps().insert(App(0, "outage"))
+        storage.get_meta_data_access_keys().insert(AccessKey("ok", app_id, ()))
+        storage.get_events().init(app_id)
+        server = EventServer(storage, EventServerConfig(ip="127.0.0.1", port=0))
+        server.start()
+        try:
+            chaos_client = storage.client_for_source("C")
+            chaos_client.injector.fault_rate = 1.0      # total outage
+            chaos_client.resilience.policy = RetryPolicy(
+                max_attempts=2, base_delay=0.001)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/events.json?accessKey=ok",
+                data=json.dumps({"event": "rate", "entityType": "user",
+                                 "entityId": "u1"}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 503
+            assert e.value.headers.get("Retry-After") is not None
+
+            # recovery: faults stop, the same request is accepted
+            chaos_client.injector.fault_rate = 0.0
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 201
+        finally:
+            server.stop()
+            storage.close()
+
+
+# ---------------------------------------------------------------------------
+# engine-server degradation: reload keeps last-known-good; deadlines
+# ---------------------------------------------------------------------------
+
+class TestServingDegradation:
+    def test_reload_failure_keeps_last_known_good(self, storage, monkeypatch):
+        import predictionio_tpu.api.engine_server as engine_server_mod
+        from predictionio_tpu.api.engine_server import create_engine_server
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        _train(storage, mult=2)
+        server = create_engine_server(
+            storage=storage, config=ServerConfig(ip="127.0.0.1", port=0))
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, r = _post_json(f"{base}/queries.json", {"x": 4})
+            assert (status, r["value"]) == (200, 8)
+            served_id = server.service.deployed.instance.id
+
+            def explode(**kwargs):
+                raise StorageUnavailableError("meta", "backend down", 2.0)
+
+            monkeypatch.setattr(engine_server_mod, "load_deployed_engine",
+                                explode)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/reload", timeout=10)
+            assert e.value.code == 503
+            assert e.value.headers.get("Retry-After") == "2"
+            assert "still serving" in json.loads(e.value.read())["message"]
+
+            # the old model keeps serving
+            assert server.service.deployed.instance.id == served_id
+            status, r = _post_json(f"{base}/queries.json", {"x": 4})
+            assert (status, r["value"]) == (200, 8)
+        finally:
+            server.stop()
+
+    def test_query_deadline_maps_to_503(self):
+        """A query that cannot finish inside the request budget is a 503
+        (degradation), not a hung socket or a 500."""
+        import types
+
+        from predictionio_tpu.api.engine_server import EngineService
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        class SlowDeployed:
+            query_class = None
+            instance = types.SimpleNamespace(id="inst-slow")
+            engine = None
+
+            def query(self, q):
+                time.sleep(0.25)
+                return {"ok": True}
+
+            def query_batch(self, qs):
+                time.sleep(0.25)
+                return [{"ok": True}] * len(qs)
+
+        service = EngineService(
+            SlowDeployed(),
+            config=ServerConfig(batching=True, batch_wait_ms=0.0,
+                                request_deadline_ms=50.0),
+        )
+        try:
+            result = service.handle("POST", "/queries.json", {}, {}, {"x": 1})
+            assert result[0] == 503
+            assert "deadline" in result[1]["message"]
+            assert result[2]["Retry-After"] == "1"
+
+            # a client header may only tighten, and bad values are 400
+            for bad in ("not-a-number", "nan", "inf", "0", "-100"):
+                result = service.handle(
+                    "POST", "/queries.json", {},
+                    {"x-pio-deadline-ms": bad}, {"x": 1})
+                assert result[0] == 400, bad
+        finally:
+            service.batcher.close()
+
+    def test_deadline_enforced_on_non_batched_path(self):
+        """batching=False (the default): a predict slower than the
+        budget must 503 within the budget, not hold the socket."""
+        import types
+
+        from predictionio_tpu.api.engine_server import EngineService
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        class SlowDeployed:
+            query_class = None
+            instance = types.SimpleNamespace(id="inst-slow")
+            engine = None
+
+            def query(self, q):
+                time.sleep(0.4)
+                return {"ok": True}
+
+        service = EngineService(
+            SlowDeployed(), config=ServerConfig(request_deadline_ms=50.0))
+        t0 = time.monotonic()
+        result = service.handle("POST", "/queries.json", {}, {}, {"x": 1})
+        assert result[0] == 503 and "deadline" in result[1]["message"]
+        assert time.monotonic() - t0 < 0.35      # returned before predict
+
+    def test_storage_timeout_not_misreported_as_deadline(self):
+        """A TimeoutError raised BY the predict path (3.11 aliases it to
+        concurrent.futures.TimeoutError) is a storage outage, not a
+        blown budget."""
+        import types
+
+        from predictionio_tpu.api.engine_server import EngineService
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        class TimingOut:
+            query_class = None
+            instance = types.SimpleNamespace(id="inst-t")
+            engine = None
+
+            def query(self, q):
+                raise TimeoutError("backend socket timed out")
+
+        service = EngineService(TimingOut(), config=ServerConfig())
+        result = service.handle("POST", "/queries.json", {}, {}, {"x": 1})
+        assert result[0] == 503
+        assert "storage unavailable" in result[1]["message"]
+        assert "deadline" not in result[1]["message"]
+
+    def test_client_header_sets_deadline_when_config_off(self):
+        import types
+
+        from predictionio_tpu.api.engine_server import EngineService
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        class SlowDeployed:
+            query_class = None
+            instance = types.SimpleNamespace(id="inst-slow")
+            engine = None
+
+            def query_batch(self, qs):
+                time.sleep(0.25)
+                return [{"ok": True}] * len(qs)
+
+        service = EngineService(
+            SlowDeployed(), config=ServerConfig(batching=True,
+                                                batch_wait_ms=0.0))
+        try:
+            result = service.handle(
+                "POST", "/queries.json", {},
+                {"x-pio-deadline-ms": "40"}, {"x": 1})
+            assert result[0] == 503
+        finally:
+            service.batcher.close()
+
+    def test_batcher_fallback_reresolves_deployed(self):
+        """QueryBatcher._finish: after a failed batch, each per-query
+        fallback re-resolves the deployed handle, so a /reload mid-batch
+        cannot pin retries to the dead instance."""
+        from predictionio_tpu.workflow.deploy import QueryBatcher
+
+        class Dead:
+            def query_batch(self, qs):
+                raise RuntimeError("batch died")
+
+            def query(self, q):
+                raise RuntimeError("old instance is gone")
+
+        class Alive:
+            def query_batch(self, qs):
+                raise RuntimeError("batch died")
+
+            def query(self, q):
+                return q * 10
+
+        handles = [Dead(), Alive()]
+        resolutions = []
+
+        def get_deployed():
+            # first resolution (the batch dispatch) sees the dead
+            # instance; the fallback resolutions see the reloaded one
+            handle = handles[0] if not resolutions else handles[1]
+            resolutions.append(handle)
+            return handle
+
+        batcher = QueryBatcher(get_deployed, batch_max=4, batch_wait_ms=1.0)
+        try:
+            assert batcher.submit(7) == 70
+            assert len(resolutions) >= 2      # re-resolved for fallback
+        finally:
+            batcher.close()

@@ -1,0 +1,398 @@
+"""Resilience layer unit tests: RetryPolicy, CircuitBreaker (with the
+injectable clock — transitions asserted deterministically, no wall-time
+sleeps), the resilient() wrapper, deadlines, and metrics exposure."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from predictionio_tpu.utils.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    ManualClock,
+    Resilience,
+    RetryPolicy,
+    StorageUnavailableError,
+    TransientError,
+    deadline_scope,
+    registry_snapshot,
+    remaining_deadline,
+    resilient,
+    retry_after_hint,
+)
+
+
+class TestRetryPolicy:
+    def test_full_jitter_bounds_and_growth(self):
+        p = RetryPolicy(base_delay=0.1, max_delay=2.0, multiplier=2.0)
+        rng = random.Random(0)
+        for i in range(6):
+            cap = min(2.0, 0.1 * 2 ** i)
+            for _ in range(50):
+                d = p.backoff(i, rng)
+                assert 0.0 <= d <= cap
+
+    def test_jitter_floor_guarantees_minimum_wait(self):
+        p = RetryPolicy(base_delay=1.0, max_delay=2.0, jitter_floor=0.5)
+        rng = random.Random(0)
+        for i in range(4):
+            cap = min(2.0, 1.0 * 2 ** i)
+            for _ in range(50):
+                d = p.backoff(i, rng)
+                assert cap / 2 <= d <= cap   # equal jitter, never ~0
+
+    def test_no_jitter_is_deterministic_cap(self):
+        p = RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=False)
+        rng = random.Random(0)
+        assert p.backoff(0, rng) == pytest.approx(0.1)
+        assert p.backoff(1, rng) == pytest.approx(0.2)
+        assert p.backoff(5, rng) == pytest.approx(1.0)  # capped
+
+    def test_from_properties(self):
+        p = RetryPolicy.from_properties({
+            "RETRY_MAX_ATTEMPTS": "7",
+            "RETRY_BASE_DELAY_MS": "10",
+            "RETRY_MAX_DELAY_MS": "500",
+            "RETRY_JITTER": "false",
+            "RETRY_DEADLINE_MS": "2500",
+        })
+        assert p.max_attempts == 7
+        assert p.base_delay == pytest.approx(0.01)
+        assert p.max_delay == pytest.approx(0.5)
+        assert p.jitter is False
+        assert p.deadline == pytest.approx(2.5)
+
+    def test_from_properties_env_fallback(self, monkeypatch):
+        monkeypatch.setenv("PIO_RESILIENCE_RETRY_MAX_ATTEMPTS", "9")
+        p = RetryPolicy.from_properties({})
+        assert p.max_attempts == 9
+        # explicit property beats env
+        p = RetryPolicy.from_properties({"RETRY_MAX_ATTEMPTS": "2"})
+        assert p.max_attempts == 2
+
+
+class TestCircuitBreaker:
+    """The acceptance transition chain, on a manual clock: closed →
+    open → half-open → closed, each edge asserted deterministically."""
+
+    def test_transition_chain(self):
+        clock = ManualClock()
+        b = CircuitBreaker("t", failure_threshold=3, reset_timeout=30.0,
+                           clock=clock)
+        assert b.state == "closed"
+        for _ in range(2):
+            b.before_call()
+            b.record_failure()
+        assert b.state == "closed"           # below threshold
+        b.before_call()
+        b.record_failure()                   # third consecutive failure
+        assert b.state == "open"
+        assert b.opens == 1
+
+        with pytest.raises(CircuitOpenError) as e:
+            b.before_call()                  # short-circuits while open
+        assert e.value.retry_after == pytest.approx(30.0)
+
+        clock.advance(29.9)
+        with pytest.raises(CircuitOpenError):
+            b.before_call()                  # still open just before reset
+        clock.advance(0.2)
+        assert b.state == "half_open"
+        b.before_call()                      # the probe is admitted
+        with pytest.raises(CircuitOpenError):
+            b.before_call()                  # ... but only one at a time
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_half_open_failure_reopens(self):
+        clock = ManualClock()
+        b = CircuitBreaker("t", failure_threshold=1, reset_timeout=10.0,
+                           clock=clock)
+        b.before_call()
+        b.record_failure()
+        assert b.state == "open"
+        clock.advance(10.0)
+        b.before_call()                      # probe
+        b.record_failure()                   # probe fails -> re-open
+        assert b.state == "open"
+        assert b.opens == 2
+        with pytest.raises(CircuitOpenError) as e:
+            b.before_call()
+        assert e.value.retry_after == pytest.approx(10.0)
+
+    def test_success_resets_failure_streak(self):
+        b = CircuitBreaker("t", failure_threshold=2, clock=ManualClock())
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == "closed"           # streak broken, not cumulative
+
+    def test_from_properties_disabled(self):
+        assert CircuitBreaker.from_properties(
+            "x", {"BREAKER_THRESHOLD": "0"}) is None
+        b = CircuitBreaker.from_properties(
+            "x", {"BREAKER_THRESHOLD": "2", "BREAKER_RESET_S": "5"})
+        assert b.failure_threshold == 2
+        assert b.reset_timeout == pytest.approx(5.0)
+
+
+def _flaky(failures: int, exc=TransientError):
+    """A callable failing the first ``failures`` times."""
+    state = {"n": 0}
+
+    def fn():
+        state["n"] += 1
+        if state["n"] <= failures:
+            raise exc(f"boom {state['n']}")
+        return state["n"]
+
+    return fn
+
+
+def _resilience(**kw) -> Resilience:
+    kw.setdefault("clock", ManualClock())
+    kw.setdefault("register", False)
+    kw.setdefault("policy", RetryPolicy(max_attempts=4, base_delay=0.01,
+                                        jitter=False))
+    return Resilience("test", **kw)
+
+
+class TestResilientCall:
+    def test_retries_then_succeeds(self):
+        r = _resilience()
+        assert resilient(r, _flaky(2)) == 3
+        snap = r.snapshot()
+        assert snap["calls"] == 1
+        assert snap["attempts"] == 3
+        assert snap["retries"] == 2
+        assert snap["failures"] == 2
+        assert snap["unavailable"] == 0
+
+    def test_exhaustion_wraps_in_storage_unavailable(self):
+        r = _resilience()
+        with pytest.raises(StorageUnavailableError) as e:
+            resilient(r, _flaky(10))
+        assert isinstance(e.value.__cause__, TransientError)
+        assert r.snapshot()["unavailable"] == 1
+        assert e.value.retry_after > 0
+
+    def test_non_retryable_passes_through_untouched(self):
+        r = _resilience()
+        with pytest.raises(KeyError):
+            resilient(r, _flaky(1, exc=KeyError))
+        assert r.snapshot()["retries"] == 0
+
+    def test_breaker_short_circuits_after_open(self):
+        clock = ManualClock()
+        calls = {"n": 0}
+
+        def always_down():
+            calls["n"] += 1
+            raise ConnectionError("refused")
+
+        r = _resilience(
+            clock=clock,
+            policy=RetryPolicy(max_attempts=2, base_delay=0.01, jitter=False),
+            breaker=CircuitBreaker("test", failure_threshold=2,
+                                   reset_timeout=60.0, clock=clock),
+        )
+        with pytest.raises(StorageUnavailableError):
+            resilient(r, always_down)        # 2 attempts, breaker opens
+        assert r.breaker.state == "open"
+        before = calls["n"]
+        with pytest.raises(StorageUnavailableError) as e:
+            resilient(r, always_down)        # short-circuited: no attempt
+        assert calls["n"] == before
+        assert e.value.retry_after == pytest.approx(60.0)
+        assert r.snapshot()["short_circuits"] == 1
+
+        # recovery: reset elapses, the half-open probe succeeds, closed
+        clock.advance(60.0)
+        assert resilient(r, lambda: "up") == "up"
+        assert r.breaker.state == "closed"
+
+    def test_policy_deadline_stops_retries(self):
+        clock = ManualClock()
+        r = _resilience(
+            clock=clock,
+            policy=RetryPolicy(max_attempts=100, base_delay=1.0,
+                               jitter=False, deadline=2.5),
+        )
+        with pytest.raises(StorageUnavailableError):
+            resilient(r, _flaky(100))
+        # 1s + 2s sleeps fit a 2.5s budget only once: attempts 1,2,(3rd
+        # blocked: 1+2=3 >= 2.5 after two sleeps) — assert bounded work
+        assert r.snapshot()["attempts"] <= 3
+
+    def test_ambient_deadline_scope(self):
+        r = _resilience(policy=RetryPolicy(max_attempts=50, base_delay=10.0,
+                                           jitter=False))
+        with deadline_scope(0.05):
+            assert remaining_deadline() <= 0.05
+            with pytest.raises(StorageUnavailableError):
+                resilient(r, _flaky(50))
+        assert remaining_deadline() is None
+        # a 10s delay never fits a 50ms budget: exactly one attempt
+        assert r.snapshot()["attempts"] == 1
+
+    def test_nested_deadline_only_shrinks(self):
+        with deadline_scope(10.0):
+            with deadline_scope(60.0):
+                assert remaining_deadline() <= 10.0
+
+
+class TestReviewRegressions:
+    def test_non_retryable_during_half_open_releases_probe(self):
+        """A 4xx/auth error during the half-open probe means the backend
+        RESPONDED: the probe slot must be released (and the breaker
+        closed), not wedged open forever."""
+        clock = ManualClock()
+        r = _resilience(
+            clock=clock,
+            policy=RetryPolicy(max_attempts=1),
+            breaker=CircuitBreaker("t", failure_threshold=1,
+                                   reset_timeout=10.0, clock=clock),
+        )
+        with pytest.raises(StorageUnavailableError):
+            resilient(r, _flaky(99))             # opens the breaker
+        clock.advance(10.0)
+        with pytest.raises(KeyError):            # half-open probe: app error
+            resilient(r, _flaky(99, exc=KeyError))
+        assert r.breaker.state == "closed"       # NOT wedged half-open
+        assert resilient(r, lambda: "up") == "up"
+
+    def test_interrupt_during_half_open_probe_releases_slot(self):
+        """A KeyboardInterrupt mid-probe must not move the breaker OR
+        leak the probe slot — a process that survives the interrupt
+        must still be able to probe the backend."""
+        clock = ManualClock()
+        r = _resilience(
+            clock=clock,
+            policy=RetryPolicy(max_attempts=1),
+            breaker=CircuitBreaker("t", failure_threshold=1,
+                                   reset_timeout=10.0, clock=clock),
+        )
+        with pytest.raises(StorageUnavailableError):
+            resilient(r, _flaky(99))             # opens the breaker
+        clock.advance(10.0)
+
+        def interrupted():
+            raise KeyboardInterrupt
+
+        with pytest.raises(KeyboardInterrupt):
+            resilient(r, interrupted)            # probe interrupted
+        assert r.breaker.state == "half_open"    # not closed, not wedged
+        assert resilient(r, lambda: "up") == "up"  # next probe admitted
+        assert r.breaker.state == "closed"
+
+    def test_nested_unavailable_is_terminal_not_retried(self):
+        """chaos-over-remote stacking: an inner policy's exhausted
+        StorageUnavailableError must pass through the outer layer with
+        ONE attempt (no retry multiplication during an outage), while
+        still counting against the outer breaker."""
+        clock = ManualClock()
+        r = _resilience(
+            clock=clock,
+            policy=RetryPolicy(max_attempts=12, base_delay=0.01),
+            breaker=CircuitBreaker("outer", failure_threshold=2,
+                                   clock=clock),
+        )
+        inner_error = StorageUnavailableError("inner", "down", 5.0)
+
+        def exhausted():
+            raise inner_error
+
+        for _ in range(2):
+            with pytest.raises(StorageUnavailableError) as e:
+                resilient(r, exhausted)
+            assert e.value is inner_error        # untouched, retry_after kept
+        assert r.snapshot()["attempts"] == 2     # one per call, no retries
+        assert r.breaker.state == "open"         # outage still counted
+
+    def test_batcher_propagates_deadline_to_dispatcher_thread(self):
+        """deadline_scope is a contextvar and does not cross threads on
+        its own; QueryBatcher.submit must carry the remaining budget
+        into the dispatcher so storage retries under a batch dispatch
+        see it."""
+        from predictionio_tpu.workflow.deploy import QueryBatcher
+
+        seen: list = []
+
+        class Deployed:
+            def query_batch(self, qs):
+                seen.append(remaining_deadline())
+                return [q for q in qs]
+
+        batcher = QueryBatcher(lambda: Deployed(), batch_wait_ms=0.0)
+        try:
+            with deadline_scope(5.0):
+                assert batcher.submit("q") == "q"
+            assert batcher.submit("r") == "r"    # no ambient deadline
+        finally:
+            batcher.close()
+        assert seen[0] is not None and 0 < seen[0] <= 5.0
+        assert seen[1] is None
+
+
+class TestMetricsExposure:
+    def test_registry_snapshot_via_stats(self):
+        from predictionio_tpu.api.stats import resilience_snapshot
+
+        r = Resilience("unit-test/registered",
+                       policy=RetryPolicy(max_attempts=1))
+        r.call(lambda: 1)
+        snap = resilience_snapshot()
+        assert snap == registry_snapshot()
+        assert snap["unit-test/registered"]["calls"] >= 1
+
+    def test_breaker_state_in_snapshot(self):
+        clock = ManualClock()
+        r = _resilience(
+            clock=clock,
+            breaker=CircuitBreaker("b", failure_threshold=1, clock=clock))
+        with pytest.raises(StorageUnavailableError):
+            resilient(r, _flaky(99))
+        snap = r.snapshot()
+        assert snap["breaker"]["state"] == "open"
+        assert snap["breaker"]["opens"] == 1
+
+
+class TestRecordFallback:
+    def test_counter_visible_in_registry(self):
+        from predictionio_tpu.utils.resilience import record_fallback
+
+        record_fallback("unit-test/fallbacks")
+        record_fallback("unit-test/fallbacks")
+        assert registry_snapshot()["unit-test/fallbacks"]["fallbacks"] == 2
+
+
+class TestRetryAfterHint:
+    def test_hint_from_exception(self):
+        assert retry_after_hint(StorageUnavailableError("x", "m", 7.5)) == 7.5
+        assert retry_after_hint(ValueError("x")) == 1.0
+        assert retry_after_hint(ValueError("x"), default=3.0) == 3.0
+
+
+class TestServerConfigDeadline:
+    def test_request_deadline_field_defaults_off(self):
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        assert ServerConfig().request_deadline_ms == 0.0
+
+    def test_bind_backoff_is_jittered_policy(self):
+        """The engine server's bind retry now draws from RetryPolicy
+        full jitter instead of a fixed 1s sleep."""
+        from predictionio_tpu.api.http_base import RestServer
+
+        policy = RestServer.bind_backoff
+        assert isinstance(policy, RetryPolicy)
+        assert policy.jitter is True
+        rng = random.Random(1)
+        delays = [policy.backoff(0, rng) for _ in range(8)]
+        assert len({round(d, 6) for d in delays}) > 1   # actually jittered
+        # ...but floored: a stopping predecessor gets a real wait window
+        assert all(d >= policy.base_delay * policy.jitter_floor
+                   for d in delays)
+        assert policy.jitter_floor > 0
