@@ -1,0 +1,54 @@
+"""`pio lint` — AST-based invariant checking for the serving and compute
+paths.
+
+The reference PredictionIO leaned on JVM typing and Spark's execution
+model to keep framework invariants honest; this Python/JAX port has
+neither, so the invariants are machine-checked here instead:
+
+- every remote network call routes through the resilience layer
+  (``resilience-bypass``)
+- jit-compiled functions are pure (``jit-purity``)
+- no host-device sync on the request-serving hot path
+  (``host-sync-in-hot-path``)
+- compute modules stay f32/bf16 (``dtype-discipline``)
+- every blocking socket/HTTP call in the serving plane carries a
+  timeout (``untimed-blocking-io``)
+- state shared with worker threads is lock-protected or documented
+  atomic (``lock-discipline``)
+
+Public surface: :func:`lint_paths` runs the registered rules over a file
+tree and returns :class:`Finding`s; the ``pio lint`` CLI subcommand and
+the tier-1 gate (``tests/test_lint_gate.py``) are thin callers. See
+docs/static-analysis.md for the rule catalog and suppression syntax
+(``# pio: lint-ignore[rule-id]: justification``).
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    all_rules,
+    get_rule,
+    register_rule,
+)
+from predictionio_tpu.analysis.config import LintConfig, default_config
+from predictionio_tpu.analysis.runner import format_findings, lint_package, lint_paths
+
+# importing the rules package registers the built-in rule suite
+import predictionio_tpu.analysis.rules  # noqa: E402,F401  (registration side effect)
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "ModuleInfo",
+    "Rule",
+    "all_rules",
+    "default_config",
+    "format_findings",
+    "get_rule",
+    "lint_package",
+    "lint_paths",
+    "register_rule",
+]

@@ -1,0 +1,11 @@
+"""The built-in rule suite — importing this package registers every
+rule with the framework registry (analysis.core)."""
+
+from __future__ import annotations
+
+import predictionio_tpu.analysis.rules.resilience  # noqa: F401
+import predictionio_tpu.analysis.rules.jit_purity  # noqa: F401
+import predictionio_tpu.analysis.rules.host_sync  # noqa: F401
+import predictionio_tpu.analysis.rules.dtype  # noqa: F401
+import predictionio_tpu.analysis.rules.blocking_io  # noqa: F401
+import predictionio_tpu.analysis.rules.locks  # noqa: F401

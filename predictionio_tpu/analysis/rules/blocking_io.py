@@ -1,0 +1,60 @@
+"""untimed-blocking-io: every socket/HTTP call in the serving plane
+carries a timeout.
+
+A handler thread blocked on an un-timed ``urlopen`` (the fire-and-
+forget feedback POST, an undeploy probe, a webhook fan-out) holds its
+socket — and under ThreadingHTTPServer, a thread — for as long as the
+peer cares to stall. The resilience layer bounds retries, but only a
+socket-level timeout bounds a single attempt. Default policed calls:
+``urlopen`` and ``socket.create_connection``; config may extend (e.g.
+``requests``-style ``get``/``post`` if that dependency ever lands).
+
+The timeout may be any expression (config field, constant, deadline
+remainder) — it just has to be PASSED. ``timeout=None`` is flagged:
+that is the spelled-out version of the bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any
+
+from predictionio_tpu.analysis.core import Finding, ModuleInfo, Rule, register_rule
+
+#: policed call -> 0-based POSITIONAL index of its timeout parameter:
+#: urlopen(url, data=None, timeout=...), create_connection(addr, timeout)
+DEFAULT_POLICED_CALLS = {"urlopen": 2, "create_connection": 1}
+
+
+@register_rule
+class UntimedBlockingIORule(Rule):
+    rule_id = "untimed-blocking-io"
+    description = "blocking network calls in the serving plane must set a timeout"
+    default_paths = ("api/",)
+
+    def check(self, module: ModuleInfo, options: dict[str, Any]) -> list[Finding]:
+        policed = dict(options.get("policed_calls", DEFAULT_POLICED_CALLS))
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self.call_name(node)
+            if name not in policed:
+                continue
+            timeout = next(
+                (kw.value for kw in node.keywords if kw.arg == "timeout"),
+                None)
+            if timeout is None and len(node.args) > policed[name]:
+                timeout = node.args[policed[name]]
+            if timeout is None:
+                findings.append(Finding(
+                    self.rule_id, "", node.lineno,
+                    f"{name}() without a timeout — a stalled peer parks "
+                    f"this thread forever; pass timeout=<bounded>",
+                    node.col_offset))
+            elif isinstance(timeout, ast.Constant) and timeout.value is None:
+                findings.append(Finding(
+                    self.rule_id, "", node.lineno,
+                    f"{name}(timeout=None) — explicitly unbounded; pass "
+                    f"a finite timeout", node.col_offset))
+        return findings

@@ -1,0 +1,127 @@
+"""Run the registered rules over a file tree and collect findings.
+
+The runner owns everything rule-independent: file discovery, parsing,
+path scoping, suppression filtering, and report formatting. Rules see
+one :class:`ModuleInfo` at a time and never touch the filesystem.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Iterable
+
+from predictionio_tpu.analysis.config import LintConfig, default_config, path_matches
+from predictionio_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    suppression_findings,
+)
+
+
+def _iter_py_files(path: str) -> Iterable[str]:
+    if not os.path.exists(path):
+        # a typo'd CI hook must fail loudly, not lint zero files "clean"
+        raise FileNotFoundError(f"lint path does not exist: {path}")
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames.sort()
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
+def lint_paths(
+    paths: Iterable[str],
+    config: LintConfig | None = None,
+    rel_root: str | None = None,
+    rule_ids: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Lint ``paths`` (files or trees), scoping rules by path relative
+    to ``rel_root`` (default: each argument itself). ``rule_ids``
+    restricts the run to a subset of enabled rules."""
+    config = config or default_config()
+    rules = config.enabled_rules()
+    if rule_ids is not None:
+        wanted = set(rule_ids)
+        unknown = wanted - set(rules)
+        if unknown:
+            raise KeyError(f"unknown/disabled rule(s): {sorted(unknown)}")
+        rules = {rid: r for rid, r in rules.items() if rid in wanted}
+
+    findings: list[Finding] = []
+    seen_files: set[str] = set()
+    for top in paths:
+        base = rel_root or (top if os.path.isdir(top) else os.path.dirname(top))
+        for fpath in _iter_py_files(top):
+            real = os.path.realpath(fpath)
+            if real in seen_files:
+                continue  # overlapping path args must not double-report
+            seen_files.add(real)
+            relpath = os.path.relpath(fpath, base).replace(os.sep, "/")
+            if path_matches(relpath, config.exclude):
+                continue
+            try:
+                with open(fpath, encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=fpath)
+            except (SyntaxError, UnicodeDecodeError) as exc:
+                findings.append(Finding(
+                    "parse-error", relpath,
+                    getattr(exc, "lineno", 0) or 0,
+                    f"could not parse: {exc}",
+                ))
+                continue
+            module = ModuleInfo(fpath, source, tree)
+            findings.extend(suppression_findings(module, relpath))
+            for rule in rules.values():
+                if not path_matches(relpath, config.rule_paths(rule)):
+                    continue
+                raw = rule.check(module, config.rule_options(rule))
+                waived = module.suppressed_lines(rule.rule_id)
+                findings.extend(
+                    Finding(rule.rule_id, relpath, f.line, f.message, f.col)
+                    for f in raw
+                    if f.line not in waived
+                )
+    findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return findings
+
+
+def lint_package(
+    package_dir: str | None = None,
+    config: LintConfig | None = None,
+    rule_ids: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Lint the installed ``predictionio_tpu`` package with the repo
+    policy — what `pio lint` and the tier-1 gate run."""
+    if package_dir is None:
+        import predictionio_tpu
+
+        package_dir = os.path.dirname(predictionio_tpu.__file__)
+    return lint_paths([package_dir], config=config, rel_root=package_dir,
+                      rule_ids=rule_ids)
+
+
+def format_findings(findings: list[Finding], fmt: str = "text") -> str:
+    if fmt == "json":
+        return json.dumps(
+            [
+                {
+                    "rule": f.rule_id,
+                    "path": f.path,
+                    "line": f.line,
+                    "col": f.col,
+                    "message": f.message,
+                }
+                for f in findings
+            ],
+            indent=2,
+        )
+    out = [f.format() for f in findings]
+    n = len(findings)
+    out.append(f"{n} finding{'s' if n != 1 else ''}" if n else "clean: no findings")
+    return "\n".join(out)

@@ -504,7 +504,9 @@ class EngineService:
                     headers={"Content-Type": "application/json"},
                     method="POST",
                 )
-                with urllib.request.urlopen(req, timeout=10, context=ssl_ctx):
+                with urllib.request.urlopen(
+                        req, timeout=self.config.feedback_timeout_s,
+                        context=ssl_ctx):
                     pass
             except Exception as e:
                 logger.warning("feedback event POST failed: %s", e)

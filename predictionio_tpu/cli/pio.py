@@ -201,6 +201,61 @@ def _cmd_accesskey(args, storage: Storage) -> int:
     return 1
 
 
+def _cmd_lint(args, storage: Storage) -> int:
+    """`pio lint` — AST invariant checker for the serving/compute paths
+    (docs/static-analysis.md). Exit 0 clean, 1 on findings."""
+    import os.path
+
+    import predictionio_tpu
+    from predictionio_tpu.analysis import (
+        all_rules,
+        default_config,
+        format_findings,
+        lint_package,
+        lint_paths,
+    )
+
+    if args.list_rules:
+        policy = default_config()
+        for rule_id, rule in sorted(all_rules().items()):
+            # the EFFECTIVE repo-policy scope, not the rule's built-in
+            # default — the listing must match what a run checks
+            paths = ", ".join(p or "<all>" for p in policy.rule_paths(rule))
+            print(f"{rule_id:24s} {rule.description} [{paths}]")
+        return 0
+    try:
+        if not args.paths:
+            findings = lint_package(rule_ids=args.rules)
+        else:
+            # paths inside the package keep the policy's package-relative
+            # scoping; ad-hoc files outside it (fixtures, snippets) run
+            # every requested rule unscoped — `pio lint some_file.py
+            # --rule X` must never silently skip X for scope reasons
+            pkg = os.path.dirname(os.path.abspath(predictionio_tpu.__file__))
+            in_pkg = [
+                p for p in args.paths
+                if os.path.abspath(p) == pkg
+                or os.path.abspath(p).startswith(pkg + os.sep)
+            ]
+            external = [p for p in args.paths if p not in in_pkg]
+            findings = []
+            if in_pkg:
+                findings += lint_paths(in_pkg, rel_root=pkg,
+                                       rule_ids=args.rules)
+            if external:
+                findings += lint_paths(external,
+                                       config=default_config().unscoped(),
+                                       rule_ids=args.rules)
+            findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    except (KeyError, OSError) as exc:
+        # stderr: stdout must stay machine-parseable under --format json
+        print(f"[ERROR] {exc.args[0] if isinstance(exc, KeyError) else exc}",
+              file=sys.stderr)
+        return 2
+    print(format_findings(findings, fmt=args.format))
+    return 1 if findings else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="pio",
@@ -239,6 +294,23 @@ def build_parser() -> argparse.ArgumentParser:
     pcd.add_argument("name")
     pcd.add_argument("channel")
 
+    p = sub.add_parser(
+        "lint",
+        help="AST invariant checker for the serving/compute paths "
+             "(docs/static-analysis.md)",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files or directories (default: the installed predictionio_tpu package)",
+    )
+    p.add_argument(
+        "--rule", action="append", dest="rules", metavar="RULE_ID",
+        help="run only this rule (repeatable; see --list-rules)",
+    )
+    p.add_argument("--list-rules", action="store_true",
+                   help="list registered rules and exit")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+
     p = sub.add_parser("accesskey", help="access key administration")
     ak_sub = p.add_subparsers(dest="ak_command", required=True)
     an = ak_sub.add_parser("new")
@@ -258,12 +330,17 @@ def build_parser() -> argparse.ArgumentParser:
 #: multi-host jax.distributed barrier
 COMPUTE_COMMANDS = frozenset({"train", "eval", "deploy", "run"})
 
+#: commands that never touch storage — they must work (CI lint hooks,
+#: version probes) even when PIO_STORAGE_* env is broken or absent
+STORAGE_FREE_COMMANDS = frozenset({"version", "lint"})
+
 _COMMANDS = {
     "version": _cmd_version,
     "status": _cmd_status,
     "eventserver": _cmd_eventserver,
     "app": _cmd_app,
     "accesskey": _cmd_accesskey,
+    "lint": _cmd_lint,
 }
 
 
@@ -297,6 +374,8 @@ def main(argv: list[str] | None = None) -> int:
         from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
 
         maybe_initialize_distributed()
+    if args.command in STORAGE_FREE_COMMANDS:
+        return _COMMANDS[args.command](args, None)
     storage = Storage.default()
     return _COMMANDS[args.command](args, storage)
 

@@ -121,8 +121,10 @@ class CategoricalNaiveBayes:
         label_counts, value_counts = _nb_count(
             label_ix, feat_ix, n_labels, n_features, max_vocab
         )
-        label_counts = np.asarray(label_counts, dtype=np.float64)
-        value_counts = np.asarray(value_counts, dtype=np.float64)
+        # f32 end-to-end: counts are integers well under 2**24, so the
+        # log-space priors/likelihoods lose nothing vs the old f64 copy
+        label_counts = np.asarray(label_counts, dtype=np.float32)
+        value_counts = np.asarray(value_counts, dtype=np.float32)
 
         log_priors = np.log(label_counts) - math.log(len(points))
         with np.errstate(divide="ignore"):

@@ -134,13 +134,14 @@ def _rowloop_half_solve(
     v_sorted = vals[order]
     seg_rows, seg_starts = np.unique(r_sorted, return_index=True)
     bounds = np.append(seg_starts, len(r_sorted))
+    # pio: lint-ignore[dtype-discipline]: exact normal-equation oracle — f64 keeps the rank-200 solve conditioned; host-side, never ships to TPU
     out = np.zeros((num_rows, rank), dtype=np.float64)
-    eye = np.eye(rank, dtype=np.float64)
+    eye = np.eye(rank, dtype=np.float64)  # pio: lint-ignore[dtype-discipline]: same f64 oracle solve as above
     for j, row in enumerate(seg_rows):
         lo, hi = bounds[j], bounds[j + 1]
-        F = V[c_sorted[lo:hi]].astype(np.float64)
+        F = V[c_sorted[lo:hi]].astype(np.float64)  # pio: lint-ignore[dtype-discipline]: same f64 oracle solve as above
         A = F.T @ F + lam * (hi - lo) * eye
-        b = F.T @ v_sorted[lo:hi].astype(np.float64)
+        b = F.T @ v_sorted[lo:hi].astype(np.float64)  # pio: lint-ignore[dtype-discipline]: same f64 oracle solve as above
         out[row] = np.linalg.solve(A, b)
     return out.astype(np.float32)
 
@@ -247,6 +248,7 @@ def test_rmse(
         [i for lst in test_by_user.values() for i, _ in lst], dtype=np.int64
     )
     vals = np.asarray(
+        # pio: lint-ignore[dtype-discipline]: parity-oracle RMSE accumulates in f64 so the noise floor compares implementations, not summation error
         [r for lst in test_by_user.values() for _, r in lst], dtype=np.float64
     )
     pred = np.einsum("nk,nk->n", U[users], V[items])

@@ -55,6 +55,11 @@ class ServerConfig:
     event_server_ip: str = "0.0.0.0"
     event_server_port: int = 7070
     access_key: str = ""
+    #: socket timeout for the fire-and-forget feedback POST — bounds how
+    #: long a stalled event server can pin a pio-feedback thread (the
+    #: untimed-blocking-io lint invariant; threads are daemonic but each
+    #: stuck one leaks a socket until the peer answers)
+    feedback_timeout_s: float = 10.0
     #: when set, /stop and /reload require ?accessKey=<server_key>
     #: (common KeyAuthentication, KeyAuthentication.scala:33-60)
     server_key: str | None = None
@@ -360,8 +365,8 @@ class QueryBatcher:
                 results = deployed.query_batch([q for q, _, _ in batch])
             for (_, fut, _), served in zip(batch, results):
                 fut.set_result(served)
-            self.batches += 1
-            self.batched_queries += len(batch)
+            self.batches += 1  # pio: lint-ignore[lock-discipline]: dispatcher is the ONLY writer; stats reads may run one batch stale
+            self.batched_queries += len(batch)  # pio: lint-ignore[lock-discipline]: single-writer stats counter, same as above
         except Exception:
             logger.exception(
                 "batched predict failed; retrying %d queries individually",

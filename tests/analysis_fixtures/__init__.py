@@ -1,0 +1,4 @@
+# Fixture snippets for the analysis rule tests (tests/test_analysis_rules.py).
+# These files are PARSED by the linter, never imported — the *_bad.py
+# modules deliberately contain the exact violations each rule exists to
+# catch, and the *_good.py twins show the compliant spelling.

@@ -1,0 +1,21 @@
+"""BAD: a call-guarded raw function (the pgwire `_open_socket` shape)
+touched outside its one allowed caller."""
+
+import socket
+
+
+def _open_socket(host, port, timeout):
+    return socket.create_connection((host, port), timeout)
+
+
+class Conn:
+    def __init__(self, host, port, timeout):
+        self._sock = _open_socket(host, port, timeout)   # the allowed site
+
+    def reconnect(self, host, port, timeout):
+        # new direct call — bypasses whatever resilience wraps Conn()
+        self._sock = _open_socket(host, port, timeout)
+
+
+def steal():
+    return _open_socket                                  # aliasing out

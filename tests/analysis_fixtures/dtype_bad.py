@@ -1,0 +1,12 @@
+"""BAD: float64 on the compute path, in every spelling the rule knows."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def widen(x):
+    a = np.zeros(4, dtype=np.float64)         # attribute dtype
+    b = jnp.asarray(x, dtype="float64")       # string dtype= keyword
+    c = np.asarray(x).astype("float64")       # string astype
+    d = np.float64(3.5)                       # scalar constructor
+    return a, b, c, d

@@ -1,0 +1,13 @@
+"""GOOD: f32/bf16 compute, plus one justified f64 suppression (the
+documented escape hatch for numerical-stability oracles)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def narrow(x):
+    a = np.zeros(4, dtype=np.float32)
+    b = jnp.asarray(x, dtype=jnp.bfloat16)
+    # pio: lint-ignore[dtype-discipline]: exact oracle solve needs f64 conditioning; host-side only
+    c = np.eye(4, dtype=np.float64)
+    return a, b, c

@@ -1,0 +1,16 @@
+"""BAD: device→host syncs on the serving path — every one blocks the
+handler thread on a device round-trip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def handle_query(model, query):
+    scores = model.predict(query)
+    best = scores.argmax().item()                 # sync per request
+    confidence = float(jnp.max(scores))           # hidden sync
+    host_scores = np.asarray(jnp.sort(scores))    # device copy-out
+    scores.block_until_ready()                    # explicit barrier
+    top = jax.device_get(scores[:10])             # forced transfer
+    return best, confidence, host_scores, top

@@ -1,0 +1,10 @@
+"""GOOD: the serving path keeps values on device; host conversion of
+plain Python values stays legal."""
+
+import numpy as np
+
+
+def handle_query(model, query, headers):
+    budget = float(headers.get("x-pio-deadline-ms", "0"))  # str, not device
+    batch = np.asarray([query.user_id], dtype=np.int32)    # host list in
+    return model.predict(batch), budget

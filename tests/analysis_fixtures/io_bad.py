@@ -1,0 +1,22 @@
+"""BAD: blocking network calls with no (or explicitly unbounded)
+timeout — each can park a handler thread forever."""
+
+import socket
+import urllib.request
+
+
+def post_feedback(url, data):
+    with urllib.request.urlopen(url, data=data):        # no timeout
+        pass
+
+
+def probe(url):
+    return urllib.request.urlopen(url, timeout=None)    # spelled-out bug
+
+
+def raw_connect(host, port):
+    return socket.create_connection((host, port))       # no timeout
+
+
+def raw_connect_positional_none(host, port):
+    return socket.create_connection((host, port), None)  # unbounded, spelled positionally

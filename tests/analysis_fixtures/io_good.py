@@ -1,0 +1,23 @@
+"""GOOD: every blocking call carries a bound — keyword, config field,
+or create_connection's positional timeout."""
+
+import socket
+import urllib.request
+
+
+def post_feedback(url, data, timeout_s):
+    with urllib.request.urlopen(url, data=data, timeout=timeout_s):
+        pass
+
+
+def probe(url):
+    return urllib.request.urlopen(url, timeout=5)
+
+
+def probe_positional(url, data):
+    # urlopen(url, data, timeout) — the positional spelling is bounded too
+    return urllib.request.urlopen(url, data, 5)
+
+
+def raw_connect(host, port):
+    return socket.create_connection((host, port), 3.0)

@@ -1,0 +1,50 @@
+"""BAD: host side effects inside jit-compiled functions — each one runs
+at trace time only and silently never again."""
+
+import random
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy_step(x):
+    print("tracing", x.shape)        # trace-time-only print
+    t0 = time.time()                 # frozen at trace time
+    return x * t0
+
+
+@partial(jax.jit, static_argnames=())
+def folded_noise(x):
+    return x + random.random()       # constant-folded host randomness
+
+
+_COUNTER = 0
+
+
+@jax.jit
+def mutates_global(x):
+    global _COUNTER                  # mutates once, at trace time
+    _COUNTER += 1
+    return x
+
+
+def _wrapped(x):
+    return x + jnp.float32(open("/dev/null").read(0) or 0)
+
+
+fast_wrapped = jax.jit(_wrapped)
+
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+@jax.jit
+def logs_once(x):
+    logger.warning("shape %s", x.shape)   # fires at trace time only
+    return x
+

@@ -1,0 +1,25 @@
+"""GOOD: pure jit functions — traced effects via jax.debug, randomness
+via jax.random, timing done by the CALLER outside the jit boundary."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def debug_ok(x):
+    jax.debug.print("x sum {s}", s=x.sum())   # traced, runs every call
+    return x * 2
+
+
+@partial(jax.jit, static_argnames=("n",))
+def random_ok(key, n):
+    return jax.random.normal(key, (n,), dtype=jnp.float32)
+
+
+def timed_caller(x):
+    t0 = time.time()                 # host timing OUTSIDE the jit: fine
+    y = debug_ok(x)
+    return y, time.time() - t0

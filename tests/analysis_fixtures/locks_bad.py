@@ -1,0 +1,53 @@
+"""BAD: worker-thread state shared without a lock — the race shapes the
+rule exists to catch."""
+
+import threading
+
+
+class UnguardedCounter:
+    """Public attribute written from the dispatcher thread, no lock,
+    no atomicity note."""
+
+    def __init__(self):
+        self.processed = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self.processed += 1
+
+
+class TransitiveWriter:
+    """The write hides one self-call deep; a sibling method reads it."""
+
+    def __init__(self):
+        self._state = "idle"
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self._advance()
+
+    def _advance(self):
+        self._state = "running"
+
+    def describe(self):
+        return self._state
+
+
+class HalfLocked:
+    """Writer takes the lock; the reader forgets it — torn reads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latest = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self._latest = object()
+
+    def peek(self):
+        return self._latest

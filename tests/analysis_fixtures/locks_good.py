@@ -1,0 +1,43 @@
+"""GOOD: worker-thread state either lock-protected on BOTH sides or
+documented atomic with a justified suppression."""
+
+import threading
+
+
+class GuardedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.processed = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.processed += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.processed
+
+
+class DocumentedAtomic:
+    def __init__(self):
+        self.ticks = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self.ticks += 1  # pio: lint-ignore[lock-discipline]: single writer; stats readers tolerate a stale int
+
+
+class ThreadLocalOnly:
+    """Private scratch state never read outside the worker: no finding."""
+
+    def __init__(self):
+        self._scratch = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self._scratch = object()

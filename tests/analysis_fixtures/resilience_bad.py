@@ -1,0 +1,22 @@
+"""BAD: a storage backend whose network calls bypass the resilience
+layer in every way the rule polices."""
+
+import urllib.request
+
+
+def _raw_request(url):
+    return urllib.request.urlopen(url, timeout=5)
+
+
+class LeakyDAO:
+    def fetch(self, url):
+        # raw net call OUTSIDE the guarded function
+        return urllib.request.urlopen(url, timeout=5)
+
+    def fast_path(self, url):
+        # direct call to the guarded function — not via resilient(...)
+        return _raw_request(url)
+
+    def alias_out(self):
+        # aliasing the guarded function out also bypasses the wrapper
+        return _raw_request

@@ -1,0 +1,15 @@
+"""GOOD: the guarded-site pattern PR 1 established — one raw function,
+invoked only through resilient(...)."""
+
+import urllib.request
+
+from predictionio_tpu.utils.resilience import resilient
+
+
+def _raw_request(url):
+    return urllib.request.urlopen(url, timeout=5)
+
+
+class GuardedDAO:
+    def fetch(self, url):
+        return resilient("fixture", lambda: _raw_request(url))

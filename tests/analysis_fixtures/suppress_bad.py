@@ -1,0 +1,14 @@
+"""BAD suppressions: missing justification, unknown rule id. The
+framework reports these as ``bad-suppression`` — a waiver that does not
+say WHY is just a disabled check."""
+
+import numpy as np
+
+
+def no_reason(x):
+    # pio: lint-ignore[dtype-discipline]
+    return np.zeros(4, dtype=np.float64)
+
+
+def unknown_rule(x):
+    return x  # pio: lint-ignore[definitely-not-a-rule]: the id is wrong

@@ -1,0 +1,309 @@
+"""Per-rule coverage via good/bad fixture snippets
+(tests/analysis_fixtures/): every rule fires on its bad twin, stays
+quiet on the good one, and the suppression machinery (justification
+required, unknown-rule detection, line targeting) behaves."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from predictionio_tpu.analysis import (
+    LintConfig,
+    all_rules,
+    lint_paths,
+)
+from predictionio_tpu.analysis.config import RuleConfig
+from predictionio_tpu.analysis.core import (
+    BAD_SUPPRESSION,
+    parse_suppressions,
+)
+
+pytestmark = pytest.mark.lint
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def run_rule(rule_id: str, filename: str, options: dict | None = None):
+    """Lint one fixture file with one rule scoped to everything."""
+    config = LintConfig(rules={
+        rule_id: RuleConfig(paths=("",), options=options or {}),
+    })
+    return lint_paths([fixture(filename)], config=config,
+                      rel_root=FIXTURES, rule_ids=[rule_id])
+
+
+#: resilience guard tables for the fixture pair — the per-package config
+#: a real deployment would keep in analysis.config.default_config()
+RESILIENCE_OPTS = {
+    "guarded_sites": {
+        "resilience_bad.py": ["_raw_request"],
+        "resilience_good.py": ["_raw_request"],
+    },
+    "resilient_only": {
+        "resilience_bad.py": ["_raw_request"],
+        "resilience_good.py": ["_raw_request"],
+    },
+}
+
+
+class TestResilienceBypassRule:
+    def test_bad_fixture_fires(self):
+        findings = run_rule("resilience-bypass", "resilience_bad.py",
+                            RESILIENCE_OPTS)
+        messages = "\n".join(f.message for f in findings)
+        assert len(findings) >= 4
+        assert "raw network call urlopen()" in messages       # stray call
+        assert "outside resilient(...)" in messages           # direct/alias
+        assert "does not import the resilience layer" in messages
+
+    def test_good_fixture_clean(self):
+        assert run_rule("resilience-bypass", "resilience_good.py",
+                        RESILIENCE_OPTS) == []
+
+    def test_unlisted_module_rejects_any_net_call(self):
+        # a module in scope but absent from the guard tables gets the
+        # strictest policy — new backends must declare their site
+        findings = run_rule("resilience-bypass", "resilience_bad.py", {})
+        assert any("raw network call" in f.message for f in findings)
+
+    def test_stale_guard_detected(self):
+        findings = run_rule("resilience-bypass", "io_good.py", {
+            "guarded_sites": {"io_good.py": ["NoSuchFn._gone"]},
+        })
+        assert any("stale guard" in f.message for f in findings)
+
+    def test_call_guard_restricts_reference_sites(self):
+        # the pgwire _open_socket policy: one allowed caller, all other
+        # references (new helpers, aliasing) are findings
+        opts = {
+            "guarded_sites": {"callguard_bad.py": ["_open_socket"]},
+            "call_guard": {
+                "callguard_bad.py": {"_open_socket": ["Conn.__init__"]},
+            },
+            "no_import_ok": ["callguard_bad.py"],
+        }
+        findings = run_rule("resilience-bypass", "callguard_bad.py", opts)
+        messages = [f.message for f in findings]
+        assert len(findings) == 2
+        assert any("from Conn.reconnect" in m for m in messages)
+        assert any("from steal" in m for m in messages)
+
+
+class TestJitPurityRule:
+    def test_bad_fixture_fires(self):
+        findings = run_rule("jit-purity", "jit_bad.py")
+        messages = "\n".join(f.message for f in findings)
+        assert "print() inside jit-compiled noisy_step()" in messages
+        assert "time.time() inside jit-compiled noisy_step()" in messages
+        assert "random.random() inside jit-compiled folded_noise()" in messages
+        assert "global statement inside jit-compiled mutates_global()" in messages
+        # functional wrapping (jax.jit(_wrapped)) is detected too
+        assert "open() inside jit-compiled _wrapped()" in messages
+        # the module-level `logger = logging.getLogger(...)` spelling
+        assert "logger.warning() inside jit-compiled logs_once()" in messages
+
+    def test_good_fixture_clean(self):
+        # jax.debug.print / jax.random / host timing outside jit all pass
+        assert run_rule("jit-purity", "jit_good.py") == []
+
+
+class TestHostSyncRule:
+    def test_bad_fixture_fires(self):
+        findings = run_rule("host-sync-in-hot-path", "host_sync_bad.py")
+        messages = "\n".join(f.message for f in findings)
+        assert ".item()" in messages
+        assert ".block_until_ready()" in messages
+        assert "float(jnp.max(scores))" in messages
+        assert "np.asarray(jnp.sort(scores))" in messages
+        assert "jax.device_get()" in messages
+        assert len(findings) == 5
+
+    def test_good_fixture_clean(self):
+        # float(<str>) / np.asarray(<host list>) must NOT be flagged
+        assert run_rule("host-sync-in-hot-path", "host_sync_good.py") == []
+
+
+class TestDtypeDisciplineRule:
+    def test_bad_fixture_fires(self):
+        findings = run_rule("dtype-discipline", "dtype_bad.py")
+        # np.float64 attr, dtype="float64", astype("float64"), np.float64()
+        assert len(findings) == 4
+        assert all("float64" in f.message for f in findings)
+
+    def test_good_fixture_clean_including_justified_suppression(self):
+        assert run_rule("dtype-discipline", "dtype_good.py") == []
+
+
+class TestUntimedBlockingIORule:
+    def test_bad_fixture_fires(self):
+        findings = run_rule("untimed-blocking-io", "io_bad.py")
+        messages = "\n".join(f.message for f in findings)
+        assert len(findings) == 4
+        assert "urlopen() without a timeout" in messages
+        assert "urlopen(timeout=None)" in messages
+        assert "create_connection() without a timeout" in messages
+        # positional None is the same spelled-out bug as timeout=None
+        assert "create_connection(timeout=None)" in messages
+
+    def test_good_fixture_clean(self):
+        # keyword timeout, config-field timeout, and the positional
+        # spellings of BOTH urlopen and create_connection
+        assert run_rule("untimed-blocking-io", "io_good.py") == []
+
+
+class TestLockDisciplineRule:
+    def test_bad_fixture_fires(self):
+        findings = run_rule("lock-discipline", "locks_bad.py")
+        messages = "\n".join(f.message for f in findings)
+        assert "UnguardedCounter.processed" in messages
+        # the write one self-call deep is still attributed to the thread
+        assert "TransitiveWriter._state" in messages
+        # locked writer + unlocked reader: the READ is the finding
+        assert "HalfLocked._latest" in messages and "read here" in messages
+        assert len(findings) == 3
+
+    def test_good_fixture_clean(self):
+        # both-sides locking, documented-atomic suppression, and private
+        # thread-local scratch state all pass
+        assert run_rule("lock-discipline", "locks_good.py") == []
+
+
+class TestSuppressionMachinery:
+    def test_missing_justification_is_reported_and_not_honored(self):
+        findings = run_rule("dtype-discipline", "suppress_bad.py")
+        rules_hit = {f.rule_id for f in findings}
+        # the unjustified lint-ignore is itself a finding...
+        assert BAD_SUPPRESSION in rules_hit
+        # ...and does NOT suppress the violation it sits above
+        assert "dtype-discipline" in rules_hit
+
+    def test_unknown_rule_id_is_reported(self):
+        findings = run_rule("dtype-discipline", "suppress_bad.py")
+        assert any("unknown rule 'definitely-not-a-rule'" in f.message
+                   for f in findings)
+
+    def test_parse_trailing_and_own_line(self):
+        src = (
+            "x = 1  # pio: lint-ignore[jit-purity]: trailing, justified\n"
+            "# pio: lint-ignore[dtype-discipline]: own line, justified\n"
+            "y = 2\n"
+        )
+        sups = parse_suppressions(src)
+        assert len(sups) == 2
+        trailing, own = sups
+        assert trailing.line == 1 and not trailing.own_line
+        assert own.line == 2 and own.own_line
+        assert own.justification == "own line, justified"
+
+    def test_string_literals_do_not_count(self):
+        src = 's = "# pio: lint-ignore[jit-purity]: inside a string"\n'
+        assert parse_suppressions(src) == ()
+
+    def test_multi_rule_suppression(self):
+        src = "z = 3  # pio: lint-ignore[jit-purity, dtype-discipline]: both\n"
+        (sup,) = parse_suppressions(src)
+        assert sup.rule_ids == ("jit-purity", "dtype-discipline")
+
+    def test_own_line_suppression_covers_multiline_statement(self, tmp_path):
+        # the finding anchors to the continuation line carrying dtype=;
+        # the suppression above the statement must waive ALL its lines
+        f = tmp_path / "multiline.py"
+        f.write_text(
+            "import numpy as np\n"
+            "# pio: lint-ignore[dtype-discipline]: justified oracle\n"
+            "x = np.zeros(\n"
+            "    (3,), dtype=np.float64)\n"
+        )
+        config = LintConfig(rules={
+            "dtype-discipline": RuleConfig(paths=("",)),
+        })
+        findings = lint_paths([str(f)], config=config,
+                              rule_ids=["dtype-discipline"])
+        assert findings == []
+
+    def test_trailing_suppression_at_statement_head_covers_continuation(
+            self, tmp_path):
+        f = tmp_path / "head.py"
+        f.write_text(
+            "import numpy as np\n"
+            "x = np.zeros(  # pio: lint-ignore[dtype-discipline]: oracle\n"
+            "    (3,), dtype=np.float64)\n"
+        )
+        config = LintConfig(rules={
+            "dtype-discipline": RuleConfig(paths=("",)),
+        })
+        assert lint_paths([str(f)], config=config,
+                          rule_ids=["dtype-discipline"]) == []
+
+    def test_own_line_suppression_does_not_waive_a_whole_block(
+            self, tmp_path):
+        # above a compound statement the waiver covers only the HEADER:
+        # one justified comment must never disable the rule for every
+        # current and future violation inside a function body
+        f = tmp_path / "block.py"
+        f.write_text(
+            "import numpy as np\n"
+            "# pio: lint-ignore[dtype-discipline]: header only\n"
+            "def build():\n"
+            "    return np.zeros(4, dtype=np.float64)\n"
+        )
+        config = LintConfig(rules={
+            "dtype-discipline": RuleConfig(paths=("",)),
+        })
+        findings = lint_paths([str(f)], config=config,
+                              rule_ids=["dtype-discipline"])
+        assert len(findings) == 1 and findings[0].line == 4
+
+
+class TestFrameworkSurface:
+    def test_rule_registry_is_complete(self):
+        assert set(all_rules()) >= {
+            "resilience-bypass", "jit-purity", "host-sync-in-hot-path",
+            "dtype-discipline", "untimed-blocking-io", "lock-discipline",
+        }
+
+    def test_unknown_rule_id_raises(self):
+        with pytest.raises(KeyError):
+            lint_paths([fixture("io_good.py")], rule_ids=["no-such-rule"])
+
+    def test_nonexistent_path_raises(self):
+        # a typo'd CI hook must fail loudly, never lint zero files clean
+        with pytest.raises(FileNotFoundError):
+            lint_paths([fixture("no_such_file.py")])
+
+    def test_overlapping_paths_do_not_double_report(self, tmp_path):
+        sub = tmp_path / "sub"
+        sub.mkdir()
+        bad = sub / "bad.py"
+        bad.write_text("import urllib.request\n"
+                       "urllib.request.urlopen('u')\n")
+        config = LintConfig(rules={
+            "untimed-blocking-io": RuleConfig(paths=("",)),
+        })
+        findings = lint_paths([str(tmp_path), str(sub), str(bad)],
+                              config=config,
+                              rule_ids=["untimed-blocking-io"])
+        assert len(findings) == 1
+
+    def test_unscoped_config_drops_module_keyed_policy(self, tmp_path):
+        # an unrelated external file named like a storage backend must
+        # not inherit the package guard tables (spurious stale-guard
+        # findings); it gets the generic strict policy instead
+        from predictionio_tpu.analysis import default_config
+
+        f = tmp_path / "postgres.py"
+        f.write_text("X = 1\n")
+        assert lint_paths([str(f)], config=default_config().unscoped()) == []
+
+    def test_findings_carry_file_line_and_rule(self):
+        findings = run_rule("untimed-blocking-io", "io_bad.py")
+        f = findings[0]
+        assert f.path == "io_bad.py" and f.line > 0
+        assert f.format().startswith("io_bad.py:")
+        assert "[untimed-blocking-io]" in f.format()

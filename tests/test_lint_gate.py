@@ -1,0 +1,72 @@
+"""Tier-1 gate: `pio lint` must pass CLEAN over the real package.
+
+This is the machine-checked form of the invariants that previously
+lived in reviewers' heads: every finding here is either a genuine new
+violation (fix it) or a deliberate exception (suppress it inline WITH a
+justification — see docs/static-analysis.md). The gate runs every
+registered rule with the repo policy config, exactly what
+`bin/pio-lint` runs in CI.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from predictionio_tpu.analysis import (
+    all_rules,
+    default_config,
+    format_findings,
+    lint_package,
+)
+
+pytestmark = pytest.mark.lint
+
+EXPECTED_RULES = {
+    "resilience-bypass",
+    "jit-purity",
+    "host-sync-in-hot-path",
+    "dtype-discipline",
+    "untimed-blocking-io",
+    "lock-discipline",
+}
+
+
+def test_rule_suite_is_complete():
+    """The gate is only as strong as its rule set: all six invariant
+    families must be registered AND enabled in the repo policy."""
+    registered = set(all_rules())
+    assert EXPECTED_RULES <= registered
+    enabled = set(default_config().enabled_rules())
+    assert EXPECTED_RULES <= enabled
+
+
+def test_package_lints_clean():
+    """All rules over all of predictionio_tpu/: zero findings. A failure
+    message IS the lint report — fix the violation or suppress it with
+    a justification at the site."""
+    findings = lint_package()
+    assert not findings, "\n" + format_findings(findings)
+
+
+def test_every_rule_actually_runs_on_the_package():
+    """Guard against a rule silently scoping itself out of existence:
+    each rule's configured paths must match at least one real file."""
+    import os
+
+    import predictionio_tpu
+
+    from predictionio_tpu.analysis.config import path_matches
+
+    pkg = os.path.dirname(predictionio_tpu.__file__)
+    relpaths = [
+        os.path.relpath(os.path.join(dirpath, f), pkg).replace(os.sep, "/")
+        for dirpath, _, files in os.walk(pkg)
+        for f in files
+        if f.endswith(".py")
+    ]
+    config = default_config()
+    for rule_id, rule in all_rules().items():
+        prefixes = config.rule_paths(rule)
+        assert any(path_matches(rp, prefixes) for rp in relpaths), (
+            f"{rule_id}: configured paths {prefixes} match no file under "
+            f"the package — the rule never runs")
