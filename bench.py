@@ -41,6 +41,10 @@ round is comparable on all axes:
   top-k scoring rate against a 2M-item catalog (the eval hot path).
   ``calibration_matmul_ms`` — fixed bf16 matmul anchor; quote
   ``rank200_iter_per_calib`` for regime-adjusted comparison.
+  ``serving_qps_*``/``serving_speedup_x``/``serving_cached_qps`` —
+  the serving-path section (bench_serving.py): adaptive micro-batcher
+  vs strict per-query dispatch under concurrent clients, and the
+  result-cache regime (full harness artifacts: BENCH_serving_rNN.json).
   ``sections_failed`` — ALWAYS present; [] means complete.
 - ``flash_s4096_ms``/``xla_s4096_ms`` — pallas flash (force=True) vs
   XLA attention forward at S=4096. Tracking this pair is what caught
@@ -724,6 +728,17 @@ def _bench_batched_serving(deployed, query_uix, clients: int = 32,
         server.stop()
 
 
+def bench_serving_path():
+    """Adaptive micro-batcher vs strict per-query dispatch over HTTP
+    loopback, plus the cached regime — the PR 3 serving-path
+    trajectory. Standalone harness: bench_serving.py (committed
+    artifacts: BENCH_serving_rNN.json); this section runs it at
+    reduced volume so every round's line carries the serving numbers."""
+    import bench_serving
+
+    return bench_serving.bench_section()
+
+
 def bench_batch_predict(n_items: int = 2_000_000, batch: int = 256,
                         rounds: int = 8):
     """Batched top-k scoring against a 2M-item catalog — the eval hot
@@ -1152,6 +1167,7 @@ def main() -> None:
         ("phases", lambda: bench_phases(users, items, vals)),
         ("rank200", lambda: bench_rank200(users, items, vals)),
         ("serving", lambda: bench_serving(user_f, item_f, users, items)),
+        ("serving_path", bench_serving_path),
         ("attention", bench_attention),
         ("quality", bench_quality),
         ("seqrec", bench_seqrec),

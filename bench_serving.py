@@ -1,0 +1,433 @@
+"""Serving-path benchmark: QPS + latency percentiles under concurrency.
+
+Every BENCH_*.json before PR 3 tracked only ALS *training* throughput;
+this harness gives the serving hot path its own trajectory. It stands
+up a real ``EngineServer`` (HTTP loopback, the production handler
+stack) over a synthetic ALS model and drives it with N concurrent
+clients in three configurations:
+
+- ``per_query``  — strict one-predict-per-request dispatch
+                   (``batch_policy="fixed", batch_max=1``: the
+                   reference PredictionIO serving model,
+                   CreateServer.scala:495-497), the baseline;
+- ``adaptive``   — the PR 3 adaptive micro-batcher (EWMA wait,
+                   menu-snapped batch sizes, dedup);
+- ``cached``     — adaptive + the result cache, clients drawing from a
+                   small hot query pool (the repeated-query regime the
+                   cache exists for).
+
+Prints ONE JSON line in the BENCH contract
+(``{"metric", "value", "unit", ...}``), with p50/p95/p99 per phase and
+the adaptive-vs-per-query speedup. Runs anywhere jax runs — CPU
+(``JAX_PLATFORMS=cpu``) included; the batching win it measures is the
+amortization of per-dispatch overhead (kernel launch + factor-table
+traversal shared across the batch), which exists on every backend and
+grows with the device RTT.
+
+Also importable: ``bench.py`` wires :func:`bench_section` in as the
+``serving_path`` section so the round artifacts carry these numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import threading
+import time
+
+import numpy as np
+
+DEF_ITEMS = 100_000
+DEF_RANK = 32
+DEF_CLIENTS = 24
+DEF_PER_CLIENT = 25
+DEF_WARMUP = 4
+#: the uncached phases draw uniformly from this many distinct queries —
+#: recommendation traffic is popularity-skewed, and a hot pool is what
+#: gives the batcher's dedup pass (and the baseline, which cannot
+#: exploit duplicates) the same realistic workload; the artifact
+#: reports the observed dedup count alongside the pool size
+DEF_POOL = 64
+
+
+def build_deployed(items: int = DEF_ITEMS, rank: int = DEF_RANK,
+                   users: int = 2048, seed: int = 7):
+    """A DeployedEngine over a synthetic ALS model (device-resident
+    factors, string entity ids — the production shape, minus training)."""
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.controller.base import FirstServing
+    from predictionio_tpu.models.als import ALSModel
+    from predictionio_tpu.storage.base import EngineInstance
+    from predictionio_tpu.templates import recommendation as rec
+    from predictionio_tpu.utils.bimap import BiMap, EntityIdIxMap
+    from predictionio_tpu.workflow.deploy import DeployedEngine
+
+    rng = np.random.default_rng(seed)
+    user_f = rng.standard_normal((users, rank)).astype(np.float32)
+    item_f = rng.standard_normal((items, rank)).astype(np.float32)
+    seen_by_user = {
+        u: rng.choice(items, size=8, replace=False).astype(np.int32)
+        for u in range(users)
+    }
+    model = ALSModel(
+        rank=rank,
+        user_factors=jax.device_put(jnp.asarray(user_f)),
+        item_factors=jax.device_put(jnp.asarray(item_f)),
+        user_ids=EntityIdIxMap(BiMap({f"u{i}": i for i in range(users)})),
+        item_ids=EntityIdIxMap(BiMap({f"i{i}": i for i in range(items)})),
+        seen_by_user=seen_by_user,
+    )
+    algo = rec.ALSAlgorithm(rec.ALSAlgorithmParams(rank=rank, use_mesh=False))
+    now = datetime.datetime.now(datetime.timezone.utc)
+    instance = EngineInstance(
+        id="bench-serving", status="COMPLETED", start_time=now,
+        completion_time=now, engine_id="bench-serving", engine_version="1",
+        engine_variant="bench-serving", engine_factory="bench-serving",
+    )
+    return DeployedEngine(None, instance, [algo], FirstServing(), [model])
+
+
+def warm_batch_signatures(deployed, batch_max: int) -> None:
+    """Pre-compile every padded batch signature the coalescer can
+    produce (the power-of-two menu): a signature first seen inside the
+    timed loop would bill a jit compile as serving time."""
+    from predictionio_tpu.ops.topk import BATCH_WIDTHS
+    from predictionio_tpu.templates import recommendation as rec
+
+    for b in BATCH_WIDTHS:
+        if b > max(batch_max, 1):
+            break
+        deployed.query_batch(
+            [rec.Query(user=f"u{j}", num=10) for j in range(b)])
+
+
+#: client processes the load splits across — IN-PROCESS client threads
+#: share the server's GIL and collapse the measurement (24 in-process
+#: clients drove pure-HTTP throughput from ~570 to ~105 req/s on this
+#: 2-core host purely from GIL convoy); ONE separate process keeps the
+#: server's interpreter lock free without starving a small host's
+#: cores (3 measured best on this 2-core host once the server's
+#: buffered-write/NODELAY response path landed; tune via
+#: --client-procs)
+DEF_CLIENT_PROCS = 3
+
+
+def _client_main(argv: list[str]) -> None:
+    """Load-generator subprocess: ``--threads`` keep-alive connections
+    fire ``--count`` queries each after a GO handshake on stdin (so all
+    processes start together and startup cost stays out of the timed
+    window); per-request latencies go back as one JSON line."""
+    import argparse
+    import sys
+
+    sys.setswitchinterval(0.0005)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--threads", type=int, required=True)
+    ap.add_argument("--count", type=int, required=True)
+    ap.add_argument("--warmup", type=int, default=DEF_WARMUP)
+    ap.add_argument("--cid0", type=int, default=0,
+                    help="first global client id (seeds each client's "
+                         "independent RNG over the shared pool)")
+    ap.add_argument("--pool-size", type=int, required=True)
+    args = ap.parse_args(argv)
+
+    import random
+    import socket
+
+    # wrk-style raw-socket clients: full request bytes pre-built per
+    # pool entry, responses parsed with a minimal Content-Length
+    # scanner. http.client costs ~2ms of CPU per request (header
+    # assembly + email-parser response headers), and on a small host
+    # the load generator's CPU comes out of the server's budget —
+    # a benchmark client must be cheaper than the thing it measures.
+    requests = []
+    for i in range(args.pool_size):
+        body = json.dumps({"user": f"u{i}", "num": 10}).encode()
+        requests.append(
+            b"POST /queries.json HTTP/1.1\r\n"
+            b"Host: 127.0.0.1\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+            b"\r\n" + body)
+    lat: list[list[float]] = [[] for _ in range(args.threads)]
+    errors = [0] * args.threads
+
+    def read_response(sock: socket.socket, buf: bytearray) -> None:
+        # headers, then exactly Content-Length body bytes (the server
+        # always sends Content-Length — engine_server._respond)
+        while True:
+            head_end = buf.find(b"\r\n\r\n")
+            if head_end >= 0:
+                break
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("closed mid-headers")
+            buf += chunk
+        head = bytes(buf[:head_end]).lower()
+        marker = b"content-length:"
+        at = head.find(marker)
+        if at < 0:
+            raise ConnectionError("no content-length")
+        line_end = head.find(b"\r\n", at)
+        if line_end < 0:
+            line_end = len(head)   # Content-Length was the last header
+        length = int(head[at + len(marker):line_end])
+        need = head_end + 4 + length
+        while len(buf) < need:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("closed mid-body")
+            buf += chunk
+        del buf[:need]
+
+    def client(tid: int, count: int, record: bool) -> None:
+        cid = args.cid0 + tid
+        # uniform draws over the shared pool (seeded per client):
+        # deterministic striding would minimize concurrent duplicates
+        # and understate what a popularity-skewed workload hands the
+        # dedup pass
+        rng = random.Random(1000 + cid)
+        sock: socket.socket | None = None
+        buf = bytearray()
+        try:
+            for j in range(count):
+                req = requests[rng.randrange(args.pool_size)]
+                t0 = time.perf_counter()
+                try:
+                    if sock is None:
+                        sock = socket.create_connection(
+                            ("127.0.0.1", args.port), timeout=120)
+                        sock.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                        buf.clear()
+                    sock.sendall(req)
+                    read_response(sock, buf)
+                except OSError:
+                    errors[tid] += 1
+                    if sock is not None:
+                        sock.close()
+                    sock = None        # reconnects on next request
+                    continue
+                if record:
+                    lat[tid].append(time.perf_counter() - t0)
+        finally:
+            if sock is not None:
+                sock.close()
+
+    def run(count: int, record: bool) -> None:
+        threads = [
+            threading.Thread(target=client, args=(t, count, record))
+            for t in range(args.threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    run(args.warmup, record=False)
+    print("READY", flush=True)
+    sys.stdin.readline()            # GO
+    run(args.count, record=True)
+    print(json.dumps({
+        "lat": [x for per in lat for x in per],
+        "errors": int(sum(errors)),
+    }), flush=True)
+
+
+def _run_round(port: int, pool_size: int, clients: int, per_client: int,
+               warmup: int, procs: int) -> dict:
+    """One synchronized multi-process load round against ``port``."""
+    import subprocess
+    import sys
+
+    procs = max(1, min(procs, clients))
+    per_proc = [clients // procs + (1 if i < clients % procs else 0)
+                for i in range(procs)]
+    children = []
+    cid0 = 0
+    for n_threads in per_proc:
+        children.append(subprocess.Popen(
+            [sys.executable, __file__, "--client",
+             "--port", str(port), "--threads", str(n_threads),
+             "--count", str(per_client), "--warmup", str(warmup),
+             "--cid0", str(cid0), "--pool-size", str(pool_size)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True))
+        cid0 += n_threads
+    for child in children:
+        assert child.stdout.readline().strip() == "READY"
+    t0 = time.perf_counter()
+    for child in children:
+        child.stdin.write("GO\n")
+        child.stdin.flush()
+    outs = [json.loads(child.stdout.readline()) for child in children]
+    dt = time.perf_counter() - t0
+    for child in children:
+        child.wait(timeout=30)
+    flat = np.asarray([x for o in outs for x in o["lat"]])
+    done = int(flat.size)
+    return {
+        "qps": round(done / dt, 1),
+        "p50_ms": round(float(np.percentile(flat, 50)) * 1e3, 2),
+        "p95_ms": round(float(np.percentile(flat, 95)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(flat, 99)) * 1e3, 2),
+        "queries": done,
+        "errors": int(sum(o["errors"] for o in outs)),
+    }
+
+
+def _drive(port: int, user_pool: list[str], clients: int, per_client: int,
+           warmup: int = DEF_WARMUP, rounds: int = 2,
+           procs: int = DEF_CLIENT_PROCS) -> dict:
+    """N keep-alive clients (split over separate processes), M queries
+    each, best of ``rounds`` synchronized rounds — the 2-core host's
+    load shifts swing single-round QPS, and the best round is the
+    least-interfered measurement of the same code (bench.py's min-of-N
+    discipline). Every client draws uniformly from the SHARED hot pool
+    (_client_main) — concurrent duplicates are part of the workload,
+    and the adaptive phase's dedup pass exploiting them while the
+    per-query baseline cannot is part of what the ratio measures."""
+    best = None
+    for _ in range(rounds):
+        candidate = _run_round(port, len(user_pool), clients, per_client,
+                               warmup, procs)
+        if best is None or candidate["qps"] > best["qps"]:
+            best = candidate
+    return best
+
+
+def _stats_doc(port: int) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats.json", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def bench_serving(items: int = DEF_ITEMS, rank: int = DEF_RANK,
+                  clients: int = DEF_CLIENTS,
+                  per_client: int = DEF_PER_CLIENT,
+                  batch_max: int = 32, hot_pool: int = 32,
+                  rounds: int = 4, procs: int = DEF_CLIENT_PROCS) -> dict:
+    from predictionio_tpu.api.engine_server import EngineServer
+    from predictionio_tpu.workflow.deploy import ServerConfig
+
+    deployed = build_deployed(items=items, rank=rank)
+    warm_batch_signatures(deployed, batch_max)
+    users = len(deployed.models[0].user_ids)
+    pool = [f"u{i}" for i in range(min(users, DEF_POOL))]
+
+    # per_query (strict one-predict-per-request, the reference serving
+    # model) and adaptive run INTERLEAVED, best round per config: the
+    # host's load drifts minute to minute, and the headline is their
+    # RATIO — alternating rounds sample comparable conditions (the
+    # same reasoning as bench.py's interleaved _chain_time_many)
+    base_server = EngineServer(deployed, ServerConfig(
+        ip="127.0.0.1", port=0, batching=True,
+        batch_policy="fixed", batch_max=1, batch_wait_ms=0.0))
+    adapt_server = EngineServer(deployed, ServerConfig(
+        ip="127.0.0.1", port=0, batching=True,
+        batch_policy="adaptive", batch_max=batch_max, batch_wait_ms=5.0))
+    base_server.start()
+    adapt_server.start()
+    base = adaptive = None
+    try:
+        for _ in range(rounds):
+            b = _drive(base_server.port, pool, clients, per_client,
+                       rounds=1, procs=procs)
+            a = _drive(adapt_server.port, pool, clients, per_client,
+                       rounds=1, procs=procs)
+            if base is None or b["qps"] > base["qps"]:
+                base = b
+            if adaptive is None or a["qps"] > adaptive["qps"]:
+                adaptive = a
+        astats = _stats_doc(adapt_server.port)
+    finally:
+        base_server.stop()
+        adapt_server.stop()
+
+    # repeated-query regime: adaptive + result cache over a hot pool
+    cache_server = EngineServer(deployed, ServerConfig(
+        ip="127.0.0.1", port=0, batching=True,
+        batch_policy="adaptive", batch_max=batch_max, batch_wait_ms=5.0,
+        cache_enabled=True, cache_ttl_s=300.0))
+    cache_server.start()
+    try:
+        cached = _drive(cache_server.port, pool[:hot_pool], clients,
+                        per_client, rounds=rounds, procs=procs)
+        cstats = _stats_doc(cache_server.port)
+    finally:
+        cache_server.stop()
+
+    out = {
+        "metric": f"serving_qps_adaptive_{clients}c",
+        "value": adaptive["qps"],
+        "unit": "qps",
+        "p50_ms": adaptive["p50_ms"],
+        "p95_ms": adaptive["p95_ms"],
+        "p99_ms": adaptive["p99_ms"],
+        "per_query_qps": base["qps"],
+        "per_query_p50_ms": base["p50_ms"],
+        "per_query_p99_ms": base["p99_ms"],
+        "speedup_vs_per_query_x": round(
+            adaptive["qps"] / base["qps"], 2) if base["qps"] else None,
+        "cached_qps": cached["qps"],
+        "cached_p50_ms": cached["p50_ms"],
+        "cache_hit_ratio": cstats["serving"]["cacheHitRatio"],
+        "clients": clients,
+        "queries_per_phase": adaptive["queries"],
+        "errors": base["errors"] + adaptive["errors"] + cached["errors"],
+        "batch_size_histogram": astats["serving"]["batchSizeHistogram"],
+        "ewma_interarrival_ms": astats["batching"]["ewmaInterarrivalMs"],
+        "deduped": astats["serving"]["deduped"],
+        "items": items,
+        "rank": rank,
+    }
+    return out
+
+
+def bench_section(clients: int = DEF_CLIENTS) -> dict:
+    """The ``serving_path`` section for bench.py's round artifact:
+    the same phases at reduced volume, keys prefixed for the merged
+    BENCH line."""
+    r = bench_serving(clients=clients, per_client=16)
+    return {
+        f"serving_qps_adaptive_{clients}c": r["value"],
+        f"serving_qps_per_query_{clients}c": r["per_query_qps"],
+        "serving_speedup_x": r["speedup_vs_per_query_x"],
+        "serving_p95_ms": r["p95_ms"],
+        "serving_cached_qps": r["cached_qps"],
+        "serving_cache_hit_ratio": r["cache_hit_ratio"],
+    }
+
+
+def main() -> None:
+    import sys
+
+    if "--client" in sys.argv:
+        # load-generator subprocess entry (spawned by _run_round)
+        _client_main([a for a in sys.argv[1:] if a != "--client"])
+        return
+    # 48+ threads at CPython's default 5ms GIL switch interval add
+    # multi-ms scheduling jitter per request; tighten it for the
+    # serving process (the client processes do the same)
+    sys.setswitchinterval(0.0005)
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--items", type=int, default=DEF_ITEMS)
+    parser.add_argument("--rank", type=int, default=DEF_RANK)
+    parser.add_argument("--clients", type=int, default=DEF_CLIENTS)
+    parser.add_argument("--per-client", type=int, default=DEF_PER_CLIENT)
+    parser.add_argument("--batch-max", type=int, default=32)
+    parser.add_argument("--client-procs", type=int, default=DEF_CLIENT_PROCS)
+    args = parser.parse_args()
+    print(json.dumps(bench_serving(
+        items=args.items, rank=args.rank, clients=args.clients,
+        per_client=args.per_client, batch_max=args.batch_max,
+        procs=args.client_procs)))
+
+
+if __name__ == "__main__":
+    main()

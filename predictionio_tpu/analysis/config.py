@@ -87,8 +87,9 @@ def path_matches(relpath: str, prefixes: tuple[str, ...]) -> bool:
 #: the compute subtrees that must stay TPU-friendly (f32/bf16, pure jit)
 COMPUTE_PATHS = ("ops/", "models/", "e2/")
 
-#: request-serving hot path: handler threads + the deployed query path
-HOT_PATHS = ("api/", "workflow/deploy.py")
+#: request-serving hot path: handler threads, the deployed query path,
+#: and the batching/cache subsystem (serving/ — PR 3)
+HOT_PATHS = ("api/", "workflow/deploy.py", "serving/")
 
 
 def default_config() -> LintConfig:
@@ -96,7 +97,9 @@ def default_config() -> LintConfig:
     return LintConfig(
         rules={
             "resilience-bypass": RuleConfig(
-                paths=("storage/",),
+                # serving/ carries the strictest policy (no guard-table
+                # entries): any raw network call there is a violation
+                paths=("storage/", "serving/"),
                 options={
                     # raw-network callables we police
                     "net_calls": ["urlopen", "create_connection"],

@@ -16,6 +16,9 @@ Route and behavior parity with the reference deploy server
 - ``GET /plugins.json``  plugin listing (:648-671)
 - ``GET /healthz``       liveness (beyond reference; k8s-style contract)
 - ``GET /readyz``        readiness: model loaded + storage reachable
+- ``GET /stats.json``    serving hot-path internals (beyond reference):
+                         batch-size histogram, adaptive-wait EWMA,
+                         cache hit ratio, dedup count, resilience
 
 Graceful degradation (beyond reference, docs/operations-resilience.md):
 storage-unavailable failures map to ``503`` + ``Retry-After`` instead of
@@ -29,6 +32,15 @@ The reference's MasterActor/ServerActor pair collapses to
 ``EngineService`` (transport-free request logic). The feedback loop
 (:514-576) POSTs ``predict`` events to the event server from a
 fire-and-forget thread, tagging responses with a ``prId``.
+
+Serving hot path (docs/serving-performance.md): the query envelope
+binds/encodes through the precompiled codecs (core/json_codec.
+compile_wire_decoder / encode_wire) instead of the per-request
+reflective binder; an opt-in result cache (ServerConfig.cache_enabled)
+answers repeated queries without a dispatch and invalidates atomically
+on /reload; the micro-batcher is policy-driven
+(ServerConfig.batch_policy — adaptive EWMA wait by default) with
+per-batch dedup of identical concurrent queries.
 """
 
 from __future__ import annotations
@@ -51,8 +63,14 @@ from typing import Any, Mapping
 from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu.api.http_base import RestServer, bounded_probe
-from predictionio_tpu.api.stats import resilience_snapshot
-from predictionio_tpu.core.wire import from_wire, to_wire
+from predictionio_tpu.api.stats import ServingStats, resilience_snapshot
+from predictionio_tpu.core.json_codec import (
+    canonical_json,
+    compile_wire_decoder,
+    encode_wire,
+)
+from predictionio_tpu.serving.batch_policy import make_batch_policy
+from predictionio_tpu.serving.result_cache import ResultCache
 from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.utils.resilience import (
     STORAGE_UNAVAILABLE_ERRORS,
@@ -190,11 +208,14 @@ class EngineService:
     def __init__(
         self,
         deployed: DeployedEngine,
-        config: ServerConfig = ServerConfig(),
+        config: ServerConfig | None = None,
         storage: Storage | None = None,
         ctx: EngineContext | None = None,
         plugin_context: EngineServerPluginContext | None = None,
     ):
+        # built at CALL time: a module-level default instance would
+        # freeze the PIO_SERVING_* env reads at import
+        config = config if config is not None else ServerConfig()
         self.deployed = deployed
         self.config = config
         self.storage = storage
@@ -204,14 +225,32 @@ class EngineService:
         self.on_stop = lambda: None
         #: set by the HTTP wrapper; mid-request client-disconnect count
         self.client_disconnects = lambda: 0
+        #: one counter set shared by batcher + cache (GET /stats.json)
+        self.serving_stats = ServingStats()
+        #: opt-in result cache: canonical-query-JSON -> prediction,
+        #: invalidated on successful /reload (ResultCache docs)
+        self.cache: ResultCache | None = (
+            ResultCache(max_entries=config.cache_max_entries,
+                        ttl_s=config.cache_ttl_s,
+                        stats=self.serving_stats)
+            if config.cache_enabled else None
+        )
         #: opt-in micro-batching: concurrent queries coalesce into one
-        #: device dispatch (ServerConfig.batching; QueryBatcher docs)
+        #: device dispatch (ServerConfig.batching; QueryBatcher docs);
+        #: the wait/target per batch comes from the configured policy
         self.batcher: QueryBatcher | None = (
             QueryBatcher(lambda: self.deployed,
-                         batch_max=config.batch_max,
-                         batch_wait_ms=config.batch_wait_ms)
+                         policy=make_batch_policy(config.batch_policy,
+                                                  config.batch_max,
+                                                  config.batch_wait_ms),
+                         stats=self.serving_stats)
             if config.batching else None
         )
+        #: precompiled query binder — refreshed on /reload with the new
+        #: instance's query class (core/json_codec fast path)
+        self._query_decoder = (
+            compile_wire_decoder(qc)
+            if (qc := deployed.query_class) is not None else None)
         #: deadline enforcement for the NON-batched path: the query runs
         #: on a pool thread so a blown budget returns 503 instead of
         #: holding the socket (threads spawn lazily; idle pool is free)
@@ -245,6 +284,8 @@ class EngineService:
                 return self.handle_query(body, headers)
             if method == "GET" and path == "/plugins.json":
                 return (200, self.plugins.describe())
+            if method == "GET" and path == "/stats.json":
+                return (200, self.stats_doc())
             if method == "GET" and path == "/healthz":
                 # liveness: the process answers; nothing else implied
                 return (200, {"status": "ok"})
@@ -339,9 +380,35 @@ class EngineService:
             **({"batching": {
                 "batches": self.batcher.batches,
                 "batchedQueries": self.batcher.batched_queries,
-                "batchMax": self.config.batch_max,
+                # batchMax comes from the policy snapshot below — the
+                # EFFECTIVE (menu-clamped) value, not the raw config
                 "batchWaitMs": self.config.batch_wait_ms,
+                **self.batcher.policy.snapshot(),
             }} if self.batcher is not None else {}),
+            **({"resilience": snap} if (snap := resilience_snapshot()) else {}),
+        }
+
+    def stats_doc(self) -> dict:
+        """GET /stats.json — the serving hot path's internals (beyond
+        reference; docs/serving-performance.md): batch-size histogram,
+        the adaptive policy's inter-arrival EWMA and last plan, cache
+        hit/miss/eviction counters and dedup count, per-backend
+        resilience state. All counters are read under their own locks
+        (ServingStats), so a concurrent burst never tears the doc."""
+        d = self.deployed
+        return {
+            "engineInstanceId": d.instance.id,
+            "requestCount": d.request_count,
+            "avgServingSec": d.avg_serving_sec,
+            "lastServingSec": d.last_serving_sec,
+            "clientDisconnects": self.client_disconnects(),
+            "serving": self.serving_stats.snapshot(),
+            "batching": (
+                {"enabled": True, **self.batcher.policy.snapshot()}
+                if self.batcher is not None else {"enabled": False}),
+            "cache": (
+                {"enabled": True, **self.cache.snapshot()}
+                if self.cache is not None else {"enabled": False}),
             **({"resilience": snap} if (snap := resilience_snapshot()) else {}),
         }
 
@@ -390,37 +457,62 @@ class EngineService:
             raise _Reject(400, "the request body must be a JSON object")
         # prId is feedback-loop metadata carried alongside any query
         # (CreateServer.scala:506-512), not a query field — strip before
-        # binding so strict from_wire doesn't reject it
+        # binding so the strict binder doesn't reject it
         body = dict(body)
         pr_id_in = body.pop("prId", None)
-        query_class = self.deployed.query_class
+        decoder = self._query_decoder
         try:
-            query = from_wire(query_class, body) if query_class else body
+            query = decoder(body) if decoder is not None else body
         except (ValueError, TypeError) as e:
             raise _Reject(400, f"invalid query: {e}")
 
         budget = self._deadline_budget(headers)
-        try:
-            with deadline_scope(budget) if budget is not None \
-                    else contextlib.nullcontext():
-                if self.batcher is not None:
-                    prediction = self.batcher.submit(
-                        query, timeout=budget if budget is not None else 300.0)
-                elif budget is not None:
-                    prediction = self._query_with_deadline(query, budget)
-                else:
-                    prediction = self.deployed.query(query)
-        except QueryDeadlineExceeded as e:
-            # a blown deadline is overload/degradation, not an
-            # application error: 503 so the client retries later
-            raise _Reject(503, str(e), {"Retry-After": "1"})
-        except STORAGE_UNAVAILABLE_ERRORS as e:
-            logger.warning("query failed on unavailable storage: %s", e)
-            raise _Reject(503, f"storage unavailable: {e}",
-                          {"Retry-After": f"{retry_after_hint(e):.0f}"})
-        except Exception as e:
-            logger.exception("query failed")
-            raise _Reject(500, f"query failed: {e}")
+        # one canonical key serves both the result cache and the
+        # batcher's dedup pass; None when neither wants it. Keyed on
+        # the BOUND query's wire form, not the raw body, so camelCase
+        # and snake_case spellings of the same query share an entry
+        # (the ResultCache contract)
+        key = (canonical_json(encode_wire(query))
+               if (self.cache is not None or self.batcher is not None)
+               else None)
+        hit, generation = False, None
+        if self.cache is not None:
+            t0 = time.perf_counter()
+            hit, cached, generation = self.cache.lookup(key)
+        if hit:
+            prediction = cached
+            # a hit IS an answered query: requestCount / serving-time
+            # bookkeeping must not report a hot cache as an idle server
+            self.deployed.record_served(time.perf_counter() - t0)
+        else:
+            try:
+                with deadline_scope(budget) if budget is not None \
+                        else contextlib.nullcontext():
+                    if self.batcher is not None:
+                        prediction = self.batcher.submit(
+                            query,
+                            timeout=budget if budget is not None else 300.0,
+                            key=key)
+                    elif budget is not None:
+                        prediction = self._query_with_deadline(query, budget)
+                    else:
+                        prediction = self.deployed.query(query)
+            except QueryDeadlineExceeded as e:
+                # a blown deadline is overload/degradation, not an
+                # application error: 503 so the client retries later
+                raise _Reject(503, str(e), {"Retry-After": "1"})
+            except STORAGE_UNAVAILABLE_ERRORS as e:
+                logger.warning("query failed on unavailable storage: %s", e)
+                raise _Reject(503, f"storage unavailable: {e}",
+                              {"Retry-After": f"{retry_after_hint(e):.0f}"})
+            except Exception as e:
+                logger.exception("query failed")
+                raise _Reject(500, f"query failed: {e}")
+            if self.cache is not None:
+                # generational put: a result computed against a model
+                # that /reload swapped out mid-flight is dropped, not
+                # cached into the new model's generation
+                self.cache.put(key, prediction, generation=generation)
 
         info = QueryInfo(
             query=query,
@@ -436,7 +528,7 @@ class EngineService:
             raise _Reject(403, f"prediction rejected: {e}")
         self.plugins.notify_sniffers(info)
 
-        response = to_wire(prediction)
+        response = encode_wire(prediction)
         if not isinstance(response, dict):
             response = {"result": response}
         if self.config.feedback:
@@ -474,6 +566,15 @@ class EngineService:
         )
         old_id = self.deployed.instance.id
         self.deployed = new
+        self._query_decoder = (
+            compile_wire_decoder(qc)
+            if (qc := new.query_class) is not None else None)
+        if self.cache is not None:
+            # swap THEN invalidate: entries computed against the old
+            # model die with its generation (ResultCache docstring); a
+            # FAILED reload never reaches here, so last-known-good
+            # keeps its warm cache
+            self.cache.invalidate()
         logger.info("reloaded: instance %s -> %s", old_id, new.instance.id)
 
     # -- feedback loop ------------------------------------------------------
@@ -517,21 +618,67 @@ class EngineService:
 class _Handler(BaseHTTPRequestHandler):
     service: EngineService  # bound per server
 
+    # HTTP/1.1 keep-alive: the stdlib default (1.0) closes the socket
+    # after every response, so each query paid a TCP connect + a fresh
+    # ThreadingHTTPServer thread — measured as the dominant serving
+    # cost at high concurrency (bench_serving.py). Persistent
+    # connections make the per-request cost one read/write on a
+    # long-lived thread. Requires the Content-Length header on every
+    # response, which _respond always sends.
+    protocol_version = "HTTP/1.1"
+
+    # ...and a read timeout, or every idle persistent connection pins
+    # its handler thread (and fd) for the life of the process —
+    # handle_one_request treats the timeout as close_connection, so an
+    # idle client is simply hung up on and reconnects transparently
+    timeout = 30
+
+    # buffer the response: the stdlib default (wbufsize=0) issues one
+    # write() syscall PER HEADER LINE, and with Nagle enabled those
+    # small segments can stall behind delayed ACKs; one buffered write
+    # per response (handle_one_request flushes) + TCP_NODELAY keeps a
+    # response to a single segment
+    wbufsize = 64 * 1024
+    disable_nagle_algorithm = True
+
     def _params(self) -> dict[str, str]:
         return {k: v[0] for k, v in parse_qs(urlparse(self.path).query).items()}
 
     def _dispatch(self, method: str) -> None:
         path = urlparse(self.path).path
         body: Any = None
-        if method == "POST":
+        if self.headers.get("Transfer-Encoding"):
+            # chunked bodies are not decoded here; on a keep-alive
+            # (HTTP/1.1) connection the unread chunks would desync
+            # every later request on the socket — 411 and CLOSE
+            # (RFC 9112 §6.3 allows rejecting chunked with 411)
+            self.close_connection = True
+            self._respond(411, {
+                "message": "chunked request bodies are not supported; "
+                           "send Content-Length"},
+                {"Connection": "close"})
+            return
+        # drain a Content-Length body for EVERY method: on a keep-alive
+        # connection unread body bytes would be parsed as the next
+        # request line (non-POST bodies are drained and ignored). A
+        # malformed/negative length cannot be drained reliably — 400
+        # and CLOSE (read(-1) would block to EOF and pin the thread)
+        try:
             length = int(self.headers.get("Content-Length") or 0)
-            raw = self.rfile.read(length) if length else b""
-            if raw:
-                try:
-                    body = json.loads(raw)
-                except json.JSONDecodeError:
-                    self._respond(400, {"message": "the request body is not valid JSON"})
-                    return
+        except ValueError:
+            length = -1
+        if length < 0:
+            self.close_connection = True
+            self._respond(400, {"message": "invalid Content-Length"},
+                          {"Connection": "close"})
+            return
+        raw = self.rfile.read(length) if length else b""
+        if method == "POST" and raw:
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                self._respond(400, {"message": "the request body is not valid JSON"})
+                return
         # header names are case-insensitive (RFC 9110); normalise once
         headers = {k.lower(): v for k, v in self.headers.items()}
         result = self.service.handle(
@@ -599,11 +746,12 @@ class EngineServer(RestServer):
     def __init__(
         self,
         deployed: DeployedEngine,
-        config: ServerConfig = ServerConfig(),
+        config: ServerConfig | None = None,
         storage: Storage | None = None,
         ctx: EngineContext | None = None,
         plugin_context: EngineServerPluginContext | None = None,
     ):
+        config = config if config is not None else ServerConfig()
         self.config = config
         super().__init__(
             _Handler,
@@ -627,13 +775,14 @@ class EngineServer(RestServer):
 
 def create_engine_server(
     storage: Storage | None = None,
-    config: ServerConfig = ServerConfig(),
+    config: ServerConfig | None = None,
     ctx: EngineContext | None = None,
     engine: Any = None,
     plugin_context: EngineServerPluginContext | None = None,
 ) -> EngineServer:
     """Load the engine instance and bind the server — CreateServer.main
     (CreateServer.scala:105-180)."""
+    config = config if config is not None else ServerConfig()
     storage = storage or Storage.default()
     deployed = load_deployed_engine(storage=storage, config=config, ctx=ctx, engine=engine)
     return EngineServer(deployed, config, storage, ctx, plugin_context)

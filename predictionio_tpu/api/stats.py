@@ -8,7 +8,10 @@ instead of an actor mailbox.
 
 Beyond reference: :func:`resilience_snapshot` surfaces the per-backend
 retry/circuit-breaker counters (utils/resilience registry) so both
-servers' stats/status documents show backend health alongside traffic.
+servers' stats/status documents show backend health alongside traffic,
+and :class:`ServingStats` carries the engine server's hot-path counters
+(batch-size histogram, adaptive-wait EWMA input, result-cache hit/miss/
+eviction, per-batch dedup) for ``GET /stats.json``.
 """
 
 from __future__ import annotations
@@ -20,6 +23,7 @@ from datetime import datetime, timezone
 
 from predictionio_tpu.core.event import Event
 from predictionio_tpu.core.json_codec import format_datetime
+from predictionio_tpu.core.wire import snake_to_camel
 
 
 def resilience_snapshot() -> dict:
@@ -29,6 +33,57 @@ def resilience_snapshot() -> dict:
     from predictionio_tpu.utils.resilience import registry_snapshot
 
     return registry_snapshot()
+
+
+class ServingStats:
+    """Counters for the engine server's query hot path, written by the
+    batcher dispatcher (batch records), the result cache (hit/miss/
+    eviction), and handler threads (expiries) — one lock guards every
+    field at writers AND readers, the same discipline as
+    :class:`StatsKeeper`/``ResilienceMetrics``, so no reader ever sees a
+    torn histogram and the lock-discipline lint needs no suppressions."""
+
+    COUNTER_FIELDS = (
+        "dispatches", "batched_queries", "deduped", "expired",
+        "cache_hits", "cache_misses", "cache_evictions",
+        "cache_expirations", "cache_invalidations",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = dict.fromkeys(self.COUNTER_FIELDS, 0)
+        #: dispatched (post-dedup) batch size -> count
+        self._batch_hist: Counter[int] = Counter()
+
+    def bump(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[field] += n
+
+    def record_batch(self, dispatched: int, coalesced: int) -> None:
+        """One device dispatch: ``dispatched`` unique queries actually
+        scored, ``coalesced`` queries answered by it (>= dispatched when
+        the dedup pass folded identical concurrent queries)."""
+        with self._lock:
+            self._counts["dispatches"] += 1
+            self._counts["batched_queries"] += coalesced
+            self._counts["deduped"] += coalesced - dispatched
+            self._batch_hist[dispatched] += 1
+
+    def count(self, field: str) -> int:
+        with self._lock:
+            return self._counts[field]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = dict(self._counts)
+            hist = {str(k): v for k, v in sorted(self._batch_hist.items())}
+        hits, misses = counts["cache_hits"], counts["cache_misses"]
+        looked = hits + misses
+        return {
+            **{snake_to_camel(k): v for k, v in counts.items()},
+            "batchSizeHistogram": hist,
+            "cacheHitRatio": round(hits / looked, 4) if looked else None,
+        }
 
 
 @dataclasses.dataclass(frozen=True)

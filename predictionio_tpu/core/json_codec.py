@@ -8,15 +8,34 @@ with milliseconds and zone offset, and reads apply EventValidation.
 The reference maintained two JSON stacks (json4s + Gson) purely for its
 Scala/Java duality (core/.../workflow/JsonExtractor.scala:36-167); this
 framework deliberately has exactly one canonical codec.
+
+Serving fast path (beyond reference): the /queries.json envelope used
+to run the generic reflective binder (core/wire.from_wire / to_wire)
+per request — ``typing.get_type_hints`` + ``dataclasses.fields`` + the
+camelCase regex on EVERY query and prediction. :func:`compile_wire_decoder`
+/ :func:`compile_wire_encoder` hoist all of that to one compile step
+per class (field tables, accepted spellings, nested sub-codecs), so the
+per-request cost is a dict walk; :func:`canonical_json` is the
+normalized query key the result cache and the batcher's dedup pass
+share. Wire behavior is bit-identical to core/wire — the equivalence
+is pinned by tests/test_serving_perf.py.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
+import typing
 from datetime import datetime, timezone
-from typing import Any, Mapping
+from typing import Any, Callable, Mapping
 
 from predictionio_tpu.core.datamap import DataMap
 from predictionio_tpu.core.event import Event, EventValidation, EventValidationError
+from predictionio_tpu.core.wire import (
+    _unwrap_optional,
+    camel_to_snake,
+    snake_to_camel,
+)
 
 
 def format_datetime(t: datetime) -> str:
@@ -110,3 +129,151 @@ def event_from_json(obj: Mapping[str, Any], validate: bool = True) -> Event:
     if validate:
         EventValidation.validate(e)
     return e
+
+
+# ---------------------------------------------------------------------------
+# serving fast path: precompiled wire codecs + canonical query keys
+# ---------------------------------------------------------------------------
+
+
+def canonical_json(obj: Any) -> str:
+    """The canonical spelling of a JSON value: sorted keys, no
+    whitespace. Two requests carrying the same query in different key
+    orders produce the same string — the result cache's key and the
+    batcher's dedup key."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                      ensure_ascii=False, default=str)
+
+
+_DECODERS: dict[Any, Callable[[Any], Any]] = {}
+
+
+def compile_wire_decoder(cls: Any) -> Callable[[Any], Any]:
+    """A JSON→``cls`` binder with the reflection hoisted out: type
+    hints, field tables, and accepted key spellings (camelCase AND
+    snake_case, exactly core/wire.from_wire's contract, including the
+    unknown-key rejection) are resolved once per class; the returned
+    callable does only dict walks per request."""
+    cls = _unwrap_optional(cls)
+    try:
+        cached = _DECODERS.get(cls)
+        hashable = True
+    except TypeError:        # unhashable annotation — compile fresh
+        cached, hashable = None, False
+    if cached is not None:
+        return cached
+    decoder = _build_decoder(cls)
+    if hashable:
+        _DECODERS[cls] = decoder
+    return decoder
+
+
+def _build_decoder(cls: Any) -> Callable[[Any], Any]:
+    if isinstance(cls, type) and dataclasses.is_dataclass(cls):
+        return _build_dataclass_decoder(cls)
+    if cls is tuple:
+        # bare `tuple` annotations still coerce JSON lists (frozen
+        # Query dataclasses rely on tuple fields for hashability)
+        return lambda v: tuple(v) if isinstance(v, list) else v
+    origin = typing.get_origin(cls)
+    if origin in (list, tuple):
+        args = typing.get_args(cls)
+        elem = args[0] if args and args[0] is not Ellipsis else Any
+        if elem is Any:
+            if origin is tuple:
+                return lambda v: tuple(v) if isinstance(v, list) else v
+            return lambda v: v
+        sub = compile_wire_decoder(elem)
+        if origin is tuple:
+            return lambda v: (tuple(sub(x) for x in v)
+                              if isinstance(v, list) else v)
+        return lambda v: [sub(x) for x in v] if isinstance(v, list) else v
+    return lambda v: v
+
+
+def _build_dataclass_decoder(cls: type) -> Callable[[Any], Any]:
+    # register a forward reference FIRST so self-referential dataclass
+    # fields compile instead of recursing forever; `accept` is filled
+    # in below and shared by closure
+    accept: dict[str, tuple[str, Callable[[Any], Any]]] = {}
+    wire_names: list[str] = []
+
+    def decode(obj: Any) -> Any:
+        if not isinstance(obj, dict):
+            raise ValueError(
+                f"expected JSON object for {cls.__name__}, "
+                f"got {type(obj).__name__}")
+        kwargs: dict[str, Any] = {}
+        unknown = []
+        for key, value in obj.items():
+            entry = accept.get(key) or accept.get(camel_to_snake(key))
+            if entry is None:
+                unknown.append(key)
+                continue
+            name, sub = entry
+            kwargs[name] = sub(value)
+        if unknown:
+            raise ValueError(
+                f"Unknown field(s) {sorted(unknown)} for {cls.__name__} "
+                f"(accepted: {sorted(wire_names)})")
+        return cls(**kwargs)
+
+    _DECODERS[cls] = decode
+    try:
+        hints = typing.get_type_hints(cls)
+        for f in dataclasses.fields(cls):
+            sub = compile_wire_decoder(hints.get(f.name, Any))
+            accept[f.name] = (f.name, sub)
+            # exact field-name spellings take precedence over a
+            # camelCase collision, matching from_wire's resolution order
+            accept.setdefault(snake_to_camel(f.name), (f.name, sub))
+            wire_names.append(snake_to_camel(f.name))
+    except BaseException:
+        # a failed compile (e.g. unresolvable forward-ref annotation)
+        # must not leave the half-built decoder cached — a later retry
+        # would silently serve its empty accept table
+        _DECODERS.pop(cls, None)
+        raise
+    return decode
+
+
+#: per-dataclass (attr, wireName) field tables for the fast encoder
+_ENCODER_FIELDS: dict[type, tuple[tuple[str, str], ...]] = {}
+
+_SCALARS = (str, int, float, bool, type(None))
+
+
+def encode_wire(obj: Any) -> Any:
+    """Fast ``core/wire.to_wire``: identical output, with per-class
+    field tables compiled once instead of ``dataclasses.fields`` + the
+    camelCase conversion per call."""
+    if isinstance(obj, _SCALARS):
+        return obj
+    t = type(obj)
+    pairs = _ENCODER_FIELDS.get(t)
+    if pairs is None and dataclasses.is_dataclass(obj) \
+            and not isinstance(obj, type):
+        pairs = tuple((f.name, snake_to_camel(f.name))
+                      for f in dataclasses.fields(t))
+        _ENCODER_FIELDS[t] = pairs
+    if pairs is not None:
+        return {wire: encode_wire(getattr(obj, name)) for name, wire in pairs}
+    if isinstance(obj, (list, tuple)):
+        return [encode_wire(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): encode_wire(v) for k, v in obj.items()}
+    if hasattr(obj, "item") and callable(getattr(obj, "item", None)) \
+            and hasattr(obj, "dtype"):
+        return obj.item()  # numpy/jax scalar, one host fetch at the wire
+    return obj
+
+
+def compile_wire_encoder(cls: type) -> Callable[[Any], Any]:
+    """Prime the encoder table for ``cls`` and return the fast encoder
+    (callers that know their prediction class ahead of the first
+    request avoid even the one lazy-compile dict miss)."""
+    if isinstance(cls, type) and dataclasses.is_dataclass(cls):
+        _ENCODER_FIELDS.setdefault(
+            cls, tuple((f.name, snake_to_camel(f.name))
+                       for f in dataclasses.fields(cls)))
+    return encode_wire

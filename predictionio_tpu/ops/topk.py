@@ -133,6 +133,28 @@ def recommend_topk_chunked(
 #: fixed set keeps the number of compiled kernel shapes bounded
 _SEEN_WIDTHS = (8, 32, 128, 512)
 
+#: static BATCH widths (power-of-two menu, serving scale): every
+#: distinct batch dim is a fresh jit signature, and the serving
+#: micro-batcher produces arbitrary coalesce counts — both the
+#: templates' batch_predict padding and the adaptive batch policy
+#: (serving/batch_policy.py) snap to this one menu so adaptivity can
+#: never mint a batch shape the compiled-program cache hasn't seen
+BATCH_WIDTHS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+def serving_batch(b: int) -> int:
+    """Round a serving batch size up to the ``BATCH_WIDTHS`` menu.
+
+    Batches beyond the menu (eval-scale: engine.eval routes whole folds
+    through batch_predict) pass through unchanged — they compile once
+    anyway, and padding them would inflate the score matmul for
+    nothing."""
+    if b <= 0:
+        return BATCH_WIDTHS[0]
+    if b > BATCH_WIDTHS[-1] or (b & (b - 1)) == 0:
+        return b
+    return 1 << b.bit_length()
+
 #: static top_k widths shared by every serving path — k is a jit
 #: signature arg fed by client-controlled ``query.num``
 _K_WIDTHS = (10, 32, 100, 320, 1000)

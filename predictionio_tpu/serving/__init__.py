@@ -1,0 +1,21 @@
+"""The serving hot-path subsystem: micro-batching policy, query
+batcher, and the device-result cache.
+
+Grown out of the single ``QueryBatcher`` class that used to live in
+``workflow/deploy.py`` (PR 1's fixed 5 ms window): the batcher now
+composes a load-aware :mod:`batch_policy`, a per-batch dedup pass, and
+an optional :mod:`result_cache`, with its counters surfaced through
+``api/stats.py`` on the engine server's ``GET /stats.json``.
+"""
+
+from predictionio_tpu.serving.batch_policy import (  # noqa: F401
+    AdaptiveBatchPolicy,
+    BatchPolicy,
+    FixedBatchPolicy,
+    make_batch_policy,
+)
+from predictionio_tpu.serving.batcher import (  # noqa: F401
+    QueryBatcher,
+    QueryDeadlineExceeded,
+)
+from predictionio_tpu.serving.result_cache import ResultCache  # noqa: F401

@@ -1,0 +1,117 @@
+"""Device-result cache for the serving query path.
+
+An LRU + TTL map from the **canonical query JSON** (core/json_codec.
+canonical_json over the BOUND query's wire form — key order,
+whitespace, and camelCase/snake_case spellings all normalized, so two
+clients spelling the same query differently share an entry) to the
+served prediction. A hit answers without touching the device at all; misses
+flow through the batcher, whose per-batch dedup pass covers the
+concurrent-identical case the cache can't (both in flight at once).
+
+Invalidation is generational: ``invalidate()`` (called by a successful
+``/reload`` after the model swap) clears the map AND bumps a generation
+counter; ``put()`` carries the generation its caller observed before
+computing, so a prediction computed against the old model can never be
+cached into the new model's generation — the check and insert are one
+atomic step under the cache lock. A FAILED reload calls nothing: the
+last-known-good model keeps its warm cache (operations-resilience
+semantics).
+
+Counters live in :class:`~predictionio_tpu.api.stats.ServingStats`
+(hit/miss/eviction/expiration/invalidation) for ``GET /stats.json``.
+The clock is injectable for TTL tests on virtual time.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any
+
+from predictionio_tpu.api.stats import ServingStats
+from predictionio_tpu.utils.resilience import SYSTEM_CLOCK, Clock
+
+#: sentinel distinguishing "miss" from a cached None prediction
+_MISS = object()
+
+
+class ResultCache:
+    """Thread-safe LRU+TTL keyed by canonical query JSON."""
+
+    def __init__(self, max_entries: int = 4096, ttl_s: float = 30.0,
+                 stats: ServingStats | None = None,
+                 clock: Clock = SYSTEM_CLOCK):
+        self.max_entries = max(1, int(max_entries))
+        self.ttl_s = ttl_s
+        self.stats = stats or ServingStats()
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: key -> (inserted_at, value); insertion/access order = LRU
+        self._entries: "OrderedDict[str, tuple[float, Any]]" = OrderedDict()
+        self._generation = 0
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def get(self, key: str) -> Any:
+        """The cached value, or the module sentinel ``_MISS``. Use
+        :meth:`lookup` for a (hit, value, generation) triple."""
+        return self.lookup(key)[1]
+
+    def lookup(self, key: str) -> tuple[bool, Any, int]:
+        """(hit, value_or_MISS, generation_observed) — callers thread the
+        generation into :meth:`put` so a result computed before a reload
+        cannot poison the post-reload cache."""
+        now = self._clock.monotonic()
+        with self._lock:
+            gen = self._generation
+            entry = self._entries.get(key)
+            if entry is None:
+                self.stats.bump("cache_misses")
+                return False, _MISS, gen
+            inserted, value = entry
+            if self.ttl_s > 0 and now - inserted >= self.ttl_s:
+                del self._entries[key]
+                self.stats.bump("cache_expirations")
+                self.stats.bump("cache_misses")
+                return False, _MISS, gen
+            self._entries.move_to_end(key)
+            self.stats.bump("cache_hits")
+            return True, value, gen
+
+    def put(self, key: str, value: Any, generation: int | None = None) -> bool:
+        """Insert; returns False (and caches nothing) when ``generation``
+        is stale — the computation started before an invalidation."""
+        now = self._clock.monotonic()
+        with self._lock:
+            if generation is not None and generation != self._generation:
+                return False
+            self._entries[key] = (now, value)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.stats.bump("cache_evictions")
+            return True
+
+    def invalidate(self) -> None:
+        """Atomically drop everything and start a new generation."""
+        with self._lock:
+            self._entries.clear()
+            self._generation += 1
+            self.stats.bump("cache_invalidations")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            size, gen = len(self._entries), self._generation
+        return {
+            "size": size,
+            "maxEntries": self.max_entries,
+            "ttlS": self.ttl_s,
+            "generation": gen,
+        }

@@ -332,19 +332,15 @@ class ALSAlgorithm(ShardedAlgorithm):
             while pad < widest:
                 pad *= 2
         B = len(known)
-        # pad the BATCH dimension to a power-of-two menu: every
-        # distinct B is a fresh jit signature, and on a
-        # remote-compile backend each costs tens of seconds — the
-        # serving micro-batcher produces arbitrary batch sizes, so
-        # without this a varying-concurrency workload compiles
-        # forever instead of dispatching (padding rows repeat row 0
-        # and are sliced off the result). Only serving-scale batches
-        # pad: a large one-shot EVAL batch (engine.eval routes whole
-        # folds here) compiles once anyway, and padding it would
-        # inflate the (B, n_items) score matmul by up to 2x for
-        # nothing
-        padB = (B if B > 256 or (B & (B - 1)) == 0
-                else 1 << B.bit_length())
+        # pad the BATCH dimension to the shared power-of-two menu
+        # (ops/topk.BATCH_WIDTHS): every distinct B is a fresh jit
+        # signature, and on a remote-compile backend each costs tens
+        # of seconds — the serving micro-batcher produces arbitrary
+        # batch sizes, so without this a varying-concurrency workload
+        # compiles forever instead of dispatching (padding rows repeat
+        # row 0 and are sliced off the result). Eval-scale batches
+        # pass through unpadded (serving_batch docstring)
+        padB = topk_ops.serving_batch(B)
         if padB != B:
             uixs = np.concatenate(
                 [uixs, np.full(padB - B, uixs[0], dtype=np.int32)])

@@ -14,6 +14,7 @@ JAX runtime — there is no assembly jar or process boundary to cross, so
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 
@@ -194,12 +195,27 @@ def _configure_deploy(sub) -> None:
     p.add_argument("--accesskey", default="", help="access key for feedback events")
     p.add_argument("--server-key", default=None,
                    help="when set, /stop and /reload require this key")
-    p.add_argument("--batching", action="store_true",
+    # serving knobs default to None so an absent flag falls through to
+    # ServerConfig's PIO_SERVING_* env-aware defaults instead of
+    # re-hard-coding them here; the boolean pairs (--batching /
+    # --no-batching) exist so the CLI can force either state over a
+    # fleet-wide env setting (docs/serving-performance.md)
+    p.add_argument("--batching", action=argparse.BooleanOptionalAction,
+                   default=None,
                    help="coalesce concurrent queries into one device "
-                        "dispatch (micro-batching; adds up to "
-                        "--batch-wait-ms latency to a lone query)")
-    p.add_argument("--batch-max", type=int, default=64)
-    p.add_argument("--batch-wait-ms", type=float, default=5.0)
+                        "dispatch (micro-batching; the adaptive policy "
+                        "waits near-zero when idle)")
+    p.add_argument("--batch-policy", choices=("adaptive", "fixed"),
+                   default=None)
+    p.add_argument("--batch-max", type=int, default=None)
+    p.add_argument("--batch-wait-ms", type=float, default=None,
+                   help="adaptive: wait cap; fixed: the constant window")
+    p.add_argument("--cache", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="LRU+TTL result cache over canonical query "
+                        "JSON, invalidated on /reload")
+    p.add_argument("--cache-max-entries", type=int, default=None)
+    p.add_argument("--cache-ttl-s", type=float, default=None)
 
 
 def _cmd_deploy(args, storage) -> int:
@@ -223,9 +239,15 @@ def _cmd_deploy(args, storage) -> int:
         event_server_port=args.event_server_port,
         access_key=args.accesskey,
         server_key=args.server_key,
-        batching=args.batching,
-        batch_max=args.batch_max,
-        batch_wait_ms=args.batch_wait_ms,
+        **{k: v for k, v in {
+            "batching": args.batching,
+            "batch_policy": args.batch_policy,
+            "batch_max": args.batch_max,
+            "batch_wait_ms": args.batch_wait_ms,
+            "cache_enabled": args.cache,
+            "cache_max_entries": args.cache_max_entries,
+            "cache_ttl_s": args.cache_ttl_s,
+        }.items() if v is not None},
     )
     server = create_engine_server(storage=storage, config=config)
     return _serve(

@@ -12,31 +12,66 @@ query path (supplement → per-algo predict → serve, CreateServer.scala:
 TPU-first: models stay resident (host or HBM) between requests, and the
 query path re-uses each algorithm's jitted predict functions — there is
 no per-query compilation or device handoff beyond the query tensors.
+The micro-batching machinery lives in :mod:`predictionio_tpu.serving`
+(batcher + adaptive policy + result cache); ``QueryBatcher`` and
+``QueryDeadlineExceeded`` are re-exported here for compatibility.
 """
 
 from __future__ import annotations
 
-import contextlib
 import dataclasses
 import logging
+import os
 import threading
 import time
-from concurrent.futures import Future
-from concurrent.futures import TimeoutError as FuturesTimeoutError
-from typing import Any, Sequence
+from typing import Any, Callable, Sequence
 
 from predictionio_tpu.controller.engine import Engine, resolve_engine_factory
+from predictionio_tpu.serving.batcher import (  # noqa: F401  (re-export)
+    QueryBatcher,
+    QueryDeadlineExceeded,
+)
 from predictionio_tpu.storage.base import EngineInstance
 from predictionio_tpu.storage.registry import Storage
-from predictionio_tpu.utils.resilience import (
-    deadline_scope,
-    record_fallback,
-    remaining_deadline,
-)
 from predictionio_tpu.workflow.context import EngineContext, WorkflowParams
 from predictionio_tpu.workflow.persistence import load_models
 
 logger = logging.getLogger(__name__)
+
+
+def _env_field(key: str, default: Any, cast: Callable[[str], Any]):
+    """A frozen-dataclass default overridable via ``PIO_SERVING_<KEY>``
+    — the serving-plane analogue of the ``PIO_RESILIENCE_*`` fallbacks
+    (utils/resilience._prop), so a deployment tunes the batcher/cache
+    without a code change. A malformed value falls back to the coded
+    default rather than killing the server at config time."""
+
+    def factory() -> Any:
+        raw = os.environ.get(f"PIO_SERVING_{key}")
+        if raw is None:
+            return default
+        try:
+            return cast(raw)
+        except (TypeError, ValueError):
+            logger.warning("ignoring malformed PIO_SERVING_%s=%r "
+                           "(using %r)", key, raw, default)
+            return default
+
+    return dataclasses.field(default_factory=factory)
+
+
+def _cast_bool(raw: str) -> bool:
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _cast_policy(raw: str) -> str:
+    # validated HERE so a typo'd env value degrades to the default with
+    # a warning (the _env_field contract) instead of killing the server
+    # when make_batch_policy() rejects it at EngineService construction
+    value = raw.strip().lower()
+    if value not in ("adaptive", "fixed"):
+        raise ValueError(value)
+    return value
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,11 +103,24 @@ class ServerConfig:
     #: batch_predict hook. On a remote-attached device a dispatch costs
     #: a full RTT (~100ms on the axon tunnel), so N concurrent clients
     #: served individually serialize at ~1/RTT qps while the same model
-    #: scores thousands of queries per dispatch batched. Opt-in: adds
-    #: up to batch_wait_ms latency to a lone query.
-    batching: bool = False
-    batch_max: int = 64
-    batch_wait_ms: float = 5.0
+    #: scores thousands of queries per dispatch batched. Opt-in; with
+    #: the adaptive policy a lone query pays (near) zero added latency.
+    batching: bool = _env_field("BATCHING", False, _cast_bool)
+    #: "adaptive" (EWMA-driven wait, serving/batch_policy.py) or
+    #: "fixed" (the legacy constant window)
+    batch_policy: str = _env_field("BATCH_POLICY", "adaptive", _cast_policy)
+    batch_max: int = _env_field("BATCH_MAX", 64, int)
+    #: for "adaptive": the CAP on the coalescing wait; for "fixed": the
+    #: constant window
+    batch_wait_ms: float = _env_field("BATCH_WAIT_MS", 5.0, float)
+    #: result cache (serving/result_cache.py): LRU+TTL over canonical
+    #: query JSON, invalidated on /reload. Off by default — only enable
+    #: for engines whose predictions depend on nothing but the query
+    #: and the deployed model (a custom Serving reading live state per
+    #: request would serve stale results from a cache)
+    cache_enabled: bool = _env_field("CACHE_ENABLED", False, _cast_bool)
+    cache_max_entries: int = _env_field("CACHE_MAX_ENTRIES", 4096, int)
+    cache_ttl_s: float = _env_field("CACHE_TTL_S", 30.0, float)
     #: graceful degradation (beyond reference): per-request time budget
     #: for /queries.json. Propagated as the ambient resilience deadline
     #: (utils/resilience.deadline_scope — storage retries stop sleeping
@@ -80,18 +128,7 @@ class ServerConfig:
     #: Clients may lower it per request with an X-PIO-Deadline-Ms
     #: header; exhaustion maps to 503 + Retry-After, not a hung socket.
     #: 0 disables (legacy behavior: 300s batcher wait, no deadline).
-    request_deadline_ms: float = 0.0
-
-
-class QueryDeadlineExceeded(RuntimeError):
-    """A query's time budget expired while WAITING for its result — as
-    distinct from the work itself raising TimeoutError (which, on
-    Python 3.11+, is the same class as concurrent.futures.TimeoutError
-    and must not be misreported as a blown deadline)."""
-
-    def __init__(self, budget: float):
-        super().__init__(f"query deadline exceeded ({budget:.3f}s budget)")
-        self.budget = budget
+    request_deadline_ms: float = _env_field("REQUEST_DEADLINE_MS", 0.0, float)
 
 
 class DeployedEngine:
@@ -137,7 +174,7 @@ class DeployedEngine:
             for algo, model in zip(self.algorithms, self.models)
         ]
         served = self.serving.serve(query, predictions)
-        self._record(time.perf_counter() - t0)
+        self.record_served(time.perf_counter() - t0)
         return served
 
     def query_batch(self, queries: Sequence[Any]) -> list[Any]:
@@ -160,10 +197,16 @@ class DeployedEngine:
         ]
         dt = time.perf_counter() - t0
         for _ in queries:           # bookkeeping counts every query
-            self._record(dt)
+            self.record_served(dt)
         return served
 
-    def _record(self, dt: float) -> None:
+    def record_served(self, dt: float) -> None:
+        """Count one answered query in the request bookkeeping. The
+        predict paths call it internally; the serving layer calls it
+        for queries answered WITHOUT their own dispatch (cache hits,
+        deduped batch waiters) so a hot cache never reads as an idle
+        server. Public API — stand-ins for DeployedEngine must carry
+        it."""
         with self._stats_lock:
             self.request_count += 1
             self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
@@ -202,11 +245,14 @@ def resolve_engine_instance(
 
 def load_deployed_engine(
     storage: Storage | None = None,
-    config: ServerConfig = ServerConfig(),
+    config: ServerConfig | None = None,
     ctx: EngineContext | None = None,
     engine: Engine | None = None,
 ) -> DeployedEngine:
     """createServerActorWithEngine (CreateServer.scala:186-244)."""
+    # built at CALL time: a module-level default instance would freeze
+    # the PIO_SERVING_* env reads at import
+    config = config if config is not None else ServerConfig()
     storage = storage or Storage.default()
     ctx = ctx or EngineContext(workflow_params=WorkflowParams(), storage=storage)
     instance = resolve_engine_instance(storage, config)
@@ -230,156 +276,3 @@ def load_deployed_engine(
         instance.id, instance.engine_factory, len(algorithms),
     )
     return DeployedEngine(engine, instance, algorithms, serving, models)
-
-
-class QueryBatcher:
-    """Coalesces concurrent queries into one device dispatch — the
-    TPU-first serving feature a per-query dispatch model can't offer
-    (beyond reference; the reference's spray actor served queries
-    strictly one predict per request, CreateServer.scala:495-497).
-
-    Handler threads ``submit()`` and block on a future; one dispatcher
-    thread drains the queue — after the first query arrives it waits at
-    most ``batch_wait_ms`` (or until ``batch_max``) for companions,
-    then runs the whole batch through ``DeployedEngine.query_batch``.
-    A failing batch is retried query-by-query so one poisoned query
-    500s alone instead of taking its batch down. ``get_deployed`` is
-    read fresh per batch, so /reload hot-swaps apply from the next
-    batch on."""
-
-    def __init__(self, get_deployed, batch_max: int = 64,
-                 batch_wait_ms: float = 5.0):
-        import queue as _queue
-
-        self._get_deployed = get_deployed
-        # clamped to 256: the ALS batch_predict pads batch dims to a
-        # power-of-two menu only up to 256 (above, every distinct size
-        # would be a fresh jit signature — the retrace stall the menu
-        # exists to prevent); 256 queries per dispatch is plenty
-        self._batch_max = max(1, min(int(batch_max), 256))
-        self._wait_s = max(0.0, batch_wait_ms) / 1e3
-        self._queue: "_queue.Queue" = _queue.Queue()
-        self._stopped = False
-        self.batches = 0
-        self.batched_queries = 0
-        self._thread = threading.Thread(
-            target=self._run, name="pio-query-batcher", daemon=True)
-        self._thread.start()
-
-    def submit(self, query: Any, timeout: float = 300.0) -> Any:
-        """Enqueue and wait; raises whatever the predict path raised.
-
-        The caller's ambient resilience deadline (deadline_scope) rides
-        along into the dispatcher thread — contextvars do not cross
-        threads, so the remaining budget is captured here and re-entered
-        around the batch dispatch and any per-query fallbacks."""
-        if self._stopped:
-            raise RuntimeError("query batcher is stopped")
-        rem = remaining_deadline()
-        deadline = time.monotonic() + rem if rem is not None else None
-        fut: Future = Future()
-        self._queue.put((query, fut, deadline))
-        if self._stopped and not fut.done():
-            # close() raced the enqueue: the dispatcher (or close's
-            # drain) may never see this entry — fail fast instead of
-            # letting the handler hang out the timeout (done() guards
-            # the benign double-completion race)
-            try:
-                fut.set_exception(RuntimeError("query batcher is stopped"))
-            except Exception:
-                pass
-        try:
-            return fut.result(timeout=timeout)
-        except FuturesTimeoutError:
-            if not fut.done():
-                # the WAIT expired (a blown budget) — not an exception
-                # from the predict path, which fut.done() distinguishes
-                # even on 3.11 where the two classes are aliased
-                raise QueryDeadlineExceeded(timeout) from None
-            raise
-
-    def close(self) -> None:
-        self._stopped = True
-        self._queue.put(None)
-        self._thread.join(timeout=5)
-        self._fail_pending()
-
-    def _fail_pending(self) -> None:
-        """Fail anything still queued after the dispatcher exited —
-        a blocked submit must get its 500 now, not at timeout."""
-        import queue as _queue
-
-        while True:
-            try:
-                item = self._queue.get_nowait()
-            except _queue.Empty:
-                return
-            if item is None:
-                continue
-            _, fut, _ = item
-            if not fut.done():
-                try:
-                    fut.set_exception(
-                        RuntimeError("query batcher is stopped"))
-                except Exception:
-                    pass
-
-    # -- dispatcher ---------------------------------------------------------
-    def _run(self) -> None:
-        import queue as _queue
-
-        while True:
-            item = self._queue.get()
-            if item is None:
-                return
-            batch = [item]
-            deadline = time.perf_counter() + self._wait_s
-            while len(batch) < self._batch_max:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = self._queue.get(timeout=remaining)
-                except _queue.Empty:
-                    break
-                if nxt is None:
-                    self._finish(batch)
-                    return
-                batch.append(nxt)
-            self._finish(batch)
-
-    @staticmethod
-    def _scope(deadline_abs: float | None):
-        """Re-enter a caller's deadline (absolute monotonic) on the
-        dispatcher thread; nested scopes only ever shrink."""
-        if deadline_abs is None:
-            return contextlib.nullcontext()
-        return deadline_scope(max(0.0, deadline_abs - time.monotonic()))
-
-    def _finish(self, batch) -> None:
-        deployed = self._get_deployed()
-        deadlines = [d for _, _, d in batch if d is not None]
-        try:
-            # the batch shares one dispatch: honor its tightest deadline
-            with self._scope(min(deadlines) if deadlines else None):
-                results = deployed.query_batch([q for q, _, _ in batch])
-            for (_, fut, _), served in zip(batch, results):
-                fut.set_result(served)
-            self.batches += 1  # pio: lint-ignore[lock-discipline]: dispatcher is the ONLY writer; stats reads may run one batch stale
-            self.batched_queries += len(batch)  # pio: lint-ignore[lock-discipline]: single-writer stats counter, same as above
-        except Exception:
-            logger.exception(
-                "batched predict failed; retrying %d queries individually",
-                len(batch))
-            record_fallback("serving/query-batcher")
-            for q, fut, deadline in batch:
-                if fut.done():
-                    continue
-                try:
-                    # re-resolve per query: a /reload mid-batch must not
-                    # pin the whole fallback pass to the dead instance
-                    # the batch dispatch captured
-                    with self._scope(deadline):
-                        fut.set_result(self._get_deployed().query(q))
-                except Exception as e:          # noqa: BLE001
-                    fut.set_exception(e)

@@ -21,6 +21,10 @@ def tiny_bench(monkeypatch):
     monkeypatch.setattr(bench, "N_LONG", 3)
     monkeypatch.setattr(bench, "bench_serving",
                         lambda *a, **kw: {"p50_ms": 1.0, "p99_ms": 2.0})
+    # serving_path drives a real HTTP server fleet at 100k-item scale
+    # (bench_serving.py) — stubbed like the other device-heavy sections
+    monkeypatch.setattr(bench, "bench_serving_path",
+                        lambda: {"serving_speedup_x": 2.0})
     monkeypatch.setattr(bench, "bench_quality",
                         lambda: {"map10_tpu": 0.1, "map10_ref": 0.1})
     monkeypatch.setattr(bench, "bench_seqrec",
@@ -86,5 +90,6 @@ def test_skip_heavy_lists_skipped_sections(tiny_bench, capsys, monkeypatch):
     tiny_bench.main()
     line = json.loads(capsys.readouterr().out.strip())
     assert set(line["sections_failed"]) == {
-        "phases", "rank200", "serving", "attention", "seqrec"}
+        "phases", "rank200", "serving", "serving_path", "attention",
+        "seqrec"}
     assert "ingest_events_per_sec" in line and "map10_tpu" in line

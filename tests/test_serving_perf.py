@@ -1,0 +1,475 @@
+"""Serving hot-path subsystem (PR 3): adaptive batch policy, result
+cache, precompiled wire codecs, and the batcher's deadline/dedup
+contracts — unit-level, on virtual clocks where timing matters."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.api.stats import ServingStats
+from predictionio_tpu.core.json_codec import (
+    canonical_json,
+    compile_wire_decoder,
+    encode_wire,
+)
+from predictionio_tpu.core.wire import from_wire, to_wire
+from predictionio_tpu.ops.topk import BATCH_WIDTHS, serving_batch
+from predictionio_tpu.serving.batch_policy import (
+    AdaptiveBatchPolicy,
+    FixedBatchPolicy,
+    make_batch_policy,
+)
+from predictionio_tpu.serving.batcher import QueryBatcher, QueryDeadlineExceeded
+from predictionio_tpu.serving.result_cache import ResultCache
+from predictionio_tpu.utils.resilience import ManualClock, deadline_scope
+
+pytestmark = pytest.mark.perf
+
+
+# ---------------------------------------------------------------------------
+# batch menu
+# ---------------------------------------------------------------------------
+
+
+class TestServingBatch:
+    def test_snaps_up_to_menu(self):
+        assert serving_batch(3) == 4
+        assert serving_batch(11) == 16
+        assert serving_batch(129) == 256
+
+    def test_menu_sizes_pass_through(self):
+        for w in BATCH_WIDTHS:
+            assert serving_batch(w) == w
+
+    def test_eval_scale_passes_through(self):
+        assert serving_batch(257) == 257
+        assert serving_batch(10_000) == 10_000
+
+    def test_degenerate(self):
+        assert serving_batch(0) == 1
+        assert serving_batch(-5) == 1
+
+
+# ---------------------------------------------------------------------------
+# adaptive policy (injectable clock, CircuitBreaker's test pattern)
+# ---------------------------------------------------------------------------
+
+
+class TestAdaptiveBatchPolicy:
+    def test_cold_start_waits_nothing(self):
+        p = AdaptiveBatchPolicy(batch_max=64, max_wait_ms=10.0,
+                                clock=ManualClock())
+        wait, target = p.plan()
+        assert wait == 0.0
+        assert target == 64
+
+    def test_loaded_waits_to_fill_a_menu_batch(self):
+        clock = ManualClock()
+        p = AdaptiveBatchPolicy(batch_max=64, max_wait_ms=10.0,
+                                clock=clock, ewma_alpha=1.0)
+        p.observe_arrival()
+        clock.advance(0.001)            # 1ms inter-arrival
+        p.observe_arrival()
+        wait, target = p.plan()
+        # ~10ms window / 1ms spacing -> 11 expected, snapped UP the menu
+        assert target == 16
+        assert 0.0 < wait <= 0.010
+        assert target in BATCH_WIDTHS
+
+    def test_idle_dispatches_immediately(self):
+        clock = ManualClock()
+        p = AdaptiveBatchPolicy(batch_max=64, max_wait_ms=10.0,
+                                clock=clock, ewma_alpha=1.0)
+        p.observe_arrival()
+        clock.advance(60.0)             # a minute of silence
+        p.observe_arrival()
+        wait, target = p.plan()
+        assert wait == 0.0
+        assert target == 1
+
+    def test_single_inflight_never_waits(self):
+        """One blocked client = no possible companion: even a hot EWMA
+        must not charge it the coalescing window."""
+        clock = ManualClock()
+        p = AdaptiveBatchPolicy(batch_max=64, max_wait_ms=10.0,
+                                clock=clock, ewma_alpha=1.0)
+        p.observe_arrival()
+        clock.advance(0.001)
+        p.observe_arrival()             # EWMA looks "loaded" (1ms)
+        assert p.plan(inflight=1) == (0.0, 1)
+        wait, target = p.plan(inflight=8)
+        assert target > 1 and wait > 0
+
+    def test_targets_always_on_menu(self):
+        clock = ManualClock()
+        p = AdaptiveBatchPolicy(batch_max=256, max_wait_ms=7.0,
+                                clock=clock, ewma_alpha=0.3)
+        rng = np.random.default_rng(0)
+        for dt in rng.uniform(1e-5, 5e-2, size=200):
+            clock.advance(float(dt))
+            p.observe_arrival()
+            _, target = p.plan()
+            assert target in BATCH_WIDTHS, target
+
+    def test_ewma_converges(self):
+        clock = ManualClock()
+        p = AdaptiveBatchPolicy(clock=clock, ewma_alpha=0.5)
+        for _ in range(20):
+            clock.advance(0.002)
+            p.observe_arrival()
+        assert abs(p.ewma_interarrival_s() - 0.002) < 1e-4
+
+    def test_snapshot_fields(self):
+        p = AdaptiveBatchPolicy(batch_max=32, clock=ManualClock())
+        p.plan()
+        snap = p.snapshot()
+        assert snap["policy"] == "AdaptiveBatchPolicy"
+        assert snap["batchMax"] == 32
+        assert "ewmaInterarrivalMs" in snap and "lastWaitMs" in snap
+
+    def test_factory(self):
+        assert isinstance(make_batch_policy("adaptive", 8, 5.0),
+                          AdaptiveBatchPolicy)
+        assert isinstance(make_batch_policy("fixed", 8, 5.0),
+                          FixedBatchPolicy)
+        with pytest.raises(ValueError, match="batch_policy"):
+            make_batch_policy("nope", 8, 5.0)
+
+    def test_fixed_policy_is_constant(self):
+        p = FixedBatchPolicy(batch_max=16, wait_ms=25.0, clock=ManualClock())
+        assert p.plan() == (0.025, 16)
+        p.observe_arrival()
+        assert p.plan() == (0.025, 16)
+
+
+# ---------------------------------------------------------------------------
+# result cache
+# ---------------------------------------------------------------------------
+
+
+class TestResultCache:
+    def test_hit_miss_counters(self):
+        stats = ServingStats()
+        c = ResultCache(max_entries=4, ttl_s=0, stats=stats)
+        assert c.lookup("a")[0] is False
+        c.put("a", 1)
+        hit, value, _ = c.lookup("a")
+        assert hit and value == 1
+        snap = stats.snapshot()
+        assert snap["cacheHits"] == 1 and snap["cacheMisses"] == 1
+        assert snap["cacheHitRatio"] == 0.5
+
+    def test_lru_eviction(self):
+        stats = ServingStats()
+        c = ResultCache(max_entries=2, ttl_s=0, stats=stats)
+        c.put("a", 1)
+        c.put("b", 2)
+        assert c.lookup("a")[0]        # refresh a -> b is now LRU
+        c.put("c", 3)
+        assert c.lookup("b")[0] is False
+        assert c.lookup("a")[0] and c.lookup("c")[0]
+        assert stats.count("cache_evictions") == 1
+
+    def test_ttl_expiry_on_virtual_time(self):
+        clock = ManualClock()
+        stats = ServingStats()
+        c = ResultCache(max_entries=8, ttl_s=10.0, stats=stats, clock=clock)
+        c.put("a", 1)
+        clock.advance(9.0)
+        assert c.lookup("a")[0]
+        clock.advance(2.0)
+        hit, _, _ = c.lookup("a")
+        assert hit is False
+        assert stats.count("cache_expirations") == 1
+
+    def test_invalidate_clears_and_rejects_stale_puts(self):
+        c = ResultCache(max_entries=8, ttl_s=0)
+        _, _, gen = c.lookup("a")
+        c.invalidate()                  # a /reload lands mid-flight
+        assert c.put("a", 1, generation=gen) is False
+        assert len(c) == 0
+        assert c.put("a", 2, generation=c.generation) is True
+        assert c.lookup("a")[1] == 2
+
+    def test_cached_none_is_a_hit(self):
+        c = ResultCache()
+        c.put("k", None)
+        hit, value, _ = c.lookup("k")
+        assert hit is True and value is None
+
+
+# ---------------------------------------------------------------------------
+# precompiled wire codecs — must match core/wire bit for bit
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Inner:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class _Query:
+    user: str
+    num: int = 10
+    white_list: tuple | None = None
+    items: tuple[_Inner, ...] = ()
+
+
+class TestCompiledCodecs:
+    PAYLOADS = [
+        {"user": "u1"},
+        {"user": "u1", "num": 3},
+        {"user": "u1", "whiteList": ["a", "b"]},
+        {"user": "u1", "white_list": ["a"]},
+        {"user": "u1", "items": [{"item": "i", "score": 1.5}]},
+    ]
+
+    def test_decoder_matches_from_wire(self):
+        decode = compile_wire_decoder(_Query)
+        for body in self.PAYLOADS:
+            assert decode(body) == from_wire(_Query, body)
+
+    def test_decoder_rejects_unknown_keys_like_from_wire(self):
+        decode = compile_wire_decoder(_Query)
+        with pytest.raises(ValueError, match="Unknown field"):
+            decode({"user": "u", "bogus": 1})
+        with pytest.raises(ValueError):
+            from_wire(_Query, {"user": "u", "bogus": 1})
+
+    def test_decoder_non_object_rejected(self):
+        decode = compile_wire_decoder(_Query)
+        with pytest.raises(ValueError, match="expected JSON object"):
+            decode([1, 2])
+
+    def test_failed_compile_not_cached(self):
+        """An unresolvable annotation must raise on EVERY compile —
+        never silently hand back a half-built decoder whose empty
+        accept table rejects every field."""
+
+        @dataclasses.dataclass(frozen=True)
+        class Broken:
+            field: "NoSuchTypeAnywhere"  # noqa: F821
+
+        for _ in range(2):
+            with pytest.raises(NameError):
+                compile_wire_decoder(Broken)
+
+    def test_encoder_matches_to_wire(self):
+        values = [
+            _Query(user="u", items=(_Inner("i", 1.5),)),
+            _Inner("x", 2.0),
+            {"k": (_Inner("y", 0.25),)},
+            [1, "a", None],
+            np.float32(1.25),
+        ]
+        for v in values:
+            assert encode_wire(v) == to_wire(v)
+
+    def test_roundtrip(self):
+        q = _Query(user="u", num=5, items=(_Inner("i", 1.0),))
+        assert compile_wire_decoder(_Query)(encode_wire(q)) == q
+
+    def test_canonical_json_normalizes_order(self):
+        assert canonical_json({"b": 1, "a": [1, 2]}) \
+            == canonical_json({"a": [1, 2], "b": 1})
+        assert canonical_json({"a": 1}) != canonical_json({"a": 2})
+
+    def test_spellings_share_canonical_key(self):
+        """camelCase and snake_case spellings of the same query bind to
+        the same object, whose wire form is the cache/dedup key."""
+        decode = compile_wire_decoder(_Query)
+        k1 = canonical_json(encode_wire(
+            decode({"user": "u", "whiteList": ["a"]})))
+        k2 = canonical_json(encode_wire(
+            decode({"user": "u", "white_list": ["a"]})))
+        assert k1 == k2
+
+
+# ---------------------------------------------------------------------------
+# ServerConfig env knobs (PIO_SERVING_*, mirroring PIO_RESILIENCE_*)
+# ---------------------------------------------------------------------------
+
+
+class TestServerConfigEnv:
+    def test_env_overrides_apply(self, monkeypatch):
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        monkeypatch.setenv("PIO_SERVING_BATCHING", "true")
+        monkeypatch.setenv("PIO_SERVING_BATCH_POLICY", "fixed")
+        monkeypatch.setenv("PIO_SERVING_BATCH_MAX", "8")
+        monkeypatch.setenv("PIO_SERVING_BATCH_WAIT_MS", "2.5")
+        monkeypatch.setenv("PIO_SERVING_CACHE_ENABLED", "1")
+        monkeypatch.setenv("PIO_SERVING_CACHE_TTL_S", "5.5")
+        cfg = ServerConfig()
+        assert cfg.batching is True
+        assert cfg.batch_policy == "fixed"
+        assert cfg.batch_max == 8
+        assert cfg.batch_wait_ms == 2.5
+        assert cfg.cache_enabled is True
+        assert cfg.cache_ttl_s == 5.5
+
+    def test_explicit_args_beat_env(self, monkeypatch):
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        monkeypatch.setenv("PIO_SERVING_BATCH_MAX", "8")
+        assert ServerConfig(batch_max=32).batch_max == 32
+
+    def test_malformed_env_falls_back(self, monkeypatch):
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        monkeypatch.setenv("PIO_SERVING_BATCH_MAX", "lots")
+        assert ServerConfig().batch_max == 64
+
+    def test_no_import_time_config_freeze(self):
+        """Default configs are built at CALL time — a module-level
+        `= ServerConfig()` default would freeze the env reads at
+        import, silently ignoring later PIO_SERVING_* changes."""
+        import inspect
+
+        from predictionio_tpu.api.engine_server import (
+            EngineServer,
+            EngineService,
+            create_engine_server,
+        )
+        from predictionio_tpu.workflow.deploy import load_deployed_engine
+
+        for fn in (create_engine_server, load_deployed_engine,
+                   EngineService.__init__, EngineServer.__init__):
+            assert inspect.signature(fn).parameters["config"].default \
+                is None, fn
+
+    def test_malformed_policy_env_falls_back(self, monkeypatch):
+        """A typo'd policy name degrades to the default instead of
+        crashing the server at EngineService construction."""
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        monkeypatch.setenv("PIO_SERVING_BATCH_POLICY", "Adaptive-ish")
+        assert ServerConfig().batch_policy == "adaptive"
+        monkeypatch.setenv("PIO_SERVING_BATCH_POLICY", "FIXED")
+        assert ServerConfig().batch_policy == "fixed"   # case-normalized
+
+
+# ---------------------------------------------------------------------------
+# batcher deadline + dedup contracts (stub engine, no HTTP)
+# ---------------------------------------------------------------------------
+
+
+class _StubDeployed:
+    def __init__(self):
+        self.batch_calls: list[list] = []
+        self.single_calls: list = []
+        self.served_records: list[float] = []
+        self.lock = threading.Lock()
+
+    def query_batch(self, queries):
+        with self.lock:
+            self.batch_calls.append(list(queries))
+        return [("batched", q) for q in queries]
+
+    def query(self, q):
+        with self.lock:
+            self.single_calls.append(q)
+        return ("single", q)
+
+    def record_served(self, dt):
+        # part of the DeployedEngine contract: deduped waiters /cache
+        # hits count as served requests
+        with self.lock:
+            self.served_records.append(dt)
+
+
+class TestBatcherContracts:
+    def test_expired_budget_fails_before_enqueue(self):
+        deployed = _StubDeployed()
+        stats = ServingStats()
+        b = QueryBatcher(lambda: deployed, stats=stats)
+        try:
+            with deadline_scope(0.0):
+                with pytest.raises(QueryDeadlineExceeded):
+                    b.submit({"q": 1})
+        finally:
+            b.close()
+        assert deployed.batch_calls == []
+        assert stats.count("expired") == 1
+
+    def test_expired_at_dequeue_never_dispatches(self):
+        """A query whose deadline dies during the coalescing window is
+        failed at dequeue, not scored and discarded."""
+        deployed = _StubDeployed()
+        stats = ServingStats()
+        # 400ms fixed window: the 50ms budget expires inside it
+        b = QueryBatcher(lambda: deployed,
+                         policy=FixedBatchPolicy(batch_max=4, wait_ms=400.0),
+                         stats=stats)
+        try:
+            with deadline_scope(0.05):
+                with pytest.raises(QueryDeadlineExceeded):
+                    b.submit({"q": 1}, timeout=5.0)
+        finally:
+            b.close()
+        assert deployed.batch_calls == []
+        assert stats.count("expired") == 1
+
+    def test_identical_queries_dedup_to_one_slot(self):
+        deployed = _StubDeployed()
+        stats = ServingStats()
+        b = QueryBatcher(lambda: deployed,
+                         policy=FixedBatchPolicy(batch_max=8, wait_ms=300.0),
+                         stats=stats)
+        results = [None] * 6
+        barrier = threading.Barrier(6)
+
+        def go(i):
+            barrier.wait()
+            # 4 identical queries + 2 distinct ones
+            key = "same" if i < 4 else f"diff{i}"
+            results[i] = b.submit({"k": key}, timeout=10.0, key=key)
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        b.close()
+        # every submit answered; the 4 identical ones share one result
+        assert all(r is not None for r in results)
+        assert results[0] == results[1] == results[2] == results[3]
+        total_dispatched = sum(len(c) for c in deployed.batch_calls)
+        # the barrier + 300ms window make one batch near-certain, but
+        # the contract asserted is scheduling-independent: some dedup
+        # happened, and every deduped query skipped a device slot
+        assert stats.count("deduped") >= 1
+        assert total_dispatched + stats.count("deduped") == 6
+        # ...while still counting as a served request (record_served)
+        assert len(deployed.served_records) == stats.count("deduped")
+
+    def test_poisoned_batch_fallback_shares_group_result(self):
+        class Flaky(_StubDeployed):
+            def query_batch(self, queries):
+                raise RuntimeError("batch path down")
+
+        deployed = Flaky()
+        b = QueryBatcher(lambda: deployed,
+                         policy=FixedBatchPolicy(batch_max=4, wait_ms=200.0))
+        results = [None] * 3
+        barrier = threading.Barrier(3)
+
+        def go(i):
+            barrier.wait()
+            results[i] = b.submit({"k": "same"}, timeout=10.0, key="same")
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        b.close()
+        assert all(r == ("single", {"k": "same"}) for r in results)
+        # ONE per-query fallback predict covered the whole dedup group
+        assert 1 <= len(deployed.single_calls) <= 3
