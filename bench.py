@@ -739,6 +739,17 @@ def bench_serving_path():
     return bench_serving.bench_section()
 
 
+def bench_data_plane():
+    """Columnar scan vs row iterator + transactional batch ingest — the
+    PR 4 data-plane trajectory. Standalone harness: bench_ingest.py
+    (committed artifacts: BENCH_ingest_rNN.json); this section runs it
+    at reduced volume so every round's line carries the data-plane
+    numbers."""
+    import bench_ingest
+
+    return bench_ingest.bench_section()
+
+
 def bench_batch_predict(n_items: int = 2_000_000, batch: int = 256,
                         rounds: int = 8):
     """Batched top-k scoring against a 2M-item catalog — the eval hot
@@ -1172,15 +1183,16 @@ def main() -> None:
         ("quality", bench_quality),
         ("seqrec", bench_seqrec),
         ("ingest", bench_ingest),
+        ("data_plane", bench_data_plane),
     ]
     failed = []
     if args.skip_heavy:
         # skipped sections' keys are absent, which IS an incomplete
-        # artifact — the completeness marker must say so
-        failed.extend(s[0] for s in sections
-                      if s[0] not in ("quality", "ingest"))
-        sections = [s for s in sections
-                    if s[0] in ("quality", "ingest")]
+        # artifact — the completeness marker must say so. data_plane
+        # stays: it is CPU+storage bound like ingest, no device needed
+        keep = ("quality", "ingest", "data_plane")
+        failed.extend(s[0] for s in sections if s[0] not in keep)
+        sections = [s for s in sections if s[0] in keep]
     for section, fn in sections:
         try:
             line.update(fn())

@@ -1,0 +1,505 @@
+"""Data-plane benchmark: columnar scans + transactional batch ingest.
+
+The serving hot path got its own harness in PR 3 (bench_serving.py);
+this one covers the OTHER half of the Lambda architecture — the event
+store's write path (POST /batch/events.json) and the train-time bulk
+read. Three measurements:
+
+- ``scan``   — events-scanned/sec, columnar (``find_columnar`` ->
+               vectorized column consumption, the PR 4 DataSource path)
+               vs the row iterator (``find`` -> per-event Python loop,
+               the pre-PR-4 path), on the memory and file-backed sqlite
+               backends. Both consumers produce the SAME rating triples
+               (the recommendation DataSource workload) and the harness
+               asserts the outputs match before trusting the ratio.
+               Interleaved best-of-N rounds (bench.py's min-of-N
+               discipline: the two numbers form a RATIO, so they must
+               sample comparable host conditions).
+- ``ingest_dao``  — events/sec into file-backed sqlite: per-event
+               ``insert`` loop (one commit per event) vs ``insert_batch``
+               (one executemany in one transaction) — the isolation of
+               the single-transaction win from HTTP costs.
+- ``ingest_http`` — batched REST ingest events/sec through a real
+               EventServer into file-backed sqlite, with MULTI-PROCESS
+               load generation (separate client processes, GO-handshake
+               synchronized): in-process clients share the server's GIL
+               and corrupt the measurement on a small host
+               (bench_serving.py measured the collapse).
+
+Prints ONE JSON line in the BENCH contract ({"metric", "value",
+"unit", ...}); bench.py wires :func:`bench_section` in as the
+``data_plane`` section. Artifacts: BENCH_ingest_rNN.json.
+Runs with JAX_PLATFORMS=cpu — nothing here touches a device; the scan
+side is bounded by Python object churn, which is exactly what the
+columnar path removes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+import numpy as np
+
+DEF_SCAN_EVENTS = 120_000
+DEF_SCAN_ROUNDS = 3
+DEF_INGEST_EVENTS = 6_000
+DEF_INGEST_BATCH = 50
+DEF_HTTP_CLIENTS = 8
+DEF_HTTP_PROCS = 3
+BUY_RATING = 4.0
+
+
+# ---------------------------------------------------------------------------
+# Workload: a realistic event mix for the recommendation DataSource
+# ---------------------------------------------------------------------------
+
+def make_events(n: int, seed: int = 0):
+    """rate/buy/view events over a skewed catalog plus $set property
+    events — the shape a recommendation app's event table actually
+    has: view-dominated (implicit feedback outnumbers explicit ratings
+    by a wide margin in production streams, which is why the reference
+    similarproduct/ecommerce templates train on view events), with a
+    minority of property-carrying rate and $set events."""
+    import datetime as dt
+
+    from predictionio_tpu.core.datamap import DataMap
+    from predictionio_tpu.core.event import Event
+
+    rng = np.random.default_rng(seed)
+    t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+    kinds = rng.choice(4, size=n, p=[0.15, 0.15, 0.55, 0.15])
+    users = (2000 * rng.random(n) ** 1.6).astype(np.int64)
+    items = (5000 * rng.random(n) ** 1.6).astype(np.int64)
+    ratings = rng.integers(1, 11, size=n) / 2.0
+    out = []
+    for j in range(n):
+        t = t0 + dt.timedelta(seconds=int(j))
+        if kinds[j] == 3:
+            out.append(Event(
+                event="$set", entity_type="user", entity_id=f"u{users[j]}",
+                properties=DataMap({"segment": int(users[j]) % 7}),
+                event_time=t))
+            continue
+        name = ("rate", "buy", "view")[kinds[j]]
+        props = DataMap({"rating": float(ratings[j])}) if name == "rate" else DataMap()
+        out.append(Event(
+            event=name, entity_type="user", entity_id=f"u{users[j]}",
+            target_entity_type="item", target_entity_id=f"i{items[j]}",
+            properties=props, event_time=t))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Scan: columnar vs row iterator (the DataSource ratings workload)
+# ---------------------------------------------------------------------------
+
+_SCAN_NAMES = ("rate", "buy", "view")
+
+
+def _scan_filter():
+    from predictionio_tpu.storage.base import EventFilter
+
+    return EventFilter(entity_type="user", event_names=list(_SCAN_NAMES),
+                       target_entity_type="item")
+
+
+def consume_rows(events_dao, app_id: int):
+    """The pre-PR-4 read path: per-event Python loop over find()."""
+    users, items, ratings = [], [], []
+    for ev in events_dao.find(app_id, None, _scan_filter()):
+        if ev.target_entity_id is None:
+            continue
+        if ev.event == "rate":
+            try:
+                rating = float(ev.properties.get("rating"))
+            except (KeyError, TypeError, ValueError):
+                continue
+        else:
+            rating = BUY_RATING
+        users.append(ev.entity_id)
+        items.append(ev.target_entity_id)
+        ratings.append(rating)
+    return (np.asarray(users, dtype=object), np.asarray(items, dtype=object),
+            np.asarray(ratings, dtype=np.float32))
+
+
+def consume_columnar(events_dao, app_id: int):
+    """The PR 4 read path: find_columnar batches consumed through the
+    SAME vectorized kernel the recommendation DataSource runs
+    (templates/recommendation.ratings_from_columns) — the benchmark
+    measures the product's code, not a copy that can drift."""
+    from predictionio_tpu.templates.recommendation import ratings_from_columns
+
+    parts = [
+        part
+        for cols in events_dao.find_columnar(app_id, None, _scan_filter())
+        if (part := ratings_from_columns(cols, BUY_RATING)) is not None
+    ]
+    if not parts:
+        return (np.asarray([], dtype=object), np.asarray([], dtype=object),
+                np.asarray([], dtype=np.float32))
+    return (np.concatenate([p[0] for p in parts]),
+            np.concatenate([p[1] for p in parts]),
+            np.concatenate([p[2] for p in parts]))
+
+
+def _seeded_backend(kind: str, events, tmp: str):
+    from predictionio_tpu.storage.base import StorageClientConfig
+    from predictionio_tpu.storage.memory import MemoryStorageClient
+    from predictionio_tpu.storage.sqlite import SQLiteStorageClient
+
+    if kind == "memory":
+        client = MemoryStorageClient()
+    else:
+        client = SQLiteStorageClient(StorageClientConfig(
+            properties={"PATH": f"{tmp}/scan_{kind}.sqlite"}))
+    dao = client.events()
+    dao.init(1)
+    for at in range(0, len(events), 1000):
+        dao.insert_batch(events[at:at + 1000], 1)
+    return client, dao
+
+
+def bench_scan(n_events: int = DEF_SCAN_EVENTS,
+               rounds: int = DEF_SCAN_ROUNDS) -> dict:
+    import tempfile
+
+    events = make_events(n_events)
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        for kind in ("memory", "sqlite"):
+            client, dao = _seeded_backend(kind, events, tmp)
+            try:
+                # correctness first: both consumers must produce the
+                # same triples, or the ratio measures different work
+                ru, ri, rr = consume_rows(dao, 1)
+                cu, ci, cr = consume_columnar(dao, 1)
+                assert list(ru) == list(cu) and list(ri) == list(ci)
+                assert np.allclose(rr, cr)
+                row_times, col_times = [], []
+                for _ in range(rounds):   # interleaved: the number is a ratio
+                    t0 = time.perf_counter()
+                    consume_rows(dao, 1)
+                    row_times.append(time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    consume_columnar(dao, 1)
+                    col_times.append(time.perf_counter() - t0)
+            finally:
+                client.close()
+            row_rate = n_events / min(row_times)
+            col_rate = n_events / min(col_times)
+            out[f"scan_row_events_per_sec_{kind}"] = round(row_rate, 1)
+            out[f"scan_columnar_events_per_sec_{kind}"] = round(col_rate, 1)
+            out[f"scan_speedup_x_{kind}"] = round(col_rate / row_rate, 2)
+            out[f"scan_rounds_{kind}"] = rounds
+    out["scan_events"] = n_events
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Ingest, DAO level: one transaction vs per-event commits
+# ---------------------------------------------------------------------------
+
+def bench_ingest_dao(n_events: int = DEF_INGEST_EVENTS,
+                     batch: int = DEF_INGEST_BATCH, rounds: int = 3) -> dict:
+    import tempfile
+
+    from predictionio_tpu.storage.base import StorageClientConfig
+    from predictionio_tpu.storage.sqlite import SQLiteStorageClient
+
+    events = make_events(n_events)
+    per_event_times, batch_times = [], []
+    with tempfile.TemporaryDirectory() as tmp:
+        client = SQLiteStorageClient(StorageClientConfig(
+            properties={"PATH": f"{tmp}/ingest.sqlite"}))
+        dao = client.events()
+
+        def fresh_table():
+            # every timed phase starts from the SAME empty table:
+            # events carry no ids, so each phase appends fresh rows and
+            # without the reset later phases would be measured against
+            # bigger B-trees than earlier ones (ratio bias)
+            dao.remove(1)
+            dao.init(1)
+            dao.insert_batch(events[:batch], 1)   # warm table/WAL
+
+        try:
+            for _ in range(rounds):              # interleaved (ratio)
+                fresh_table()
+                t0 = time.perf_counter()
+                for e in events:
+                    dao.insert(e, 1)
+                per_event_times.append(time.perf_counter() - t0)
+                fresh_table()
+                t0 = time.perf_counter()
+                for at in range(0, n_events, batch):
+                    dao.insert_batch(events[at:at + batch], 1)
+                batch_times.append(time.perf_counter() - t0)
+        finally:
+            client.close()
+    per_rate = n_events / min(per_event_times)
+    batch_rate = n_events / min(batch_times)
+    return {
+        "ingest_per_event_events_per_sec": round(per_rate, 1),
+        "ingest_batch_tx_events_per_sec": round(batch_rate, 1),
+        "ingest_tx_speedup_x": round(batch_rate / per_rate, 2),
+        "ingest_dao_events": n_events,
+        "ingest_dao_batch": batch,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Ingest, HTTP level: multi-process load against a real EventServer
+# ---------------------------------------------------------------------------
+
+def _client_main(argv: list[str]) -> None:
+    """Load-generator subprocess: ``--threads`` keep-alive raw-socket
+    connections each POST ``--count`` batch requests after a GO
+    handshake (same protocol as bench_serving.py: all processes start
+    together, startup stays out of the timed window)."""
+    import socket
+    import sys
+
+    sys.setswitchinterval(0.0005)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--threads", type=int, required=True)
+    ap.add_argument("--count", type=int, required=True)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, required=True)
+    ap.add_argument("--cid0", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import threading
+
+    path = "/batch/events.json?accessKey=bench-key"
+
+    def build_request(cid: int, j: int) -> bytes:
+        payload = [
+            {"event": "rate", "entityType": "user",
+             "entityId": f"u{(cid * 131 + j * 17 + k) % 997}",
+             "targetEntityType": "item",
+             "targetEntityId": f"i{(cid * 37 + j * 11 + k) % 503}",
+             "properties": {"rating": float(k % 5 + 1)}}
+            for k in range(args.batch_size)
+        ]
+        body = json.dumps(payload).encode()
+        return (b"POST " + path.encode() + b" HTTP/1.1\r\n"
+                b"Host: 127.0.0.1\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"\r\n" + body)
+
+    def read_response(sock: socket.socket, buf: bytearray) -> None:
+        while True:
+            head_end = buf.find(b"\r\n\r\n")
+            if head_end >= 0:
+                break
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("closed mid-headers")
+            buf += chunk
+        head = bytes(buf[:head_end]).lower()
+        marker = b"content-length:"
+        at = head.find(marker)
+        if at < 0:
+            raise ConnectionError("no content-length")
+        line_end = head.find(b"\r\n", at)
+        if line_end < 0:
+            line_end = len(head)
+        length = int(head[at + len(marker):line_end])
+        need = head_end + 4 + length
+        while len(buf) < need:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("closed mid-body")
+            buf += chunk
+        del buf[:need]
+
+    errors = [0] * args.threads
+
+    def client(tid: int, count: int) -> None:
+        cid = args.cid0 + tid
+        reqs = [build_request(cid, j) for j in range(min(count, 16))]
+        sock = None
+        buf = bytearray()
+        try:
+            for j in range(count):
+                try:
+                    if sock is None:
+                        sock = socket.create_connection(
+                            ("127.0.0.1", args.port), timeout=120)
+                        sock.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                        buf.clear()
+                    sock.sendall(reqs[j % len(reqs)])
+                    read_response(sock, buf)
+                except OSError:
+                    errors[tid] += 1
+                    if sock is not None:
+                        sock.close()
+                    sock = None
+        finally:
+            if sock is not None:
+                sock.close()
+
+    def run(count: int) -> None:
+        threads = [threading.Thread(target=client, args=(t, count))
+                   for t in range(args.threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    run(args.warmup)
+    print("READY", flush=True)
+    sys.stdin.readline()
+    run(args.count)
+    print(json.dumps({"errors": int(sum(errors))}), flush=True)
+
+
+def _http_round(port: int, clients: int, per_client: int, batch_size: int,
+                procs: int) -> dict:
+    import subprocess
+    import sys
+
+    procs = max(1, min(procs, clients))
+    per_proc = [clients // procs + (1 if i < clients % procs else 0)
+                for i in range(procs)]
+    children = []
+    cid0 = 0
+    for n_threads in per_proc:
+        children.append(subprocess.Popen(
+            [sys.executable, __file__, "--client",
+             "--port", str(port), "--threads", str(n_threads),
+             "--count", str(per_client), "--batch-size", str(batch_size),
+             "--cid0", str(cid0)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True))
+        cid0 += n_threads
+    for child in children:
+        assert child.stdout.readline().strip() == "READY"
+    t0 = time.perf_counter()
+    for child in children:
+        child.stdin.write("GO\n")
+        child.stdin.flush()
+    outs = [json.loads(child.stdout.readline()) for child in children]
+    dt = time.perf_counter() - t0
+    for child in children:
+        child.wait(timeout=30)
+    total_events = clients * per_client * batch_size
+    return {
+        "events_per_sec": round(total_events / dt, 1),
+        "errors": int(sum(o["errors"] for o in outs)),
+        "events": total_events,
+    }
+
+
+def bench_ingest_http(clients: int = DEF_HTTP_CLIENTS, per_client: int = 12,
+                      batch_size: int = DEF_INGEST_BATCH, rounds: int = 3,
+                      procs: int = DEF_HTTP_PROCS) -> dict:
+    import tempfile
+
+    from predictionio_tpu.api.event_server import EventServer, EventServerConfig
+    from predictionio_tpu.storage.base import AccessKey, App
+    from predictionio_tpu.storage.registry import Storage
+
+    with tempfile.TemporaryDirectory() as tmp:
+        storage = Storage({
+            "PIO_STORAGE_SOURCES_S_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_S_PATH": f"{tmp}/pio.db",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+        })
+        app_id = storage.get_meta_data_apps().insert(App(0, "BenchApp"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey("bench-key", app_id, []))
+        storage.get_events().init(app_id)
+        server = EventServer(storage, EventServerConfig(
+            ip="127.0.0.1", port=0, stats=True))
+        server.start()
+        try:
+            best = None
+            for _ in range(rounds):
+                r = _http_round(server.port, clients, per_client,
+                                batch_size, procs)
+                if best is None or r["events_per_sec"] > best["events_per_sec"]:
+                    best = r
+            ingest = server.service.ingest_stats.snapshot()
+        finally:
+            server.stop()
+    return {
+        "ingest_http_events_per_sec": best["events_per_sec"],
+        "ingest_http_clients": clients,
+        "ingest_http_batch": batch_size,
+        "ingest_http_errors": best["errors"],
+        "ingest_http_rounds": rounds,
+        "ingest_stats_mean_batch": ingest["meanBatchSize"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def bench_data_plane(scan_events: int = DEF_SCAN_EVENTS,
+                     ingest_events: int = DEF_INGEST_EVENTS,
+                     clients: int = DEF_HTTP_CLIENTS,
+                     rounds: int = DEF_SCAN_ROUNDS,
+                     procs: int = DEF_HTTP_PROCS) -> dict:
+    scan = bench_scan(n_events=scan_events, rounds=rounds)
+    dao = bench_ingest_dao(n_events=ingest_events, rounds=rounds)
+    http = bench_ingest_http(clients=clients, rounds=rounds, procs=procs)
+    headline = scan["scan_columnar_events_per_sec_sqlite"]
+    return {
+        "metric": "scan_columnar_events_per_sec_sqlite",
+        "value": headline,
+        "unit": "events/sec",
+        **scan,
+        **dao,
+        **http,
+    }
+
+
+def bench_section() -> dict:
+    """The ``data_plane`` section for bench.py's round artifact: the
+    same phases at reduced volume, the headline ratios only (the full
+    harness artifacts are BENCH_ingest_rNN.json)."""
+    r = bench_data_plane(scan_events=30_000, ingest_events=2_000,
+                         clients=4, rounds=2)
+    return {
+        "scan_columnar_events_per_sec_sqlite":
+            r["scan_columnar_events_per_sec_sqlite"],
+        "scan_row_events_per_sec_sqlite":
+            r["scan_row_events_per_sec_sqlite"],
+        "scan_speedup_x_sqlite": r["scan_speedup_x_sqlite"],
+        "scan_speedup_x_memory": r["scan_speedup_x_memory"],
+        "ingest_tx_speedup_x": r["ingest_tx_speedup_x"],
+        "ingest_http_events_per_sec": r["ingest_http_events_per_sec"],
+    }
+
+
+def main() -> None:
+    import sys
+
+    if "--client" in sys.argv:
+        _client_main([a for a in sys.argv[1:] if a != "--client"])
+        return
+    sys.setswitchinterval(0.0005)
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--scan-events", type=int, default=DEF_SCAN_EVENTS)
+    parser.add_argument("--ingest-events", type=int, default=DEF_INGEST_EVENTS)
+    parser.add_argument("--clients", type=int, default=DEF_HTTP_CLIENTS)
+    parser.add_argument("--rounds", type=int, default=DEF_SCAN_ROUNDS)
+    parser.add_argument("--client-procs", type=int, default=DEF_HTTP_PROCS)
+    args = parser.parse_args()
+    print(json.dumps(bench_data_plane(
+        scan_events=args.scan_events, ingest_events=args.ingest_events,
+        clients=args.clients, rounds=args.rounds, procs=args.client_procs)))
+
+
+if __name__ == "__main__":
+    main()

@@ -88,8 +88,10 @@ def path_matches(relpath: str, prefixes: tuple[str, ...]) -> bool:
 COMPUTE_PATHS = ("ops/", "models/", "e2/")
 
 #: request-serving hot path: handler threads, the deployed query path,
-#: and the batching/cache subsystem (serving/ — PR 3)
-HOT_PATHS = ("api/", "workflow/deploy.py", "serving/")
+#: the batching/cache subsystem (serving/ — PR 3), and the columnar
+#: data plane's scan/view consumers (data/ — PR 4): a host sync inside
+#: the train-read loop would serialize every batch
+HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/")
 
 
 def default_config() -> LintConfig:
@@ -97,9 +99,14 @@ def default_config() -> LintConfig:
     return LintConfig(
         rules={
             "resilience-bypass": RuleConfig(
-                # serving/ carries the strictest policy (no guard-table
-                # entries): any raw network call there is a violation
-                paths=("storage/", "serving/"),
+                # serving/, data/ and the event server's ingest path
+                # carry the strictest policy (no guard-table entries):
+                # any raw network call there is a violation — the
+                # columnar scan and batch-ingest paths must reach
+                # remote backends only through the DAO layer's
+                # resilient() wrappers
+                paths=("storage/", "serving/", "data/",
+                       "api/event_server.py"),
                 options={
                     # raw-network callables we police
                     "net_calls": ["urlopen", "create_connection"],

@@ -86,6 +86,74 @@ class ServingStats:
         }
 
 
+class IngestStats:
+    """Counters for the event server's ingest path, written by the
+    request handlers after each successful insert/insert_batch — the
+    same one-lock-at-writers-AND-readers discipline as
+    :class:`ServingStats`, so a ``GET /stats.json`` reader never sees a
+    torn histogram and the lock-discipline lint needs no suppressions.
+
+    ``events_per_sec_ewma`` smooths the instantaneous batch rate
+    (batch size / time since the previous batch) with EWMA_ALPHA.
+    Caveat (bench discipline): under a closed-loop load generator the
+    EWMA tracks the generator's issue rate, not server capacity — treat
+    it as an observability signal, not a benchmark number."""
+
+    EWMA_ALPHA = 0.2
+    #: SKIP (not clamp) the EWMA update for gaps below this: two
+    #: handler threads landing in the same instant would otherwise
+    #: divide by ~zero and fold a meaningless multi-million-events/sec
+    #: spike into the average
+    _MIN_DT = 1e-6
+
+    def __init__(self, clock=None):
+        import time
+
+        self._now = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._batches = 0
+        self._events = 0
+        #: inserted batch size -> count (1 = single-event posts)
+        self._batch_hist: Counter[int] = Counter()
+        self._last_t: float | None = None
+        self._ewma_rate: float | None = None
+
+    def record_batch(self, n: int) -> None:
+        """One successful storage insert of ``n`` events."""
+        if n <= 0:
+            return
+        with self._lock:
+            # clock read INSIDE the lock: a thread that read the clock
+            # before losing the lock race would otherwise compute a
+            # negative-then-clamped dt and spike the EWMA
+            now = self._now()
+            self._batches += 1
+            self._events += n
+            self._batch_hist[n] += 1
+            if self._last_t is not None:
+                dt = now - self._last_t
+                if dt >= self._MIN_DT:
+                    inst = n / dt
+                    self._ewma_rate = (
+                        inst if self._ewma_rate is None
+                        else self.EWMA_ALPHA * inst
+                        + (1.0 - self.EWMA_ALPHA) * self._ewma_rate)
+            self._last_t = now
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            batches, events = self._batches, self._events
+            hist = {str(k): v for k, v in sorted(self._batch_hist.items())}
+            rate = self._ewma_rate
+        return {
+            "batches": batches,
+            "events": events,
+            "meanBatchSize": round(events / batches, 2) if batches else None,
+            "batchSizeHistogram": hist,
+            "eventsPerSecEwma": round(rate, 1) if rate is not None else None,
+        }
+
+
 @dataclasses.dataclass(frozen=True)
 class EntityTypesEvent:
     """Parity: EntityTypesEvent (Stats.scala:30-39)."""

@@ -74,6 +74,48 @@ class EventStore:
             ),
         )
 
+    def scan(
+        self,
+        app_name: str,
+        channel_name: str | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None | type(...) = ...,
+        target_entity_id: str | None | type(...) = ...,
+        limit: int | None = None,
+        reversed: bool = False,
+        batch_size: int | None = None,
+    ) -> "Iterator[EventColumns]":
+        """Training-time bulk read as columnar batches — the same filter
+        surface as :meth:`find`, yielding ``EventColumns``
+        (core/columns.py) instead of per-event objects. This is the
+        train-path analogue of the reference's PEvents RDD read: engines
+        consume numpy columns per batch and never touch an Event in the
+        hot loop (docs/data-pipeline.md)."""
+        from predictionio_tpu.storage.base import Events
+
+        app_id, channel_id = self.app_name_to_id(app_name, channel_name)
+        return self.storage.get_events().find_columnar(
+            app_id,
+            channel_id,
+            EventFilter(
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+                limit=limit,
+                reversed=reversed,
+            ),
+            batch_size=(Events.COLUMNAR_BATCH_SIZE if batch_size is None
+                        else batch_size),
+        )
+
     def aggregate_properties(
         self,
         app_name: str,

@@ -196,18 +196,36 @@ def create_data_view(
             return pq.read_table(cache_path)
         logger.info("cached copy not found, reading from the event store")
 
-    rows = []
-    for e in store.find(app_name, channel_name=channel_name,
-                        start_time=start_time, until_time=until_time):
-        row = conversion(e)
-        if row is None:
-            continue
-        if dataclasses.is_dataclass(row):
-            row = dataclasses.asdict(row)
-        elif not isinstance(row, dict):
-            row = {f"f{i}": v for i, v in enumerate(row)}
-        rows.append(row)
-    table = pa.Table.from_pylist(rows)
+    # stream the event scan into per-chunk record batches (the columnar
+    # scan underneath bounds what is resident: one EventColumns batch +
+    # one converted chunk, never the whole result set as a Python list)
+    batches: list[pa.RecordBatch] = []
+    for cols in store.scan(app_name, channel_name=channel_name,
+                           start_time=start_time, until_time=until_time):
+        chunk = []
+        for e in cols.to_events():
+            row = conversion(e)
+            if row is None:
+                continue
+            if dataclasses.is_dataclass(row):
+                row = dataclasses.asdict(row)
+            elif not isinstance(row, dict):
+                row = {f"f{i}": v for i, v in enumerate(row)}
+            chunk.append(row)
+        if chunk:
+            batches.append(pa.RecordBatch.from_pylist(chunk))
+    if not batches:
+        table = pa.Table.from_pylist([])
+    else:
+        # per-chunk inferred schemas can disagree (ints then floats);
+        # promoted concat unifies them the way one global from_pylist did
+        tables = [pa.Table.from_batches([b]) for b in batches]
+        try:
+            table = pa.concat_tables(tables, promote_options="permissive")
+        except TypeError:
+            # pyarrow < 14 spells type promotion promote=True (the
+            # parquet extra does not pin a floor)
+            table = pa.concat_tables(tables, promote=True)
     if cache_path is not None:
         os.makedirs(os.path.dirname(cache_path), exist_ok=True)
         tmp = f"{cache_path}.tmp.{os.getpid()}"

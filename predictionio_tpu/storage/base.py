@@ -209,6 +209,33 @@ class Events(abc.ABC):
     ) -> Iterator[Event]:
         """Filtered scan (LEvents.futureFind, LEvents.scala:188-214)."""
 
+    #: default ``find_columnar`` batch size — large enough that the
+    #: per-batch fixed cost (vocab build, array allocation) amortizes,
+    #: small enough that a batch stays cache- and memory-friendly
+    COLUMNAR_BATCH_SIZE = 4096
+
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter = EventFilter(),
+        batch_size: int = COLUMNAR_BATCH_SIZE,
+    ) -> "Iterator[EventColumns]":
+        """Filtered scan as struct-of-arrays batches (core/columns.py):
+        the training-read path of the columnar data plane (the role
+        PEvents' RDD reads play in the reference, PEvents.scala:80-103).
+
+        Contract: concatenating the yielded batches reproduces EXACTLY
+        the event sequence ``find`` returns for the same filter — order,
+        ties, and limit cuts included (pinned per backend by the
+        conformance suite). This generic implementation chunks ``find``
+        through the rows->columns builder; backends with a cheaper
+        native representation (memory, sqlite, binevents) override it.
+        """
+        from predictionio_tpu.core.columns import iter_batches
+
+        return iter_batches(self.find(app_id, channel_id, filter), batch_size)
+
     def aggregate_properties(
         self,
         app_id: int,

@@ -158,12 +158,15 @@ def _py_valid_prefix(path: str) -> int:
     return good
 
 
-def _py_scan(path: str, flt: EventFilter) -> list[bytes]:
+def _py_scan_records(path: str, flt: EventFilter) -> list[tuple]:
+    """Live records surviving the filter, as the frame's decoded fields:
+    ``(id, t_us, name, etype, eid, tet, tei, payload)``."""
     start_us = _to_us(flt.start_time) if flt.start_time is not None else None
     until_us = _to_us(flt.until_time) if flt.until_time is not None else None
     names = set(flt.event_names) if flt.event_names is not None else None
     out = []
-    for t_us, name, etype, eid, tet, tei, payload in _py_replay(path).values():
+    for rid, rec in _py_replay(path).items():
+        t_us, name, etype, eid, tet, tei, payload = rec
         if start_us is not None and t_us < start_us:
             continue
         if until_us is not None and t_us >= until_us:
@@ -178,8 +181,12 @@ def _py_scan(path: str, flt: EventFilter) -> list[bytes]:
             continue
         if flt.target_entity_id is not ... and tei != flt.target_entity_id:
             continue
-        out.append(payload)
+        out.append((rid, *rec))
     return out
+
+
+def _py_scan(path: str, flt: EventFilter) -> list[bytes]:
+    return [rec[-1] for rec in _py_scan_records(path, flt)]
 
 
 # ---------------------------------------------------------------------------
@@ -422,6 +429,62 @@ class BinEvents(base.Events):
         if filter.limit is not None and filter.limit >= 0:
             events = events[: filter.limit]
         return iter(events)
+
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter = EventFilter(),
+        batch_size: int = base.Events.COLUMNAR_BATCH_SIZE,
+    ):
+        """Native path: the binary log's frame headers decode straight
+        into arrays — time/name/entity/target live in fixed binary
+        fields ahead of the JSON payload, so no Event object and no
+        JSON parse happens for the hot columns (the payload rides along
+        as the lazy cold column). Same (event_time, event_id) ordering
+        and limit cut as ``find``. The per-record fflush in the native
+        writer (native/eventlog.cc pio_write_put) is what makes reading
+        the file directly safe while a native handle is open."""
+        from predictionio_tpu.core.columns import check_batch_size
+
+        check_batch_size(batch_size)
+        return self._find_columnar(app_id, channel_id, filter, batch_size)
+
+    def _find_columnar(self, app_id, channel_id, filter, batch_size):
+        import numpy as np
+
+        from predictionio_tpu.core.columns import EventColumns, encode_column
+
+        with self._lock:
+            path = self._file(app_id, channel_id)
+            if not os.path.exists(path):
+                return
+            if filter.event_names is not None and len(filter.event_names) == 0:
+                return
+            records = _py_scan_records(path, filter)
+        # same total order as find(): find sorts by the PAYLOAD's
+        # event_time (wire JSON, millisecond-truncated) with event_id
+        # tiebreak, so the columnar sort key truncates t_us to ms —
+        # sorting by raw µs could order sub-millisecond neighbors
+        # differently from the row path; ids are unique so
+        # ascending-sort + reverse equals a descending sort
+        records.sort(key=lambda r: (r[1] // 1000, r[0]),
+                     reverse=filter.reversed)
+        if filter.limit is not None and filter.limit >= 0:
+            records = records[: filter.limit]
+        for at in range(0, len(records), batch_size):
+            chunk = records[at:at + batch_size]
+            ids, t_us, names, etypes, eids, tets, teis, payloads = zip(*chunk)
+            yield EventColumns.from_event_json(
+                times_us=np.asarray(t_us, dtype=np.int64),
+                event=encode_column(names),
+                entity_type=encode_column(etypes),
+                entity_id=encode_column(eids),
+                target_entity_type=encode_column(tets),
+                target_entity_id=encode_column(teis),
+                event_ids=ids,
+                payloads=payloads,
+            )
 
 
 class BinEventsStorageClient(base.BaseStorageClient):

@@ -58,6 +58,19 @@ class MemoryEvents(base.Events):
             self._tables[(app_id, channel_id)][event_id] = event.with_event_id(event_id)
         return event_id
 
+    def insert_batch(
+        self, events, app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        # one lock acquisition per batch (the transactional analogue of
+        # sqlite's single-commit executemany): a concurrent reader sees
+        # the whole batch or none of it
+        ids = [e.event_id or uuid.uuid4().hex for e in events]
+        with self._lock:
+            table = self._tables.setdefault((app_id, channel_id), {})
+            for event_id, e in zip(ids, events):
+                table[event_id] = e.with_event_id(event_id)
+        return ids
+
     def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None:
         with self._lock:
             return self._tables.get((app_id, channel_id), {}).get(event_id)
@@ -79,6 +92,34 @@ class MemoryEvents(base.Events):
                 if filter.matches(e)
             ]
         return iter(_sort_and_limit(events, filter))
+
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter = EventFilter(),
+        batch_size: int = base.Events.COLUMNAR_BATCH_SIZE,
+    ):
+        """Native path: one lock acquisition + one filter/sort pass over
+        the table, then a direct single-pass array build per batch —
+        no per-batch re-entry into ``find`` and no iterator hops."""
+        from predictionio_tpu.core.columns import check_batch_size
+
+        check_batch_size(batch_size)
+        return self._find_columnar(app_id, channel_id, filter, batch_size)
+
+    def _find_columnar(self, app_id, channel_id, filter, batch_size):
+        from predictionio_tpu.core.columns import EventColumns
+
+        with self._lock:
+            events = [
+                e
+                for e in self._tables.get((app_id, channel_id), {}).values()
+                if filter.matches(e)
+            ]
+        events = _sort_and_limit(events, filter)
+        for at in range(0, len(events), batch_size):
+            yield EventColumns.from_events(events[at:at + batch_size])
 
 
 class MemoryApps(base.Apps):

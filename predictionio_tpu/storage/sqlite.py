@@ -128,6 +128,33 @@ class _Connection:
             conn.executemany(sql, seq)
             conn.commit()
 
+    @property
+    def can_stream(self) -> bool:
+        """Streaming holds a pooled connection across the consumer's
+        whole scan loop; on a single-connection pool (``:memory:``)
+        any nested DAO call from inside that loop would starve waiting
+        for the one connection — such pools must take the buffered
+        read path instead."""
+        return self._max > 1
+
+    def execute_stream(self, sql: str, params: tuple = (),
+                       arraysize: int = 1024):
+        """One query, rows yielded in ``fetchmany``-sized chunks while
+        the borrowed connection is held — the columnar scan's streaming
+        read (a full ``fetchall`` would hold every row of a training
+        scan in Python lists at once). The generator must be exhausted
+        or closed for the connection to return to the pool; closing it
+        early (consumer break) releases via GeneratorExit. Callers must
+        honor :attr:`can_stream` (see there for the pool hazard)."""
+        with self._borrow() as conn:
+            cur = conn.execute(sql, params)
+            while True:
+                rows = cur.fetchmany(arraysize)
+                if not rows:
+                    break
+                yield rows
+            conn.commit()
+
     def close(self) -> None:
         self._closed = True
         while True:
@@ -188,6 +215,52 @@ def _row_to_event(row: tuple) -> Event:
     )
 
 
+def _times_to_us(raw: list[str]) -> "np.ndarray":
+    """Vectorized fixed-width-UTC text -> int64 epoch-micros. The
+    storage format (``_fmt_utc``) is always ``...%fZ``; anything else
+    (hand-written rows) falls back to per-row ISO parsing. The Z check
+    must come FIRST: blindly stripping the last char of a non-Z string
+    can still parse (dropping a fractional digit) and return a silently
+    wrong instant instead of a ValueError."""
+    import numpy as np
+
+    arr = np.asarray(raw)
+    if bool(np.all(np.char.endswith(arr, "Z"))):
+        try:
+            return (np.char.rstrip(arr, "Z")
+                    .astype("datetime64[us]").astype(np.int64))
+        except ValueError:
+            pass
+    from predictionio_tpu.core.columns import datetime_to_us
+
+    return np.asarray([datetime_to_us(parse_datetime(s)) for s in raw],
+                      dtype=np.int64)
+
+
+def _rows_to_columns(rows: list[tuple]):
+    """One fetchmany chunk -> EventColumns, no Event materialization:
+    ``zip(*rows)`` transposes at C speed, the dictionary encoding is the
+    C-level ``encode_column``, and properties/tags stay the row's JSON
+    text (the lazy column)."""
+    from predictionio_tpu.core.columns import EventColumns, encode_column
+
+    (ids, ev_names, etypes, eids, tets, teis, props, times, tags, pr_ids,
+     ctimes) = zip(*rows)
+    return EventColumns.from_sql_columns(
+        times_us=_times_to_us(times),
+        event=encode_column(ev_names),
+        entity_type=encode_column(etypes),
+        entity_id=encode_column(eids),
+        target_entity_type=encode_column(tets),
+        target_entity_id=encode_column(teis),
+        event_ids=ids,
+        props_json=props,
+        tags_json=tags,
+        pr_ids=pr_ids,
+        creation_raw=ctimes,
+    )
+
+
 class SQLiteEvents(base.Events):
     """Event DAO on sqlite. Parity: JDBCLEvents.scala:37-289."""
 
@@ -211,13 +284,19 @@ class SQLiteEvents(base.Events):
                 creationTime TEXT NOT NULL)"""
         )
         # entity-clustered time-ordered access path, the role the HBase
-        # backend gives its rowkey design (HBEventsUtil.scala:84-131)
+        # backend gives its rowkey design (HBEventsUtil.scala:84-131).
+        # Both indexes end in (eventTime, id) because the scan SQL
+        # orders by exactly that pair (the plan-independent tie order,
+        # _scan_sql): with id in the index, ordered+limited reads walk
+        # the index and skip the temp B-tree sort. Pre-existing tables
+        # keep their narrower indexes (IF NOT EXISTS) and simply pay
+        # the sort.
         self._conn.execute(
             f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t} "
-            "(entityType, entityId, eventTime)"
+            "(entityType, entityId, eventTime, id)"
         )
         self._conn.execute(
-            f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (eventTime)"
+            f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (eventTime, id)"
         )
         return True
 
@@ -290,13 +369,12 @@ class SQLiteEvents(base.Events):
             raise
         return existed
 
-    def find(
-        self,
-        app_id: int,
-        channel_id: int | None = None,
-        filter: EventFilter = EventFilter(),
-    ) -> Iterator[Event]:
-        """WHERE-clause assembly parity: JDBCPEvents.find:33-120."""
+    @staticmethod
+    def _scan_sql(app_id: int, channel_id: int | None,
+                  filter: EventFilter) -> tuple[str, tuple]:
+        """WHERE-clause assembly parity: JDBCPEvents.find:33-120. Shared
+        by the row iterator and the columnar scan so both read the SAME
+        sequence (order, ties, limit) from the database."""
         t = event_table_name(app_id, channel_id)
         clauses, params = [], []
         f = filter
@@ -329,19 +407,96 @@ class SQLiteEvents(base.Events):
                 clauses.append("targetEntityId = ?")
                 params.append(f.target_entity_id)
         where = f" WHERE {' AND '.join(clauses)}" if clauses else ""
-        order = " ORDER BY eventTime DESC" if f.reversed else " ORDER BY eventTime"
+        # id tiebreak: equal-timestamp order must not depend on which
+        # query plan ran the scan (the planner picks different index
+        # strategies for find vs the hinted columnar scan, and SQL
+        # gives ties no order at all without this — measured divergence
+        # on reversed entity-filtered scans); same contract as the
+        # binevents event_id tiebreaker
+        order = (" ORDER BY eventTime DESC, id DESC" if f.reversed
+                 else " ORDER BY eventTime, id")
         limit = (
             f" LIMIT {int(f.limit)}" if f.limit is not None and f.limit >= 0 else ""
         )
+        return (f"SELECT {_EVENT_COLUMNS} FROM {t}{where}{order}{limit}",
+                tuple(params))
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter = EventFilter(),
+    ) -> Iterator[Event]:
+        sql, params = self._scan_sql(app_id, channel_id, filter)
         try:
-            rows = self._conn.execute(
-                f"SELECT {_EVENT_COLUMNS} FROM {t}{where}{order}{limit}", tuple(params)
-            )
+            rows = self._conn.execute(sql, params)
         except sqlite3.OperationalError as err:
             if _is_no_table(err):
                 return iter(())
             raise
         return (_row_to_event(r) for r in rows)
+
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter = EventFilter(),
+        batch_size: int = base.Events.COLUMNAR_BATCH_SIZE,
+    ):
+        """Native path: ONE SQL scan streamed ``fetchmany`` -> columns.
+        Rows become arrays without ``_row_to_event`` — no Event object,
+        no properties/tags JSON parse (they stay the row's JSON text in
+        the lazy column), and the two timestamps parse vectorized (the
+        storage format is fixed-width UTC, ``_fmt_utc``). On a pool
+        without ``execute_stream`` (the PostgreSQL adapter's _PGPool,
+        storage/postgres.py reuses this DAO) the scan degrades to one
+        ``execute`` chunked in Python — same rows, same columns."""
+        from predictionio_tpu.core.columns import check_batch_size
+
+        check_batch_size(batch_size)
+        return self._find_columnar(app_id, channel_id, filter, batch_size)
+
+    def _find_columnar(self, app_id, channel_id, filter, batch_size):
+        sql, params = self._scan_sql(app_id, channel_id, filter)
+        stream = getattr(self._conn, "execute_stream", None)
+        if stream is not None and not getattr(self._conn, "can_stream", False):
+            stream = None   # single-connection pool: see can_stream
+        if stream is None:
+            try:
+                rows = self._conn.execute(sql, params)
+            except sqlite3.OperationalError as err:
+                if _is_no_table(err):
+                    return
+                raise
+            for at in range(0, len(rows), batch_size):
+                yield _rows_to_columns(rows[at:at + batch_size])
+            return
+        # bulk-scan plan hint (sqlite only — the PG adapter takes the
+        # branch above): for a whole-table training read the planner
+        # still picks the entity index off an entityType predicate and
+        # pays a random rowid lookup per row plus a temp B-tree sort
+        # (measured ~3x the sequential scan at 50k rows); NOT INDEXED
+        # forces the table scan. Applied when nothing marks the scan
+        # selective — no entity_id, no time bounds, no limit.
+        # entity_type alone deliberately does NOT disable the hint:
+        # a training scan always carries one (every event of a
+        # recommendation app is entityType='user', which is precisely
+        # the unselective predicate that baited the planner), at the
+        # accepted cost that a scan over a genuinely rare entity type
+        # also table-scans. Anything else keeps the planner's choice
+        # (the extended (…, eventTime, id) indexes serve time ranges
+        # and single-entity reads in index order, measured µs-to-ms).
+        if (filter.entity_id is None and filter.start_time is None
+                and filter.until_time is None and filter.limit is None):
+            t = event_table_name(app_id, channel_id)
+            sql = sql.replace(f"FROM {t} ", f"FROM {t} NOT INDEXED ", 1)
+        try:
+            for rows in stream(sql, params, arraysize=batch_size):
+                yield _rows_to_columns(rows)
+        except sqlite3.OperationalError as err:
+            if _is_no_table(err):
+                return
+            raise
 
 
 class SQLiteApps(base.Apps):

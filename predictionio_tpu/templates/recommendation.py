@@ -116,6 +116,38 @@ class DataSourceParams(Params):
     seed: int = 3
 
 
+def ratings_from_columns(cols, buy_rating: float):
+    """One EventColumns batch -> (users, items, ratings) arrays, or
+    None when nothing survives. The columnar rating rule, vectorized:
+    rows need a target entity (code compare against the batch's None
+    code), ``rate`` events take their properties' ``rating`` (rows
+    whose rating is missing/malformed are dropped — the row-path rule),
+    everything else is an implicit signal worth ``buy_rating``. Shared
+    by the DataSource and bench_ingest.py so the benchmark measures
+    exactly the code the train path runs."""
+    n = len(cols)
+    if n == 0:
+        return None
+    none_code = cols.target_entity_id.code_of(None)
+    keep = np.ones(n, dtype=bool)
+    if none_code is not None:
+        keep &= cols.target_entity_id.codes != none_code
+    ratings = np.full(n, buy_rating, dtype=np.float32)
+    rate_code = cols.event.code_of("rate")
+    if rate_code is not None:
+        for i in np.nonzero(keep & (cols.event.codes == rate_code))[0]:
+            try:
+                ratings[i] = float(cols.properties_raw(int(i)).get("rating"))
+            except (KeyError, TypeError, ValueError):
+                keep[i] = False
+    idx = np.nonzero(keep)[0]
+    if len(idx) == 0:
+        return None
+    return (cols.entity_id.decode()[idx],
+            cols.target_entity_id.decode()[idx],
+            ratings[idx])
+
+
 class RecommendationDataSource(DataSource):
     """Reads rate/buy events into rating triples.
 
@@ -128,30 +160,38 @@ class RecommendationDataSource(DataSource):
     params_class = DataSourceParams
 
     def _ratings(self, ctx) -> TrainingData:
+        """Columnar train read: EventStore.scan hands struct-of-arrays
+        batches (core/columns.py), and per batch the entity/target
+        columns land in the output arrays by vectorized code selection
+        — no per-event Python loop over Event objects. The only row
+        work left is the properties parse for ``rate`` events (the
+        rating value lives in the lazy JSON column), touched solely for
+        the rows that survive the mask."""
         p = self.params
-        users, items, ratings = [], [], []
-        for ev in ctx.event_store().find(
+        user_parts: list[np.ndarray] = []
+        item_parts: list[np.ndarray] = []
+        rating_parts: list[np.ndarray] = []
+        for cols in ctx.event_store().scan(
             p.app_name,
             entity_type=p.entity_type,
             event_names=list(p.event_names),
             target_entity_type=p.target_entity_type,
         ):
-            if ev.target_entity_id is None:
+            part = ratings_from_columns(cols, p.buy_rating)
+            if part is None:
                 continue
-            if ev.event == "rate":
-                try:
-                    rating = float(ev.properties.get("rating"))
-                except (KeyError, TypeError, ValueError):
-                    continue
-            else:  # buy and other implicit signals
-                rating = p.buy_rating
-            users.append(ev.entity_id)
-            items.append(ev.target_entity_id)
-            ratings.append(rating)
+            user_parts.append(part[0])
+            item_parts.append(part[1])
+            rating_parts.append(part[2])
+        if not user_parts:
+            empty = np.asarray([], dtype=object)
+            return TrainingData(
+                users=empty, items=empty.copy(),
+                ratings=np.asarray([], dtype=np.float32))
         return TrainingData(
-            users=np.asarray(users, dtype=object),
-            items=np.asarray(items, dtype=object),
-            ratings=np.asarray(ratings, dtype=np.float32),
+            users=np.concatenate(user_parts),
+            items=np.concatenate(item_parts),
+            ratings=np.concatenate(rating_parts),
         )
 
     def read_training(self, ctx) -> TrainingData:

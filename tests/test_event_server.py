@@ -170,6 +170,143 @@ def test_batch_events(server):
     assert "50" in body["message"]
 
 
+def test_batch_rides_single_insert_batch_call(server):
+    """The valid subset of a batch lands via ONE insert_batch call (a
+    single storage transaction), never per-event inserts."""
+    service = server.service
+    calls = {"insert": 0, "insert_batch": 0}
+    real_batch = service.events.insert_batch
+    real_insert = service.events.insert
+
+    def spy_batch(events, app_id, channel_id=None):
+        calls["insert_batch"] += 1
+        return real_batch(events, app_id, channel_id)
+
+    def spy_insert(event, app_id, channel_id=None):
+        calls["insert"] += 1
+        return real_insert(event, app_id, channel_id)
+
+    service.events.insert_batch = spy_batch
+    service.events.insert = spy_insert
+    try:
+        batch = [EVENT, {"event": "buy", "entityType": "user"},  # invalid
+                 {**EVENT, "entityId": "u2"}]
+        status, results = call(
+            server, "POST", "/batch/events.json?accessKey=testkey", batch)
+    finally:
+        service.events.insert_batch = real_batch
+        service.events.insert = real_insert
+    assert status == 200
+    assert [r["status"] for r in results] == [201, 400, 201]
+    assert calls == {"insert": 0, "insert_batch": 1}
+
+
+def test_batch_storage_failure_maps_per_event_500(server):
+    """When the batched call AND the per-event fallback both fail,
+    every pending event reports 500; invalid ones keep their own
+    statuses."""
+    service = server.service
+    real_batch = service.events.insert_batch
+    real_insert = service.events.insert
+
+    def boom(*a, **kw):
+        raise RuntimeError("disk on fire")
+
+    service.events.insert_batch = boom
+    service.events.insert = boom
+    try:
+        status, results = call(
+            server, "POST", "/batch/events.json?accessKey=testkey",
+            [EVENT, {"event": "x", "entityType": "user"},
+             {**EVENT, "entityId": "u2"}])
+    finally:
+        service.events.insert_batch = real_batch
+        service.events.insert = real_insert
+    assert status == 200
+    assert [r["status"] for r in results] == [500, 400, 500]
+    assert "disk on fire" in results[0]["message"]
+
+
+def test_batch_partial_failure_falls_back_per_event_idempotently(server):
+    """insert_batch failing mid-way (non-transactional backend shape)
+    falls back to per-event inserts with PRE-ASSIGNED event ids, so the
+    prefix the failed batch committed is overwritten, not duplicated,
+    and per-event statuses stay accurate."""
+    service = server.service
+    real_batch = service.events.insert_batch
+
+    def half_then_die(events, app_id, channel_id=None):
+        # commit a prefix the way the base per-event loop would, then die
+        real_batch(events[:1], app_id, channel_id)
+        raise RuntimeError("mid-batch crash")
+
+    service.events.insert_batch = half_then_die
+    try:
+        status, results = call(
+            server, "POST", "/batch/events.json?accessKey=testkey",
+            [EVENT, {**EVENT, "entityId": "u2"}])
+    finally:
+        service.events.insert_batch = real_batch
+    assert status == 200
+    assert [r["status"] for r in results] == [201, 201]
+    # the prefix event was written twice (batch then fallback) under the
+    # SAME id — exactly one copy per event exists
+    stored = list(service.events.find(
+        service.storage.get_meta_data_apps().get_by_name("testapp").id))
+    ids = [e.event_id for e in stored]
+    assert len(ids) == len(set(ids)) == 2
+    assert sorted(ids) == sorted(r["eventId"] for r in results)
+
+
+def test_max_batch_events_config_and_env(monkeypatch):
+    """max_batch_events: explicit config wins; PIO_EVENTSERVER_MAX_BATCH
+    sets the default; malformed env degrades to the reference 50."""
+    assert EventServerConfig().max_batch_events == 50
+    assert EventServerConfig(max_batch_events=3).max_batch_events == 3
+    monkeypatch.setenv("PIO_EVENTSERVER_MAX_BATCH", "200")
+    assert EventServerConfig().max_batch_events == 200
+    monkeypatch.setenv("PIO_EVENTSERVER_MAX_BATCH", "garbage")
+    assert EventServerConfig().max_batch_events == 50
+    monkeypatch.setenv("PIO_EVENTSERVER_MAX_BATCH", "-5")
+    assert EventServerConfig().max_batch_events == 50
+
+
+def test_max_batch_events_enforced_over_http():
+    storage = memory_storage()
+    app_id = storage.get_meta_data_apps().insert(App(0, "capapp"))
+    storage.get_meta_data_access_keys().insert(AccessKey("capkey", app_id, ()))
+    storage.get_events().init(app_id)
+    srv = EventServer(storage, EventServerConfig(
+        ip="127.0.0.1", port=0, max_batch_events=2))
+    srv.start()
+    try:
+        status, body = call(srv, "POST", "/batch/events.json?accessKey=capkey",
+                            [EVENT] * 3)
+        assert status == 400 and "2" in body["message"]
+        status, results = call(srv, "POST",
+                               "/batch/events.json?accessKey=capkey",
+                               [EVENT] * 2)
+        assert status == 200
+        assert [r["status"] for r in results] == [201, 201]
+    finally:
+        srv.stop()
+
+
+def test_stats_json_carries_ingest_counters(server):
+    call(server, "POST", "/events.json?accessKey=testkey", EVENT)
+    call(server, "POST", "/batch/events.json?accessKey=testkey",
+         [EVENT, {**EVENT, "entityId": "u2"}])
+    status, stats = call(server, "GET", "/stats.json?accessKey=testkey")
+    assert status == 200
+    ingest = stats["ingest"]
+    assert ingest["batches"] == 2
+    assert ingest["events"] == 3
+    assert ingest["batchSizeHistogram"] == {"1": 1, "2": 1}
+    assert ingest["meanBatchSize"] == 1.5
+    # EWMA needs two observations to have a rate
+    assert ingest["eventsPerSecEwma"] is None or ingest["eventsPerSecEwma"] > 0
+
+
 def test_stats(server):
     call(server, "POST", "/events.json?accessKey=testkey", EVENT)
     call(server, "POST", "/events.json?accessKey=testkey",

@@ -254,6 +254,136 @@ class TestEvents:
 
 
 # ---------------------------------------------------------------------------
+# Columnar/row equivalence (PR 4: the columnar data plane)
+# ---------------------------------------------------------------------------
+
+def _columnar_seed_events():
+    """A mixed batch exercising every column: targets present/absent,
+    properties/tags/prId, equal timestamps (tie order must match the
+    row path), sub-millisecond spacing, and multiple entity types."""
+    out = [
+        ev("rate", "u1", 0, target="i1", props={"rating": 4.5}),
+        ev("buy", "u2", 1, target="i2"),
+        ev("$set", "u1", 2, props={"a": 1, "nested": {"b": [1, 2]}}),
+        ev("rate", "u3", 2, target="i3", props={"rating": 1.0}),  # tie @2min
+        ev("view", "u1", 3, target="i9"),
+        Event(event="note", entity_type="doc", entity_id="d1",
+              properties=DataMap({"len": 7}), tags=("t1", "t2"),
+              pr_id="pr-9", event_time=T0 + timedelta(minutes=4)),
+        # sub-millisecond neighbors: ordering must agree with find()
+        Event(event="view", entity_type="user", entity_id="u9",
+              event_time=T0 + timedelta(minutes=5, microseconds=200)),
+        Event(event="view", entity_type="user", entity_id="u9",
+              event_time=T0 + timedelta(minutes=5, microseconds=900)),
+    ]
+    return out
+
+
+_COLUMNAR_FILTERS = [
+    EventFilter(),
+    EventFilter(event_names=["rate", "buy"]),
+    EventFilter(event_names=[]),                      # match nothing
+    EventFilter(entity_type="user"),
+    EventFilter(entity_type="user", entity_id="u1"),
+    EventFilter(target_entity_type=None),             # target must be absent
+    EventFilter(target_entity_type="item"),
+    EventFilter(target_entity_id="i2"),
+    EventFilter(start_time=T0 + timedelta(minutes=1),
+                until_time=T0 + timedelta(minutes=4)),
+    EventFilter(limit=3),
+    EventFilter(limit=0),
+    EventFilter(entity_type="user", entity_id="u1", reversed=True, limit=2),
+    EventFilter(reversed=True),
+]
+
+
+def _assert_columnar_matches_rows(events_dao, app_id=1, batch_size=3):
+    """For every filter: concatenated find_columnar batches materialize
+    to EXACTLY the find() sequence (order, ties, limit cuts)."""
+    for flt in _COLUMNAR_FILTERS:
+        rows = list(events_dao.find(app_id, None, flt))
+        got = []
+        for batch in events_dao.find_columnar(app_id, None, flt,
+                                              batch_size=batch_size):
+            assert len(batch) <= batch_size
+            assert len(batch.event_time_us) == len(batch.event_ids)
+            got.extend(batch.to_events())
+        assert got == rows, f"filter {flt} diverged"
+
+
+class TestColumnarRowEquivalence:
+    """find_columnar must round-trip to the exact event sequence find
+    returns — for every backend, both the native fast paths and the
+    generic rows->columns fallback (ISSUE 4 conformance gate)."""
+
+    def test_native_path_matches_rows(self, events_client):
+        events = events_client.events()
+        events.init(1)
+        events.insert_batch(_columnar_seed_events(), 1)
+        _assert_columnar_matches_rows(events)
+
+    def test_generic_fallback_matches_rows(self, events_client):
+        """Force the base-class fallback (unbound call) even on backends
+        that override find_columnar: the inherited path must stay
+        correct for third-party backends that never override it."""
+        from predictionio_tpu.storage import base as storage_base
+
+        events = events_client.events()
+        events.init(1)
+        events.insert_batch(_columnar_seed_events(), 1)
+        for flt in _COLUMNAR_FILTERS:
+            rows = list(events.find(1, None, flt))
+            got = [
+                e
+                for batch in storage_base.Events.find_columnar(
+                    events, 1, None, flt, batch_size=2)
+                for e in batch.to_events()
+            ]
+            assert got == rows, f"fallback filter {flt} diverged"
+
+    def test_empty_table_yields_no_batches(self, events_client):
+        events = events_client.events()
+        events.init(1)
+        assert list(events.find_columnar(1)) == []
+
+    def test_batch_size_must_be_positive(self, events_client):
+        events = events_client.events()
+        events.init(1)
+        events.insert(ev(), 1)
+        with pytest.raises(ValueError):
+            list(events.find_columnar(1, batch_size=0))
+
+    def test_lazy_properties_decode_per_row(self, events_client):
+        """The cold columns decode on demand and match the row path."""
+        events = events_client.events()
+        events.init(1)
+        events.insert_batch(_columnar_seed_events(), 1)
+        flt = EventFilter(event_names=["rate"])
+        rows = list(events.find(1, None, flt))
+        (batch,) = list(events.find_columnar(1, None, flt, batch_size=100))
+        for i, e in enumerate(rows):
+            assert batch.properties(i).fields == e.properties.fields
+        # hot columns decode vectorized
+        assert list(batch.entity_id.decode()) == [e.entity_id for e in rows]
+        assert list(batch.event.decode()) == [e.event for e in rows]
+
+    @pytest.mark.chaos
+    def test_chaos_backend_columnar_conformance(self):
+        """The chaos-wrapped DAO (fault injection + resilience above a
+        memory inner store) must pass the same equivalence suite — the
+        injected faults are absorbed by the retry layer and the batches
+        still match the row path exactly."""
+        from predictionio_tpu.storage.chaos import ChaosStorageClient
+
+        inner = MemoryStorageClient()
+        client = ChaosStorageClient.wrap(inner, fault_rate=0.3, seed=7)
+        events = client.events()
+        events.init(1)
+        events.insert_batch(_columnar_seed_events(), 1)
+        _assert_columnar_matches_rows(events)
+
+
+# ---------------------------------------------------------------------------
 # Metadata DAOs
 # ---------------------------------------------------------------------------
 
