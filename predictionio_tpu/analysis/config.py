@@ -88,10 +88,12 @@ def path_matches(relpath: str, prefixes: tuple[str, ...]) -> bool:
 COMPUTE_PATHS = ("ops/", "models/", "e2/")
 
 #: request-serving hot path: handler threads, the deployed query path,
-#: the batching/cache subsystem (serving/ — PR 3), and the columnar
+#: the batching/cache subsystem (serving/ — PR 3), the columnar
 #: data plane's scan/view consumers (data/ — PR 4): a host sync inside
-#: the train-read loop would serialize every batch
-HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/")
+#: the train-read loop would serialize every batch, and the
+#: observability plane (obs/ — PR 5), which runs INSIDE every request
+#: and must never block on the device
+HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/", "obs/")
 
 
 def default_config() -> LintConfig:
@@ -99,13 +101,15 @@ def default_config() -> LintConfig:
     return LintConfig(
         rules={
             "resilience-bypass": RuleConfig(
-                # serving/, data/ and the event server's ingest path
-                # carry the strictest policy (no guard-table entries):
-                # any raw network call there is a violation — the
-                # columnar scan and batch-ingest paths must reach
+                # serving/, data/, obs/ and the event server's ingest
+                # path carry the strictest policy (no guard-table
+                # entries): any raw network call there is a violation —
+                # the columnar scan and batch-ingest paths must reach
                 # remote backends only through the DAO layer's
-                # resilient() wrappers
-                paths=("storage/", "serving/", "data/",
+                # resilient() wrappers, and the observability plane
+                # must never do network I/O of its own (scrapers pull;
+                # the plane never pushes)
+                paths=("storage/", "serving/", "data/", "obs/",
                        "api/event_server.py"),
                 options={
                     # raw-network callables we police

@@ -62,12 +62,38 @@ from http.server import BaseHTTPRequestHandler
 from typing import Any, Mapping
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.api.http_base import RestServer, bounded_probe
+from predictionio_tpu.api.http_base import (
+    REQUEST_ID_HEADER,
+    PlainTextPayload,
+    RestServer,
+    access_log_enabled,
+    bounded_probe,
+    emit_access_log,
+    ensure_access_log_handler,
+    resolve_request_id,
+)
 from predictionio_tpu.api.stats import ServingStats, resilience_snapshot
 from predictionio_tpu.core.json_codec import (
     canonical_json,
     compile_wire_decoder,
     encode_wire,
+)
+from predictionio_tpu.obs.exporter import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from predictionio_tpu.obs.exporter import render_prometheus
+from predictionio_tpu.obs.registry import (
+    HistogramFamily,
+    MetricRegistry,
+    resilience_collector,
+    server_info_collector,
+    serving_collector,
+)
+from predictionio_tpu.obs.trace import (
+    TraceLog,
+    active_trace,
+    span,
+    start_trace,
+    tracing_default,
+    use_trace,
 )
 from predictionio_tpu.serving.batch_policy import make_batch_policy
 from predictionio_tpu.serving.result_cache import ResultCache
@@ -251,6 +277,25 @@ class EngineService:
         self._query_decoder = (
             compile_wire_decoder(qc)
             if (qc := deployed.query_class) is not None else None)
+        #: observability plane (docs/observability.md): per-request
+        #: tracing (opt-in; config wins, else PIO_TRACE), structured
+        #: access logs (config wins, else PIO_ACCESS_LOG), and the
+        #: per-server metric registry GET /metrics renders
+        self.tracing = (config.tracing if config.tracing is not None
+                        else tracing_default())
+        self.access_log = access_log_enabled(config.access_log)
+        if self.access_log:
+            ensure_access_log_handler()
+        self.trace_log = TraceLog()
+        self.request_latency = HistogramFamily(
+            "pio_http_request_seconds",
+            "HTTP request walltime by route (handler-measured)",
+            "route", ("queries", "stats", "metrics", "status"))
+        self.registry = MetricRegistry()
+        self.registry.register(self.request_latency.collect)
+        self.registry.register(serving_collector(self.serving_stats))
+        self.registry.register(resilience_collector())
+        self.registry.register(server_info_collector("engine"))
         #: deadline enforcement for the NON-batched path: the query runs
         #: on a pool thread so a blown budget returns 503 instead of
         #: holding the socket (threads spawn lazily; idle pool is free)
@@ -286,6 +331,15 @@ class EngineService:
                 return (200, self.plugins.describe())
             if method == "GET" and path == "/stats.json":
                 return (200, self.stats_doc())
+            if method == "GET" and path == "/metrics":
+                # Prometheus exposition: serving counters + latency
+                # histograms + resilience state (docs/observability.md)
+                return (200, PlainTextPayload(
+                    render_prometheus(self.registry),
+                    PROMETHEUS_CONTENT_TYPE))
+            if method == "GET" and path == "/traces.json":
+                return (200, {"tracing": self.tracing,
+                              "traces": self.trace_log.snapshot()})
             if method == "GET" and path == "/healthz":
                 # liveness: the process answers; nothing else implied
                 return (200, {"status": "ok"})
@@ -325,6 +379,19 @@ class EngineService:
         except Exception as e:
             logger.exception("unhandled error in %s %s", method, path)
             return (500, {"message": f"internal error: {e}"})
+
+    _ROUTE_LABELS = {
+        "/queries.json": "queries",
+        "/stats.json": "stats",
+        "/metrics": "metrics",
+        "/": "status",
+    }
+
+    def observe_request(self, path: str, dt: float) -> None:
+        """Handler-measured request walltime into the per-route
+        latency family (unknown paths fold into ``other``)."""
+        self.request_latency.observe(
+            self._ROUTE_LABELS.get(path, "other"), dt)
 
     def readyz(self) -> tuple:
         """Readiness: a deployed model AND reachable storage. 503 (with
@@ -462,7 +529,10 @@ class EngineService:
         pr_id_in = body.pop("prId", None)
         decoder = self._query_decoder
         try:
-            query = decoder(body) if decoder is not None else body
+            # span() is the ambient-trace helper: a shared no-op when
+            # the handler started no trace (the near-free disabled path)
+            with span("bind"):
+                query = decoder(body) if decoder is not None else body
         except (ValueError, TypeError) as e:
             raise _Reject(400, f"invalid query: {e}")
 
@@ -472,13 +542,15 @@ class EngineService:
         # the BOUND query's wire form, not the raw body, so camelCase
         # and snake_case spellings of the same query share an entry
         # (the ResultCache contract)
-        key = (canonical_json(encode_wire(query))
-               if (self.cache is not None or self.batcher is not None)
-               else None)
+        with span("codec_key"):
+            key = (canonical_json(encode_wire(query))
+                   if (self.cache is not None or self.batcher is not None)
+                   else None)
         hit, generation = False, None
         if self.cache is not None:
             t0 = time.perf_counter()
-            hit, cached, generation = self.cache.lookup(key)
+            with span("cache_lookup"):
+                hit, cached, generation = self.cache.lookup(key)
         if hit:
             prediction = cached
             # a hit IS an answered query: requestCount / serving-time
@@ -489,14 +561,23 @@ class EngineService:
                 with deadline_scope(budget) if budget is not None \
                         else contextlib.nullcontext():
                     if self.batcher is not None:
+                        # the ambient trace rides the queue entry: the
+                        # dispatcher thread records queue-wait and
+                        # device-dispatch spans onto it (batcher.py)
                         prediction = self.batcher.submit(
                             query,
                             timeout=budget if budget is not None else 300.0,
-                            key=key)
+                            key=key, trace=active_trace())
                     elif budget is not None:
-                        prediction = self._query_with_deadline(query, budget)
+                        # _query_with_deadline copies this request's
+                        # contextvars, so the ambient trace follows
+                        # onto the pool thread by construction
+                        with span("predict"):
+                            prediction = self._query_with_deadline(
+                                query, budget)
                     else:
-                        prediction = self.deployed.query(query)
+                        with span("predict"):
+                            prediction = self.deployed.query(query)
             except QueryDeadlineExceeded as e:
                 # a blown deadline is overload/degradation, not an
                 # application error: 503 so the client retries later
@@ -528,7 +609,8 @@ class EngineService:
             raise _Reject(403, f"prediction rejected: {e}")
         self.plugins.notify_sniffers(info)
 
-        response = encode_wire(prediction)
+        with span("encode"):
+            response = encode_wire(prediction)
         if not isinstance(response, dict):
             response = {"result": response}
         if self.config.feedback:
@@ -645,7 +727,33 @@ class _Handler(BaseHTTPRequestHandler):
         return {k: v[0] for k, v in parse_qs(urlparse(self.path).query).items()}
 
     def _dispatch(self, method: str) -> None:
+        """Observability envelope around the real dispatch: request-id
+        resolution (echoed by _respond), optional trace creation for
+        the query hot path, handler-measured route latency, and the
+        structured access log (all docs/observability.md)."""
+        t_start = time.perf_counter()
         path = urlparse(self.path).path
+        self._request_id = resolve_request_id(self.headers)
+        self._last_status = 0
+        self._trace = (
+            start_trace("queries.json", request_id=self._request_id)
+            if (method == "POST" and path == "/queries.json"
+                and self.service.tracing)
+            else None)
+        try:
+            self._dispatch_inner(method, path)
+        finally:
+            dt = time.perf_counter() - t_start
+            self.service.observe_request(path, dt)
+            if self._trace is not None:
+                self._trace.finish(status=self._last_status)
+                self.service.trace_log.record(self._trace)
+            if self.service.access_log:
+                emit_access_log(
+                    "engine", method, path, self._last_status, dt,
+                    self._request_id, client=self.address_string())
+
+    def _dispatch_inner(self, method: str, path: str) -> None:
         body: Any = None
         if self.headers.get("Transfer-Encoding"):
             # chunked bodies are not decoded here; on a keep-alive
@@ -675,28 +783,48 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b""
         if method == "POST" and raw:
             try:
-                body = json.loads(raw)
+                if self._trace is not None:
+                    with self._trace.span("parse"):
+                        body = json.loads(raw)
+                else:
+                    body = json.loads(raw)
             except json.JSONDecodeError:
                 self._respond(400, {"message": "the request body is not valid JSON"})
                 return
         # header names are case-insensitive (RFC 9110); normalise once
         headers = {k.lower(): v for k, v in self.headers.items()}
-        result = self.service.handle(
-            method, path, self._params(), headers, body
-        )
+        if self._trace is not None:
+            # ambient binding: spans opened anywhere under handle()
+            # (bind, cache lookup, predict, encode) land on this trace
+            with use_trace(self._trace):
+                result = self.service.handle(
+                    method, path, self._params(), headers, body)
+        else:
+            result = self.service.handle(
+                method, path, self._params(), headers, body)
         self._respond(*result)
 
     def _respond(self, status: int, payload: Any,
                  extra_headers: Mapping[str, str] | None = None) -> None:
+        self._last_status = status
         if isinstance(payload, _HtmlPage):
             data = str(payload).encode()
             ctype = "text/html; charset=UTF-8"
+        elif isinstance(payload, PlainTextPayload):
+            data = str(payload).encode()
+            ctype = payload.content_type
         else:
             data = json.dumps(payload).encode()
             ctype = "application/json; charset=UTF-8"
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        # every response carries the correlation id (inbound
+        # X-PIO-Request-Id propagated, else minted — http_base)
+        if getattr(self, "_request_id", None):
+            self.send_header(REQUEST_ID_HEADER, self._request_id)
+        if getattr(self, "_trace", None) is not None:
+            self.send_header("X-PIO-Trace-Id", self._trace.trace_id)
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
         self.end_headers()

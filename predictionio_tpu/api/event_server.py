@@ -39,11 +39,21 @@ import logging
 import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler
 from typing import Any, Mapping
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.api.http_base import RestServer, bounded_probe
+from predictionio_tpu.api.http_base import (
+    REQUEST_ID_HEADER,
+    PlainTextPayload,
+    RestServer,
+    access_log_enabled,
+    bounded_probe,
+    emit_access_log,
+    ensure_access_log_handler,
+    resolve_request_id,
+)
 from predictionio_tpu.api.plugins import EventInfo, EventServerPluginContext
 from predictionio_tpu.api.stats import IngestStats, StatsKeeper, resilience_snapshot
 from predictionio_tpu.api.webhooks import (
@@ -57,6 +67,22 @@ from predictionio_tpu.core.json_codec import (
     event_from_json,
     event_to_json,
     parse_datetime,
+)
+from predictionio_tpu.obs.exporter import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from predictionio_tpu.obs.exporter import render_prometheus
+from predictionio_tpu.obs.registry import (
+    HistogramFamily,
+    MetricRegistry,
+    ingest_collector,
+    resilience_collector,
+    server_info_collector,
+)
+from predictionio_tpu.obs.trace import (
+    TraceLog,
+    span,
+    start_trace,
+    tracing_default,
+    use_trace,
 )
 from predictionio_tpu.storage.base import EventFilter
 from predictionio_tpu.storage.registry import Storage
@@ -106,6 +132,12 @@ class EventServerConfig:
     #: overridable per deployment via ``PIO_EVENTSERVER_MAX_BATCH``
     max_batch_events: int = dataclasses.field(
         default_factory=_default_max_batch)
+    #: observability plane (docs/observability.md): per-request spans
+    #: on the ingest hot paths (None defers to PIO_TRACE at server
+    #: construction) and structured JSON access logs (None defers to
+    #: PIO_ACCESS_LOG)
+    tracing: bool | None = None
+    access_log: bool | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,10 +174,28 @@ class EventService:
         self.channels = self.storage.get_meta_data_channels()
         self.plugin_context = plugin_context or EventServerPluginContext()
         self.stats = StatsKeeper() if config.stats else None
-        #: ingest-path counters (batch sizes, events/sec EWMA) — always
-        #: kept (O(1) per batch under one lock, the ServingStats
-        #: discipline); surfaced via GET /stats.json when --stats is on
+        #: ingest-path counters (batch sizes, events/sec EWMA +
+        #: windowed rate) — always kept (O(1) per batch under one lock,
+        #: the ServingStats discipline); surfaced via GET /stats.json
+        #: when --stats is on and GET /metrics always
         self.ingest_stats = IngestStats()
+        #: observability plane (docs/observability.md)
+        self.tracing = (config.tracing if config.tracing is not None
+                        else tracing_default())
+        self.access_log = access_log_enabled(config.access_log)
+        if self.access_log:
+            ensure_access_log_handler()
+        self.trace_log = TraceLog()
+        self.request_latency = HistogramFamily(
+            "pio_http_request_seconds",
+            "HTTP request walltime by route (handler-measured)",
+            "route", ("events_post", "events_get", "batch", "webhooks",
+                      "stats", "metrics"))
+        self.registry = MetricRegistry()
+        self.registry.register(self.request_latency.collect)
+        self.registry.register(ingest_collector(self.ingest_stats))
+        self.registry.register(resilience_collector())
+        self.registry.register(server_info_collector("event"))
 
     # -- auth (EventServer.scala:92-131) ------------------------------------
     def authenticate(
@@ -212,7 +262,10 @@ class EventService:
         if not isinstance(body, Mapping):
             return 400, {"message": "request body must be a JSON object"}
         try:
-            event = event_from_json(body)
+            # span() records against the handler's ambient trace and is
+            # a shared no-op when tracing is off (obs/trace.py)
+            with span("validate"):
+                event = event_from_json(body)
         except EventValidationError as exc:
             return 400, {"message": str(exc)}
         if auth.events and event.event not in auth.events:
@@ -223,7 +276,10 @@ class EventService:
             )
         except Exception as exc:
             return 403, {"message": str(exc)}
-        event_id = self.events.insert(event, auth.app_id, auth.channel_id)
+        t0 = time.perf_counter()
+        with span("insert"):
+            event_id = self.events.insert(event, auth.app_id, auth.channel_id)
+        self.ingest_stats.insert_latency.observe(time.perf_counter() - t0)
         self.plugin_context.notify_sniffers(
             EventInfo(auth.app_id, auth.channel_id, event)
         )
@@ -315,28 +371,30 @@ class EventService:
             }
         results: list[dict[str, Any] | None] = [None] * len(body)
         pending: list[tuple[int, Any]] = []   # (original position, Event)
-        for pos, item in enumerate(body):
-            try:
-                if not isinstance(item, Mapping):
-                    raise EventValidationError("event must be a JSON object")
-                event = event_from_json(item)
-            except EventValidationError as exc:
-                results[pos] = {"status": 400, "message": str(exc)}
-                continue
-            if auth.events and event.event not in auth.events:
-                results[pos] = {
-                    "status": 403,
-                    "message": f"{event.event} events are not allowed",
-                }
-                continue
-            try:
-                self.plugin_context.run_blockers(
-                    EventInfo(auth.app_id, auth.channel_id, event)
-                )
-            except Exception as exc:
-                results[pos] = {"status": 403, "message": str(exc)}
-                continue
-            pending.append((pos, event))
+        with span("validate"):
+            for pos, item in enumerate(body):
+                try:
+                    if not isinstance(item, Mapping):
+                        raise EventValidationError(
+                            "event must be a JSON object")
+                    event = event_from_json(item)
+                except EventValidationError as exc:
+                    results[pos] = {"status": 400, "message": str(exc)}
+                    continue
+                if auth.events and event.event not in auth.events:
+                    results[pos] = {
+                        "status": 403,
+                        "message": f"{event.event} events are not allowed",
+                    }
+                    continue
+                try:
+                    self.plugin_context.run_blockers(
+                        EventInfo(auth.app_id, auth.channel_id, event)
+                    )
+                except Exception as exc:
+                    results[pos] = {"status": 403, "message": str(exc)}
+                    continue
+                pending.append((pos, event))
         if pending:
             # pre-assign event ids so the per-event fallback below is
             # IDEMPOTENT: every backend honors a caller-set event_id
@@ -351,8 +409,12 @@ class EventService:
             ]
             events = [e for _, e in pending]
             try:
-                ids = self.events.insert_batch(
-                    events, auth.app_id, auth.channel_id)
+                t0 = time.perf_counter()
+                with span("insert_batch"):
+                    ids = self.events.insert_batch(
+                        events, auth.app_id, auth.channel_id)
+                self.ingest_stats.insert_latency.observe(
+                    time.perf_counter() - t0)
                 if len(ids) != len(events):
                     # a backend returning a short id list is a partial
                     # failure in disguise — zip would silently leave
@@ -471,6 +533,24 @@ class EventService:
     _WEBHOOK_JSON = re.compile(r"^/webhooks/(?P<site>[^/.]+)\.json$")
     _WEBHOOK_FORM = re.compile(r"^/webhooks/(?P<site>[^/.]+)\.form$")
 
+    def route_label(self, method: str, path: str) -> str:
+        """Low-cardinality route label for the request-latency family
+        (unknown paths fold into ``other`` at observe time)."""
+        if path == "/events.json":
+            return "events_post" if method == "POST" else "events_get"
+        if path == "/batch/events.json":
+            return "batch"
+        if path.startswith("/webhooks/"):
+            return "webhooks"
+        if path == "/stats.json":
+            return "stats"
+        if path == "/metrics":
+            return "metrics"
+        return "other"
+
+    def observe_request(self, method: str, path: str, dt: float) -> None:
+        self.request_latency.observe(self.route_label(method, path), dt)
+
     def handle(
         self,
         method: str,
@@ -489,6 +569,20 @@ class EventService:
                 return self.readyz()
             if path == "/plugins.json" and method == "GET":
                 return self.plugins_json()
+            if path == "/metrics" and method == "GET":
+                # Prometheus exposition (docs/observability.md):
+                # aggregate counters only, no per-app data — served
+                # without an accessKey so a scraper needs no credential
+                return 200, PlainTextPayload(
+                    render_prometheus(self.registry),
+                    PROMETHEUS_CONTENT_TYPE)
+            if path == "/traces.json" and method == "GET":
+                # UNLIKE /metrics this carries per-request data
+                # (request ids, paths, timings) — it sits behind the
+                # same accessKey auth as every event route
+                self.authenticate(params, headers)
+                return 200, {"tracing": self.tracing,
+                             "traces": self.trace_log.snapshot()}
             if path == "/events.json":
                 if method == "POST":
                     return self.post_event(params, headers, body)
@@ -558,24 +652,78 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _respond(self, status: int, payload: Any,
                  extra_headers: Mapping[str, str] | None = None) -> None:
-        data = json.dumps(payload).encode()
+        self._last_status = status
+        if isinstance(payload, PlainTextPayload):
+            data = str(payload).encode()
+            ctype = payload.content_type
+        else:
+            data = json.dumps(payload).encode()
+            ctype = "application/json; charset=UTF-8"
         self.send_response(status)
-        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        # every response carries the correlation id (inbound
+        # X-PIO-Request-Id propagated, else minted — http_base)
+        if getattr(self, "_request_id", None):
+            self.send_header(REQUEST_ID_HEADER, self._request_id)
+        if getattr(self, "_trace", None) is not None:
+            self.send_header("X-PIO-Trace-Id", self._trace.trace_id)
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
+    #: ingest hot paths that get a trace when tracing is on
+    _TRACED_PATHS = ("/events.json", "/batch/events.json")
+
     def _dispatch(self, method: str) -> None:
+        """Observability envelope (mirrors the engine server handler):
+        request-id resolution, optional ingest-path traces, per-route
+        latency, structured access log (docs/observability.md)."""
+        t_start = time.perf_counter()
         path = urlparse(self.path).path
-        body = self._body() if method in ("POST", "PUT") else None
+        self._request_id = resolve_request_id(self.headers)
+        self._last_status = 0
+        self._trace = (
+            start_trace(path.lstrip("/"), request_id=self._request_id)
+            if (method == "POST" and path in self._TRACED_PATHS
+                and self.service.tracing)
+            else None)
+        try:
+            self._dispatch_inner(method, path)
+        finally:
+            dt = time.perf_counter() - t_start
+            self.service.observe_request(method, path, dt)
+            if self._trace is not None:
+                self._trace.finish(status=self._last_status)
+                self.service.trace_log.record(self._trace)
+            if self.service.access_log:
+                emit_access_log(
+                    "event", method, path, self._last_status, dt,
+                    self._request_id, client=self.address_string())
+
+    def _dispatch_inner(self, method: str, path: str) -> None:
+        if method in ("POST", "PUT"):
+            if self._trace is not None:
+                with self._trace.span("parse"):
+                    body = self._body()
+            else:
+                body = self._body()
+        else:
+            body = None
         if body is _MALFORMED:
             self._respond(400, {"message": "the request body is not valid JSON"})
             return
-        result = self.service.handle(
-            method, path, self._params(), dict(self.headers.items()), body
-        )
+        if self._trace is not None:
+            # ambient binding: validate/insert spans opened inside the
+            # service land on this trace (obs/trace.py)
+            with use_trace(self._trace):
+                result = self.service.handle(
+                    method, path, self._params(),
+                    dict(self.headers.items()), body)
+        else:
+            result = self.service.handle(
+                method, path, self._params(), dict(self.headers.items()), body)
         self._respond(*result)
 
     def do_GET(self) -> None:  # noqa: N802

@@ -5,21 +5,138 @@ dashboard :9000, admin API :7071) all ran on spray/Akka HTTP; here they
 share one stdlib scaffold: a handler class bound to a transport-free
 service object, optional TLS (utils/ssl_config), ephemeral-port support,
 background-thread or blocking serve, and clean shutdown.
+
+Observability plumbing shared by every handler (docs/observability.md):
+
+- **request ids** — :func:`resolve_request_id` accepts an inbound
+  ``X-PIO-Request-Id`` (sanitized: a hostile header must not inject
+  into logs) or mints one; every response echoes it, so a client, a
+  proxy log, and this server's access log correlate one request;
+- **structured access logs** — :func:`emit_access_log` writes one JSON
+  object per request (method, path, status, latency_ms, request_id) on
+  the ``pio.access`` logger, gated by :func:`access_log_enabled`
+  (``PIO_ACCESS_LOG`` env / per-server ``--access-log`` flag) — the
+  replacement for the blanket ``log_message`` suppression the handlers
+  used to ship;
+- **plain-text payloads** — :class:`PlainTextPayload` marks a response
+  body (the Prometheus ``/metrics`` text) that must not be
+  JSON-encoded.
 """
 
 from __future__ import annotations
 
+import itertools
+import json
 import logging
+import os
 import random
+import re
 import sys
 import threading
 import time
+import uuid
 from http.server import ThreadingHTTPServer
+from typing import Mapping
 
 from predictionio_tpu.utils.resilience import RetryPolicy
 from predictionio_tpu.utils.ssl_config import maybe_enable_ssl
 
 logger = logging.getLogger(__name__)
+
+#: dedicated access-log stream: operators route it separately from the
+#: framework's diagnostic logging (a JSON-lines file, a sidecar, ...)
+access_logger = logging.getLogger("pio.access")
+
+REQUEST_ID_HEADER = "X-PIO-Request-Id"
+
+#: inbound request ids are propagated only when they look like ids —
+#: anything else (spaces, quotes, control bytes, unbounded length) is
+#: replaced, never logged verbatim
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
+
+#: minted request ids are a per-process random prefix + a sequence —
+#: the same uniqueness story as uuid4 for correlation purposes without
+#: an os.urandom read (a getrandom syscall) on EVERY request, the same
+#: reasoning as obs/trace.py's trace ids. itertools.count is a single
+#: C call, safe under the GIL.
+_REQUEST_ID_PREFIX = uuid.uuid4().hex[:8]
+_REQUEST_ID_SEQ = itertools.count(1)
+
+
+class PlainTextPayload(str):
+    """Marker: respond with this body as ``text/plain`` (optionally a
+    specific content type), not JSON — the ``GET /metrics`` path."""
+
+    content_type = "text/plain; charset=utf-8"
+
+    def __new__(cls, body: str, content_type: str | None = None):
+        self = super().__new__(cls, body)
+        if content_type is not None:
+            self.content_type = content_type
+        return self
+
+
+def resolve_request_id(headers: Mapping[str, str]) -> str:
+    """The request's correlation id: a well-formed inbound
+    ``X-PIO-Request-Id`` wins (callers correlate across services),
+    otherwise a fresh one is minted. ``headers`` may be an
+    ``email.Message`` (case-insensitive get) or a plain lowercased
+    dict — both header spellings are tried."""
+    raw = headers.get(REQUEST_ID_HEADER) or headers.get("x-pio-request-id")
+    if raw and _REQUEST_ID_RE.match(raw):
+        return raw
+    return f"{_REQUEST_ID_PREFIX}{next(_REQUEST_ID_SEQ):08x}"
+
+
+def access_log_enabled(override: bool | None = None) -> bool:
+    """Per-server config wins when set; otherwise the ``PIO_ACCESS_LOG``
+    env var decides (read at call time — server construction — never
+    frozen at import)."""
+    if override is not None:
+        return override
+    return os.environ.get("PIO_ACCESS_LOG", "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def ensure_access_log_handler() -> None:
+    """Make an enabled access log actually emit: the flag was set, so
+    INFO must flow regardless of the root logger's level (a root at
+    WARNING would otherwise silently drop every line), and when
+    nothing has configured ``pio.access`` (no handlers anywhere up its
+    tree) it gets a stderr JSON-lines handler. Deployments that
+    configured logging themselves keep their handlers."""
+    access_logger.setLevel(logging.INFO)
+    lg = access_logger
+    while lg is not None:
+        if lg.handlers:
+            return
+        if not lg.propagate:
+            break
+        lg = lg.parent
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    access_logger.addHandler(handler)
+    access_logger.propagate = False
+
+
+def emit_access_log(server: str, method: str, path: str, status: int,
+                    latency_s: float, request_id: str,
+                    client: str | None = None, **extra) -> None:
+    """One structured JSON access-log line. Key order is stable
+    (method, path, status first) so the lines grep cleanly."""
+    record = {
+        "ts": round(time.time(), 3),
+        "server": server,
+        "method": method,
+        "path": path,
+        "status": status,
+        "latency_ms": round(latency_s * 1e3, 3),
+        "request_id": request_id,
+    }
+    if client:
+        record["client"] = client
+    record.update(extra)
+    access_logger.info("%s", json.dumps(record))
 
 
 class _PioHTTPServer(ThreadingHTTPServer):

@@ -24,6 +24,7 @@ from datetime import datetime, timezone
 from predictionio_tpu.core.event import Event
 from predictionio_tpu.core.json_codec import format_datetime
 from predictionio_tpu.core.wire import snake_to_camel
+from predictionio_tpu.obs.histogram import LatencyHistogram
 
 
 def resilience_snapshot() -> dict:
@@ -54,10 +55,25 @@ class ServingStats:
         self._counts = dict.fromkeys(self.COUNTER_FIELDS, 0)
         #: dispatched (post-dedup) batch size -> count
         self._batch_hist: Counter[int] = Counter()
+        #: latency attribution (obs/histogram.py; each histogram owns
+        #: its own lock): queue component vs device component of the
+        #: batched serving path — the Clipper-style split GET /metrics
+        #: and /traces.json surface (docs/observability.md)
+        self.queue_wait = LatencyHistogram()
+        self.device_time = LatencyHistogram()
 
     def bump(self, field: str, n: int = 1) -> None:
         with self._lock:
             self._counts[field] += n
+
+    def observe_queue_waits(self, waits) -> None:
+        """Per-entry enqueue→dispatch waits for one batch (one lock
+        acquisition for the whole batch)."""
+        self.queue_wait.observe_many(waits)
+
+    def observe_device_time(self, dt: float) -> None:
+        """One batch's query_batch walltime."""
+        self.device_time.observe(dt)
 
     def record_batch(self, dispatched: int, coalesced: int) -> None:
         """One device dispatch: ``dispatched`` unique queries actually
@@ -73,6 +89,17 @@ class ServingStats:
         with self._lock:
             return self._counts[field]
 
+    def raw_counts(self) -> dict[str, int]:
+        """All counters under ONE lock acquisition (snake_case keys) —
+        the metric-registry adapter's read (obs/registry.py)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def batch_histogram(self) -> dict[int, int]:
+        """Dispatched batch-size -> count, read under the lock."""
+        with self._lock:
+            return dict(self._batch_hist)
+
     def snapshot(self) -> dict:
         with self._lock:
             counts = dict(self._counts)
@@ -83,6 +110,8 @@ class ServingStats:
             **{snake_to_camel(k): v for k, v in counts.items()},
             "batchSizeHistogram": hist,
             "cacheHitRatio": round(hits / looked, 4) if looked else None,
+            "queueWait": self.queue_wait.snapshot().summary_ms(),
+            "deviceDispatch": self.device_time.snapshot().summary_ms(),
         }
 
 
@@ -97,7 +126,12 @@ class IngestStats:
     (batch size / time since the previous batch) with EWMA_ALPHA.
     Caveat (bench discipline): under a closed-loop load generator the
     EWMA tracks the generator's issue rate, not server capacity — treat
-    it as an observability signal, not a benchmark number."""
+    it as an observability signal, not a benchmark number. The
+    windowed rate below does NOT share that bias: a ring of per-second
+    monotonic buckets counts what actually landed each wall second, so
+    ``eventsPerSecWindowed`` is a true recent-throughput number
+    (complete seconds only — the current partial second is excluded so
+    a mid-second read never underreports)."""
 
     EWMA_ALPHA = 0.2
     #: SKIP (not clamp) the EWMA update for gaps below this: two
@@ -105,6 +139,9 @@ class IngestStats:
     #: divide by ~zero and fold a meaningless multi-million-events/sec
     #: spike into the average
     _MIN_DT = 1e-6
+    #: per-second ring span: the windowed rate covers up to this many
+    #: complete seconds (Prometheus-style "last minute" semantics)
+    WINDOW_SECONDS = 60
 
     def __init__(self, clock=None):
         import time
@@ -117,6 +154,15 @@ class IngestStats:
         self._batch_hist: Counter[int] = Counter()
         self._last_t: float | None = None
         self._ewma_rate: float | None = None
+        #: per-second event counts: slot i holds the count for the
+        #: monotonic second recorded in _ring_sec[i]; a slot whose
+        #: second moved on is reset lazily at the next write
+        self._ring = [0] * self.WINDOW_SECONDS
+        self._ring_sec = [-1] * self.WINDOW_SECONDS
+        self._first_sec: int | None = None
+        #: storage insert/insert_batch walltime (obs/histogram.py;
+        #: owns its own lock) — fed by the event server's ingest paths
+        self.insert_latency = LatencyHistogram()
 
     def record_batch(self, n: int) -> None:
         """One successful storage insert of ``n`` events."""
@@ -130,6 +176,14 @@ class IngestStats:
             self._batches += 1
             self._events += n
             self._batch_hist[n] += 1
+            sec = int(now)
+            idx = sec % self.WINDOW_SECONDS
+            if self._ring_sec[idx] != sec:
+                self._ring[idx] = 0
+                self._ring_sec[idx] = sec
+            self._ring[idx] += n
+            if self._first_sec is None:
+                self._first_sec = sec
             if self._last_t is not None:
                 dt = now - self._last_t
                 if dt >= self._MIN_DT:
@@ -140,17 +194,55 @@ class IngestStats:
                         + (1.0 - self.EWMA_ALPHA) * self._ewma_rate)
             self._last_t = now
 
+    def _windowed_rate_locked(self) -> tuple[float | None, int]:
+        """(events/sec over complete seconds, window length) — caller
+        holds the lock. None until one full second has elapsed."""
+        if self._first_sec is None:
+            return None, 0
+        now_sec = int(self._now())
+        # complete seconds only: [now_sec - window, now_sec)
+        window = min(self.WINDOW_SECONDS - 1, now_sec - self._first_sec)
+        if window <= 0:
+            return None, 0
+        lo = now_sec - window
+        total = sum(
+            count
+            for count, sec in zip(self._ring, self._ring_sec)
+            if lo <= sec < now_sec
+        )
+        return total / window, window
+
+    def totals(self) -> tuple[int, int]:
+        """(batches, events) under one lock — the registry adapter."""
+        with self._lock:
+            return self._batches, self._events
+
+    def batch_histogram(self) -> dict[int, int]:
+        with self._lock:
+            return dict(self._batch_hist)
+
+    def rates(self) -> tuple[float | None, float | None, int]:
+        """(ewma, windowed, window_seconds) under one lock."""
+        with self._lock:
+            windowed, window = self._windowed_rate_locked()
+            return self._ewma_rate, windowed, window
+
     def snapshot(self) -> dict:
         with self._lock:
             batches, events = self._batches, self._events
             hist = {str(k): v for k, v in sorted(self._batch_hist.items())}
             rate = self._ewma_rate
+            windowed, window = self._windowed_rate_locked()
         return {
             "batches": batches,
             "events": events,
             "meanBatchSize": round(events / batches, 2) if batches else None,
             "batchSizeHistogram": hist,
             "eventsPerSecEwma": round(rate, 1) if rate is not None else None,
+            "eventsPerSecWindowed": (
+                round(windowed, 1) if windowed is not None else None),
+            "windowSeconds": window,
+            "insertLatency": self.insert_latency.snapshot().summary_ms(),
         }
 
 

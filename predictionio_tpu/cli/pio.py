@@ -54,7 +54,8 @@ def _cmd_eventserver(args, storage: Storage) -> int:
 
     server = EventServer(
         storage,
-        EventServerConfig(ip=args.ip, port=args.port, stats=args.stats),
+        EventServerConfig(ip=args.ip, port=args.port, stats=args.stats,
+                          tracing=args.tracing, access_log=args.access_log),
     )
     print(f"[INFO] Event Server listening on {args.ip}:{server.port}")
     try:
@@ -270,6 +271,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=7070)
     p.add_argument("--stats", action="store_true")
+    # observability (docs/observability.md): None defers to the
+    # PIO_TRACE / PIO_ACCESS_LOG env vars
+    p.add_argument("--tracing", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="per-request span collection for the ingest "
+                        "paths (served on GET /traces.json)")
+    p.add_argument("--access-log", action=argparse.BooleanOptionalAction,
+                   default=None, dest="access_log",
+                   help="structured JSON access logs (method, path, "
+                        "status, latency_ms, request_id)")
 
     p = sub.add_parser("app", help="app administration")
     app_sub = p.add_subparsers(dest="app_command", required=True)

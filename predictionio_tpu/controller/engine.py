@@ -130,18 +130,27 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         instances that will serve (see prepare_deploy) — train hooks
         stash serve-time state on the instance just like load_model
         hooks do."""
+        # per-DASE-stage spans (obs/trace.py): when the driver bound an
+        # ambient trace (workflow/train.run_train always does), read /
+        # prepare / train land as spans and `pio train` prints the
+        # stage breakdown; with no trace active, span() is a shared
+        # no-op — direct Engine.train callers pay one contextvar read
+        from predictionio_tpu.obs.trace import span
+
         params = ctx.workflow_params
         data_source, preparator, made_algorithms, _ = \
             self.make_components(engine_params)
         if algorithms is None:
             algorithms = made_algorithms
 
-        td = data_source.read_training(ctx)
+        with span("read"):
+            td = data_source.read_training(ctx)
         _sanity_check(td, "training data", not params.skip_sanity_check)
         if params.stop_after_read:
             raise StopAfterReadInterruption("stopping after read per workflow params")
 
-        pd = preparator.prepare(ctx, td)
+        with span("prepare"):
+            pd = preparator.prepare(ctx, td)
         _sanity_check(pd, "prepared data", not params.skip_sanity_check)
         if params.stop_after_prepare:
             raise StopAfterPrepareInterruption("stopping after prepare per workflow params")
@@ -149,10 +158,12 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         models: list[Any] = []
         for i, algo in enumerate(algorithms):
             logger.info("training algorithm %d: %s", i, type(algo).__name__)
-            model = algo.train(ctx, pd)
-            _sanity_check(model, f"model[{i}]", not params.skip_sanity_check)
-            if hasattr(algo, "gather_model"):
-                model = algo.gather_model(ctx, model)
+            with span("train"):
+                model = algo.train(ctx, pd)
+                _sanity_check(model, f"model[{i}]",
+                              not params.skip_sanity_check)
+                if hasattr(algo, "gather_model"):
+                    model = algo.gather_model(ctx, model)
             models.append(model)
 
         persisted = [

@@ -1,0 +1,62 @@
+"""Observability plane: request tracing, latency histograms, metric
+registry, and Prometheus text export (docs/observability.md).
+
+Zero-dependency by design — the serving plane must not grow a client
+library for the privilege of being measured. Four layers:
+
+- :mod:`~predictionio_tpu.obs.trace` — Dapper-style spans with ids,
+  parent links, and contextvar propagation that survives the
+  QueryBatcher's thread handoff and the deadline-dispatch pool;
+- :mod:`~predictionio_tpu.obs.histogram` — log-bucketed latency
+  histograms with lock-guarded snapshots, shared by serving and ingest;
+- :mod:`~predictionio_tpu.obs.registry` — one metric registry per
+  server that adopts the existing ServingStats / IngestStats /
+  resilience counters through scrape-time collectors;
+- :mod:`~predictionio_tpu.obs.exporter` — Prometheus text-format
+  rendering for ``GET /metrics``.
+
+The disabled path is near-free: one flag check and no allocation per
+request (``trace.start_trace`` is only called behind the server's
+``tracing`` flag; ambient ``span()`` returns a shared no-op when no
+trace is active), so tracing defaults off in benches.
+"""
+
+from predictionio_tpu.obs.exporter import render_prometheus
+from predictionio_tpu.obs.histogram import LatencyHistogram
+from predictionio_tpu.obs.registry import (
+    HistogramFamily,
+    Metric,
+    MetricRegistry,
+    ingest_collector,
+    resilience_collector,
+    server_info_collector,
+    serving_collector,
+)
+from predictionio_tpu.obs.trace import (
+    Trace,
+    TraceLog,
+    active_trace,
+    span,
+    start_trace,
+    tracing_default,
+    use_trace,
+)
+
+__all__ = [
+    "HistogramFamily",
+    "LatencyHistogram",
+    "Metric",
+    "MetricRegistry",
+    "Trace",
+    "TraceLog",
+    "active_trace",
+    "ingest_collector",
+    "render_prometheus",
+    "resilience_collector",
+    "server_info_collector",
+    "serving_collector",
+    "span",
+    "start_trace",
+    "tracing_default",
+    "use_trace",
+]

@@ -1,0 +1,256 @@
+"""Dapper-style request tracing for the serving, ingest, and training
+paths (Sigelman et al., 2010; docs/observability.md).
+
+A :class:`Trace` is one request's (or one train run's) span tree:
+flat records of ``(name, parent, start offset, duration)`` appended
+under a lock, so spans measured on OTHER threads — the QueryBatcher's
+dispatcher recording queue-wait and device time, the deadline pool
+running a non-batched predict — land on the same trace safely.
+
+Propagation has two legs:
+
+- **ambient** — a contextvar carries the active trace on the current
+  thread; ``span(name)`` opens a child span against it and is a shared
+  no-op when no trace is active (one contextvar read, no allocation —
+  the near-free disabled path). ``contextvars.copy_context`` captures
+  it, so the engine server's deadline-dispatch pool threads
+  (``EngineService._query_with_deadline``) inherit the trace for free.
+- **explicit** — queue handoffs (QueryBatcher.submit) carry the trace
+  object on the queue entry; the dispatcher thread calls
+  ``Trace.add_span`` with externally measured intervals.
+
+Traces are sampled into a bounded :class:`TraceLog` ring per server,
+served as JSON on ``GET /traces.json``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from contextvars import ContextVar
+from typing import Any, Iterator
+
+#: process-unique trace-id scheme: one random prefix per process plus a
+#: sequence — same uniqueness story as uuid4 for correlation purposes,
+#: without an os.urandom read (a syscall) on every traced request.
+#: itertools.count threads safely under the GIL (a single C call).
+_TRACE_ID_PREFIX = uuid.uuid4().hex[:16]
+_TRACE_ID_SEQ = itertools.count(1)
+
+
+def tracing_default() -> bool:
+    """The process-wide default for servers whose config leaves
+    ``tracing`` unset: the ``PIO_TRACE`` env var. Read at CALL time
+    (server construction), never frozen at import."""
+    return os.environ.get("PIO_TRACE", "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+_current: ContextVar["Trace | None"] = ContextVar("pio_trace", default=None)
+
+#: span record slots: (name, parent_span_id, span_id, start_s, dur_s)
+_ROOT_PARENT = ""
+
+
+class Trace:
+    """One request's spans. Cheap to create (an id, a list); creation
+    is gated behind the server's tracing flag so the disabled path
+    never allocates.
+
+    Concurrency contract (why there is NO lock): span records are
+    appended with ``list.append`` — atomic under the GIL — and every
+    read (``to_dict``/``stage_seconds``) first takes an atomic
+    ``list(...)`` copy, so a reader can never see a half-written
+    record (tuples are immutable and fully built before the append).
+    In the serving wiring the writers never actually overlap anyway:
+    the handler thread is blocked on its future while the batcher's
+    dispatcher records queue-wait/device spans. A lock here would add
+    two GIL handoff points per span on a 24-thread serving path for a
+    race that cannot corrupt anything — measured as a real qps cost
+    in the tracing-overhead bench phase."""
+
+    __slots__ = ("trace_id", "name", "request_id", "tags",
+                 "_t0", "_wall_start", "_spans", "_duration")
+
+    def __init__(self, name: str, request_id: str | None = None,
+                 trace_id: str | None = None):
+        self.trace_id = (trace_id
+                         or f"{_TRACE_ID_PREFIX}{next(_TRACE_ID_SEQ):012x}")
+        self.name = name
+        self.request_id = request_id
+        self.tags: dict[str, Any] = {}
+        self._t0 = time.perf_counter()
+        self._wall_start = time.time()
+        #: flat records: (name, parent_id, span_id, start_off_s, dur_s)
+        self._spans: list[tuple[str, str, str, float, float]] = []
+        self._duration: float | None = None
+
+    # -- span recording ------------------------------------------------------
+    def span(self, name: str, parent_id: str = _ROOT_PARENT) -> "_ActiveSpan":
+        """Context manager timing one in-thread stage."""
+        return _ActiveSpan(self, name, parent_id)
+
+    def add_span(self, name: str, start_perf: float, end_perf: float,
+                 parent_id: str = _ROOT_PARENT) -> str:
+        """Record an interval measured elsewhere (e.g. the batcher's
+        dispatcher thread timing queue-wait with its own clock reads).
+        ``start_perf``/``end_perf`` are ``time.perf_counter`` values.
+        Returns the new span id (usable as a parent link).
+
+        Span ids are a per-trace sequence, not uuids: they only need
+        to be unique WITHIN the trace (the trace_id provides global
+        uniqueness), and the hot path should not pay an os.urandom
+        read per span."""
+        span_id = f"s{len(self._spans):x}"
+        self._spans.append(
+            (name, parent_id, span_id,
+             start_perf - self._t0, max(0.0, end_perf - start_perf)))
+        return span_id
+
+    def finish(self, **tags: Any) -> None:
+        self._duration = time.perf_counter() - self._t0
+        if tags:
+            self.tags.update(tags)
+
+    # -- views ---------------------------------------------------------------
+    def stage_seconds(self) -> dict[str, float]:
+        """Total seconds per span name, insertion-ordered — the
+        ``pio train`` stage breakdown."""
+        out: dict[str, float] = {}
+        for name, _, _, _, dur in list(self._spans):
+            out[name] = out.get(name, 0.0) + dur
+        return out
+
+    def to_dict(self) -> dict:
+        spans = list(self._spans)
+        duration = self._duration
+        tags = dict(self.tags)
+        doc: dict[str, Any] = {
+            "traceId": self.trace_id,
+            "name": self.name,
+            "startTime": self._wall_start,
+            "durationMs": (round(duration * 1e3, 3)
+                           if duration is not None else None),
+            "spans": [
+                {
+                    "name": name,
+                    "spanId": span_id,
+                    **({"parentId": parent} if parent else {}),
+                    "startMs": round(start * 1e3, 3),
+                    "durationMs": round(dur * 1e3, 3),
+                }
+                for name, parent, span_id, start, dur in sorted(
+                    spans, key=lambda s: s[3])
+            ],
+        }
+        if self.request_id:
+            doc["requestId"] = self.request_id
+        if tags:
+            doc["tags"] = tags
+        return doc
+
+
+class _ActiveSpan:
+    """The in-thread span context manager (``Trace.span``)."""
+
+    __slots__ = ("_trace", "_name", "_parent", "_start", "span_id")
+
+    def __init__(self, trace: Trace, name: str, parent_id: str):
+        self._trace = trace
+        self._name = name
+        self._parent = parent_id
+        self._start = 0.0
+        self.span_id = ""
+
+    def __enter__(self) -> "_ActiveSpan":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.span_id = self._trace.add_span(
+            self._name, self._start, time.perf_counter(), self._parent)
+
+
+class _NullSpan:
+    """Shared no-op for the disabled path: ``span()`` with no active
+    trace returns THIS singleton — no allocation, two no-op calls."""
+
+    __slots__ = ()
+    span_id = ""
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def start_trace(name: str, request_id: str | None = None) -> Trace:
+    """A new root trace. Call sites gate this behind their tracing
+    flag — the flag check is the whole cost of the disabled path."""
+    return Trace(name, request_id=request_id)
+
+
+def active_trace() -> Trace | None:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def use_trace(trace: Trace | None) -> Iterator[Trace | None]:
+    """Bind ``trace`` as the ambient trace for the current context.
+    ``contextvars.copy_context()`` carries the binding onto pool
+    threads (the deadline-dispatch path)."""
+    token = _current.set(trace)
+    try:
+        yield trace
+    finally:
+        _current.reset(token)
+
+
+def span(name: str):
+    """Ambient child span: records against the current trace, or is a
+    shared no-op when none is active (one contextvar read, zero
+    allocation)."""
+    trace = _current.get()
+    if trace is None:
+        return _NULL_SPAN
+    return trace.span(name)
+
+
+class TraceLog:
+    """Bounded ring of recently finished traces (newest first on
+    read). Recording is one deque append under the ring's lock —
+    serialization to JSON-able dicts happens at READ time, relying on
+    the lock-free :class:`Trace` read contract (``to_dict`` copies the
+    span list atomically under the GIL; see the Trace docstring for
+    why the trace itself carries no lock), so the request hot path
+    never pays for a trace nobody is looking at. The ring's one lock
+    guards the deque at writers and readers."""
+
+    def __init__(self, maxlen: int = 64):
+        self._lock = threading.Lock()
+        self._ring: deque[Trace] = deque(maxlen=maxlen)
+        self._recorded = 0
+
+    def record(self, trace: Trace) -> None:
+        with self._lock:
+            self._ring.append(trace)
+            self._recorded += 1
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            traces = list(reversed(self._ring))
+        return [t.to_dict() for t in traces]
+
+    @property
+    def recorded(self) -> int:
+        with self._lock:
+            return self._recorded

@@ -69,6 +69,13 @@ class _Pending(NamedTuple):
     budget: float | None
     #: canonical dedup key (None = never deduped)
     key: str | None
+    #: perf_counter at enqueue — the queue-wait component of serving
+    #: latency is measured from here to the dispatch (obs histograms)
+    t_enq: float
+    #: the caller's trace, carried EXPLICITLY across the thread handoff
+    #: (contextvars do not follow queue entries); None when tracing is
+    #: off — the dispatcher's whole tracing cost is this None check
+    trace: Any = None
 
 
 class QueryBatcher:
@@ -111,14 +118,17 @@ class QueryBatcher:
         return self.stats.count("batched_queries")
 
     def submit(self, query: Any, timeout: float = 300.0,
-               key: str | None = None) -> Any:
+               key: str | None = None, trace: Any = None) -> Any:
         """Enqueue and wait; raises whatever the predict path raised.
 
         The caller's ambient resilience deadline (deadline_scope) rides
         along into the dispatcher thread — contextvars do not cross
         threads, so the remaining budget is captured here and re-entered
         around the batch dispatch and any per-query fallbacks. A budget
-        that is ALREADY exhausted fails here, before the queue."""
+        that is ALREADY exhausted fails here, before the queue. The
+        caller's ``trace`` (obs/trace.py) rides the queue entry the
+        same way: the dispatcher records this query's queue-wait and
+        device-dispatch spans onto it."""
         if self._stopped:
             raise RuntimeError("query batcher is stopped")
         rem = remaining_deadline()
@@ -131,7 +141,8 @@ class QueryBatcher:
             self._policy.observe_arrival()
             deadline = time.monotonic() + rem if rem is not None else None
             fut: Future = Future()
-            self._queue.put(_Pending(query, fut, deadline, rem, key))
+            self._queue.put(_Pending(query, fut, deadline, rem, key,
+                                     time.perf_counter(), trace))
             if self._stopped and not fut.done():
                 # close() raced the enqueue: the dispatcher (or close's
                 # drain) may never see this entry — fail fast instead of
@@ -274,9 +285,20 @@ class QueryBatcher:
         try:
             # the batch shares one dispatch: honor its tightest deadline
             t0 = time.perf_counter()
+            # queue-wait attribution (enqueue -> dispatch start): one
+            # lock acquisition for the whole batch's samples, plus the
+            # per-entry trace spans when tracing rode along
+            self.stats.observe_queue_waits([t0 - e.t_enq for e in live])
+            for e in live:
+                if e.trace is not None:
+                    e.trace.add_span("batcher.queue_wait", e.t_enq, t0)
             with self._scope(min(deadlines) if deadlines else None):
                 results = deployed.query_batch([g[0].query for g in groups])
             dt = time.perf_counter() - t0
+            self.stats.observe_device_time(dt)
+            for e in live:
+                if e.trace is not None:
+                    e.trace.add_span("batcher.device_dispatch", t0, t0 + dt)
             # query_batch records request bookkeeping only for the
             # group leaders it saw; the deduped waiters were answered
             # by the same dispatch and must count as served requests
@@ -314,6 +336,7 @@ class QueryBatcher:
                 self._expire(entry)
                 continue
             if outcome is self._UNSET and err is None:
+                t0 = time.perf_counter()
                 try:
                     # re-resolve per query: a /reload mid-batch must not
                     # pin the whole fallback pass to the dead instance
@@ -322,6 +345,9 @@ class QueryBatcher:
                         outcome = self._get_deployed().query(entry.query)
                 except Exception as e:          # noqa: BLE001
                     err = e
+                if entry.trace is not None:
+                    entry.trace.add_span("batcher.fallback_predict", t0,
+                                         time.perf_counter())
             try:
                 if err is not None:
                     entry.fut.set_exception(err)

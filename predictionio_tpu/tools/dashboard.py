@@ -27,9 +27,25 @@ import html
 import json
 import logging
 import re
+import time
 from http.server import BaseHTTPRequestHandler
 
-from predictionio_tpu.api.http_base import RestServer
+from predictionio_tpu.api.http_base import (
+    REQUEST_ID_HEADER,
+    RestServer,
+    access_log_enabled,
+    emit_access_log,
+    ensure_access_log_handler,
+    resolve_request_id,
+)
+from predictionio_tpu.obs.exporter import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from predictionio_tpu.obs.exporter import render_prometheus
+from predictionio_tpu.obs.registry import (
+    HistogramFamily,
+    MetricRegistry,
+    resilience_collector,
+    server_info_collector,
+)
 from predictionio_tpu.storage.registry import Storage
 
 logger = logging.getLogger(__name__)
@@ -50,8 +66,33 @@ _CORS_PREFLIGHT = (
 
 
 class DashboardService:
-    def __init__(self, storage: Storage | None = None):
+    def __init__(self, storage: Storage | None = None,
+                 access_log: bool | None = None):
         self.storage = storage or Storage.default()
+        # observability plane (docs/observability.md): the dashboard
+        # exposes its own scrape point — request latency + the
+        # process-global resilience counters — and the shared
+        # structured-access-log/request-id contract
+        self.access_log = access_log_enabled(access_log)
+        if self.access_log:
+            ensure_access_log_handler()
+        self.request_latency = HistogramFamily(
+            "pio_http_request_seconds",
+            "HTTP request walltime by route (handler-measured)",
+            "route", ("index", "results", "metrics"))
+        self.registry = MetricRegistry()
+        self.registry.register(self.request_latency.collect)
+        self.registry.register(resilience_collector())
+        self.registry.register(server_info_collector("dashboard"))
+
+    def route_label(self, path: str) -> str:
+        if path == "/":
+            return "index"
+        if path == "/metrics":
+            return "metrics"
+        if _RESULTS_RE.match(path):
+            return "results"
+        return "other"
 
     def handle(self, method: str, path: str) -> tuple[int, str, str]:
         """Returns (status, content_type, body)."""
@@ -59,6 +100,9 @@ class DashboardService:
             return (405, "application/json", json.dumps({"message": "GET only"}))
         if path == "/":
             return (200, "text/html; charset=UTF-8", self.index_html())
+        if path == "/metrics":
+            return (200, PROMETHEUS_CONTENT_TYPE,
+                    render_prometheus(self.registry))
         m = _RESULTS_RE.match(path)
         if m:
             instance_id, fmt = m.groups()
@@ -103,20 +147,31 @@ class _Handler(BaseHTTPRequestHandler):
     service: DashboardService
 
     def do_GET(self) -> None:  # noqa: N802
-        status, ctype, body = self.service.handle("GET", self.path.split("?")[0])
+        t_start = time.perf_counter()
+        path = self.path.split("?")[0]
+        request_id = resolve_request_id(self.headers)
+        status, ctype, body = self.service.handle("GET", path)
         data = body.encode()
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        self.send_header(REQUEST_ID_HEADER, request_id)
         self.send_header(*_CORS_ORIGIN)
         self.end_headers()
         self.wfile.write(data)
+        dt = time.perf_counter() - t_start
+        self.service.request_latency.observe(
+            self.service.route_label(path), dt)
+        if self.service.access_log:
+            emit_access_log("dashboard", "GET", path, status, dt,
+                            request_id, client=self.address_string())
 
     def do_OPTIONS(self) -> None:  # noqa: N802
         """CORS preflight (CorsSupport.scala:48-63): a routed path answers
         with the methods it supports; unknown paths still 404."""
         path = self.path.split("?")[0]
-        known = path == "/" or _RESULTS_RE.match(path) is not None
+        known = (path == "/" or path == "/metrics"
+                 or _RESULTS_RE.match(path) is not None)
         self.send_response(200 if known else 404)
         self.send_header("Access-Control-Allow-Methods", "OPTIONS, GET")
         self.send_header(*_CORS_ORIGIN)
@@ -136,5 +191,6 @@ class Dashboard(RestServer):
     thread_name = "pio-dashboard"
 
     def __init__(self, storage: Storage | None = None, ip: str = "0.0.0.0",
-                 port: int = 9000):
-        super().__init__(_Handler, DashboardService(storage), ip, port)
+                 port: int = 9000, access_log: bool | None = None):
+        super().__init__(_Handler, DashboardService(storage, access_log),
+                         ip, port)

@@ -122,6 +122,12 @@ def _cmd_train(args, storage) -> int:
     )
     print(f"[INFO] Training finished: engine instance {outcome.instance_id} "
           f"({outcome.status})")
+    if outcome.stage_seconds:
+        from predictionio_tpu.workflow.train import format_stage_times
+
+        # per-DASE-stage walltimes (docs/observability.md): where a
+        # slow train actually spent its time
+        print(f"[INFO] Stage times: {format_stage_times(outcome.stage_seconds)}")
     return 0 if outcome.status in ("COMPLETED", "INTERRUPTED") else 1
 
 
@@ -216,6 +222,17 @@ def _configure_deploy(sub) -> None:
                         "JSON, invalidated on /reload")
     p.add_argument("--cache-max-entries", type=int, default=None)
     p.add_argument("--cache-ttl-s", type=float, default=None)
+    # observability (docs/observability.md): None defers to the
+    # PIO_TRACE / PIO_ACCESS_LOG env vars; the boolean pairs let the
+    # CLI force either state over a fleet-wide env setting
+    p.add_argument("--tracing", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="per-request span collection for /queries.json "
+                        "(served on GET /traces.json)")
+    p.add_argument("--access-log", action=argparse.BooleanOptionalAction,
+                   default=None, dest="access_log",
+                   help="structured JSON access logs (method, path, "
+                        "status, latency_ms, request_id)")
 
 
 def _cmd_deploy(args, storage) -> int:
@@ -247,6 +264,8 @@ def _cmd_deploy(args, storage) -> int:
             "cache_enabled": args.cache,
             "cache_max_entries": args.cache_max_entries,
             "cache_ttl_s": args.cache_ttl_s,
+            "tracing": args.tracing,
+            "access_log": args.access_log,
         }.items() if v is not None},
     )
     server = create_engine_server(storage=storage, config=config)
@@ -282,12 +301,17 @@ def _configure_dashboard(sub) -> None:
     p = sub.add_parser("dashboard", help="launch the evaluation dashboard")
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9000)
+    p.add_argument("--access-log", action=argparse.BooleanOptionalAction,
+                   default=None, dest="access_log",
+                   help="structured JSON access logs "
+                        "(docs/observability.md)")
 
 
 def _cmd_dashboard(args, storage) -> int:
     from predictionio_tpu.tools.dashboard import Dashboard
 
-    return _serve(Dashboard(storage, ip=args.ip, port=args.port),
+    return _serve(Dashboard(storage, ip=args.ip, port=args.port,
+                            access_log=args.access_log),
                   "Dashboard", args.ip)
 
 

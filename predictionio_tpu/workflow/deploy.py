@@ -129,6 +129,15 @@ class ServerConfig:
     #: header; exhaustion maps to 503 + Retry-After, not a hung socket.
     #: 0 disables (legacy behavior: 300s batcher wait, no deadline).
     request_deadline_ms: float = _env_field("REQUEST_DEADLINE_MS", 0.0, float)
+    #: observability plane (docs/observability.md). ``tracing`` turns
+    #: on per-request span collection for /queries.json (served back on
+    #: GET /traces.json); None defers to the PIO_TRACE env var at
+    #: server construction. Off by default — the disabled path is one
+    #: flag check per request, which is what the serving bench runs.
+    tracing: bool | None = None
+    #: structured JSON access logs on the ``pio.access`` logger; None
+    #: defers to the PIO_ACCESS_LOG env var (api/http_base.py)
+    access_log: bool | None = None
 
 
 class DeployedEngine:
