@@ -19,11 +19,19 @@ clients in three configurations:
                    ≤ 5%;
 - ``cached``     — adaptive + the result cache, clients drawing from a
                    small hot query pool (the repeated-query regime the
-                   cache exists for).
+                   cache exists for);
+- ``router``     — the PR 6 fleet tier's overhead pin (docs/fleet.md):
+                   a ``RouterServer`` fronting TWO adaptive replicas vs
+                   one direct adaptive server, paired order-alternated
+                   rounds, steady-state means — ``router_overhead_pct``
+                   in the artifact must stay ≤ 10% qps at the default
+                   client count.
 
-Prints ONE JSON line in the BENCH contract
-(``{"metric", "value", "unit", ...}``), with p50/p95/p99 per phase and
-the adaptive-vs-per-query speedup. Runs anywhere jax runs — CPU
+Prints ONE JSON line PER PHASE GROUP in the BENCH contract
+(``{"metric", "value", "unit", ...}``): the serving line (adaptive /
+traced / cached, with p50/p95/p99 per phase and the
+adaptive-vs-per-query speedup) followed by the router-overhead line;
+``--router-only`` emits just the latter. Runs anywhere jax runs — CPU
 (``JAX_PLATFORMS=cpu``) included; the batching win it measures is the
 amortization of per-dispatch overhead (kernel launch + factor-table
 traversal shared across the batch), which exists on every backend and
@@ -243,21 +251,26 @@ def _client_main(argv: list[str]) -> None:
     }), flush=True)
 
 
-def _run_round(port: int, pool_size: int, clients: int, per_client: int,
-               warmup: int, procs: int) -> dict:
-    """One synchronized multi-process load round against ``port``."""
+def _run_round(port: int | list[int], pool_size: int, clients: int,
+               per_client: int, warmup: int, procs: int) -> dict:
+    """One synchronized multi-process load round against ``port`` — or
+    several ports: a LIST splits the client processes round-robin
+    across them (client-side load balancing, the router bench's
+    direct-to-replicas baseline)."""
     import subprocess
     import sys
 
-    procs = max(1, min(procs, clients))
+    ports = [port] if isinstance(port, int) else list(port)
+    procs = max(len(ports), min(procs, clients))
     per_proc = [clients // procs + (1 if i < clients % procs else 0)
                 for i in range(procs)]
     children = []
     cid0 = 0
-    for n_threads in per_proc:
+    for i, n_threads in enumerate(per_proc):
         children.append(subprocess.Popen(
             [sys.executable, __file__, "--client",
-             "--port", str(port), "--threads", str(n_threads),
+             "--port", str(ports[i % len(ports)]),
+             "--threads", str(n_threads),
              "--count", str(per_client), "--warmup", str(warmup),
              "--cid0", str(cid0), "--pool-size", str(pool_size)],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True))
@@ -284,7 +297,8 @@ def _run_round(port: int, pool_size: int, clients: int, per_client: int,
     }
 
 
-def _drive(port: int, user_pool: list[str], clients: int, per_client: int,
+def _drive(port: int | list[int], user_pool: list[str], clients: int,
+           per_client: int,
            warmup: int = DEF_WARMUP, rounds: int = 2,
            procs: int = DEF_CLIENT_PROCS) -> dict:
     """N keep-alive clients (split over separate processes), M queries
@@ -445,11 +459,198 @@ def bench_serving(items: int = DEF_ITEMS, rank: int = DEF_RANK,
     return out
 
 
+def _replica_main(argv: list[str]) -> None:
+    """Replica subprocess: synthetic adaptive engine server, the same
+    production shape the serving phases measure — in its OWN process
+    so the router never steals interpreter time from the model server
+    (the GIL-convoy lesson of the in-process client experiment)."""
+    import argparse
+    import sys
+
+    sys.setswitchinterval(0.0005)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--items", type=int, required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--batch-max", type=int, required=True)
+    args = ap.parse_args(argv)
+
+    from predictionio_tpu.api.engine_server import EngineServer
+    from predictionio_tpu.workflow.deploy import ServerConfig
+
+    deployed = build_deployed(items=args.items, rank=args.rank)
+    warm_batch_signatures(deployed, args.batch_max)
+    server = EngineServer(deployed, ServerConfig(
+        ip="127.0.0.1", port=0, batching=True,
+        batch_policy="adaptive", batch_max=args.batch_max,
+        batch_wait_ms=5.0))
+    server.start()
+    print(f"PORT {server.port}", flush=True)
+    sys.stdin.readline()                 # parent closes stdin to stop
+    server.stop()
+
+
+def _router_main(argv: list[str]) -> None:
+    """Router worker subprocess (how `pio router` deploys: its own
+    process; ``--workers N`` spawns N of these sharing one
+    SO_REUSEPORT listen port)."""
+    import argparse
+    import sys
+
+    sys.setswitchinterval(0.0005)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", action="append", required=True)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--reuse-port", action="store_true")
+    args = ap.parse_args(argv)
+
+    from predictionio_tpu.api.router_server import RouterServer
+    from predictionio_tpu.fleet.router import RouterConfig
+
+    # generous probe budget: a GIL-saturated CPython replica can sit on
+    # a /healthz answer for over a second at full load, and a bench
+    # round that marks a healthy-but-busy replica down measures the
+    # mark-down, not the router hop
+    server = RouterServer(RouterConfig(
+        ip="127.0.0.1", port=args.port, backends=tuple(args.backend),
+        reuse_port=args.reuse_port,
+        probe_timeout_s=5.0, down_after=3))
+    server.start()
+    print(f"PORT {server.port}", flush=True)
+    sys.stdin.readline()
+    stats = server.router.stats.raw_counts()
+    server.stop()
+    print(json.dumps(stats), flush=True)
+
+
+def _spawn(mode: str, argv: list[str]):
+    """(process, announced port) for a --replica/--router child."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, __file__, f"--{mode}", *argv],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("PORT "):
+        proc.kill()
+        raise AssertionError(f"{mode} child said {line!r}")
+    return proc, int(line.split()[1])
+
+
+def bench_router(items: int = DEF_ITEMS, rank: int = DEF_RANK,
+                 clients: int = DEF_CLIENTS,
+                 per_client: int = 50,
+                 batch_max: int = 32, rounds: int = 6,
+                 procs: int = DEF_CLIENT_PROCS) -> dict:
+    """The fleet router's cost, pinned the way tracing's was
+    (docs/fleet.md): the SAME two replica processes driven two ways —
+    ``direct`` (client processes split across the replicas: client-side
+    round-robin, the no-router fleet) vs ``router`` (every client
+    through one router process). Same fleet, same model, same batching
+    regime; the ONLY difference is the router hop, so the ratio is the
+    router's cost and nothing else. Every server runs in its OWN
+    process exactly as `pio deploy`/`pio router` deploy them (an
+    in-process router measurement GIL-couples the router to the
+    replicas and misreports interpreter contention as routing cost —
+    the bench_serving client lesson again). Paired order-alternated
+    rounds; overhead from STEADY-STATE MEANS with the first paired
+    round dropped — the same reasoning as tracing_overhead_pct above."""
+    import socket as _socket
+
+    replica_args = ["--items", str(items), "--rank", str(rank),
+                    "--batch-max", str(batch_max)]
+    pool = [f"u{i}" for i in range(DEF_POOL)]
+    # an EVEN number of client processes so the direct phase splits
+    # clients across the two replicas symmetrically
+    procs = max(2, procs + (procs % 2))
+    direct_rounds: list[float] = []
+    router_rounds: list[float] = []
+    direct_best = router_best = None
+    # every spawn happens INSIDE the try and registers itself as it
+    # starts: a failed later spawn must tear down the earlier children
+    children: list = []
+    router_workers: list = []
+    try:
+        for _ in range(2):
+            children.append(_spawn("replica", replica_args))
+        replica_ports = [port for _, port in children]
+        # TWO router workers on one SO_REUSEPORT port (`pio router
+        # --workers 2`): one CPython router process saturates its GIL
+        # at ~200 qps on this host while the 2-replica fleet clears
+        # ~300 — the router tier scales horizontally exactly like the
+        # model tier
+        probe = _socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        router_port = probe.getsockname()[1]
+        probe.close()
+        backend_args = [a for port in replica_ports
+                        for a in ("--backend", f"127.0.0.1:{port}")]
+        for _ in range(2):
+            router_workers.append(
+                _spawn("router", [*backend_args, "--port",
+                                  str(router_port), "--reuse-port"])[0])
+        for i in range(rounds):
+            pair = [(replica_ports, "d"), ([router_port], "r")]
+            if i % 2:
+                pair.reverse()
+            for ports, tag in pair:
+                r = _drive(ports, pool, clients, per_client,
+                           rounds=1, procs=procs)
+                if tag == "d":
+                    direct_rounds.append(r["qps"])
+                    if direct_best is None or r["qps"] > direct_best["qps"]:
+                        direct_best = r
+                else:
+                    router_rounds.append(r["qps"])
+                    if router_best is None or r["qps"] > router_best["qps"]:
+                        router_best = r
+        router_stats: dict = {}
+        for worker in router_workers:
+            worker.stdin.close()         # worker prints stats and exits
+            for field, value in json.loads(
+                    worker.stdout.readline()).items():
+                router_stats[field] = router_stats.get(field, 0) + value
+    finally:
+        # exception-safe teardown: one wedged child must not leak the
+        # rest (a raised wait() would skip every later kill)
+        for proc in [p for p, _ in children] + router_workers:
+            try:
+                if proc.stdin and not proc.stdin.closed:
+                    proc.stdin.close()
+                proc.wait(timeout=15)
+            except Exception:
+                proc.kill()
+
+    return {
+        "metric": f"router_overhead_{clients}c",
+        "value": round(
+            (1.0 - _steady_mean(router_rounds)
+             / _steady_mean(direct_rounds)) * 100.0, 2),
+        "unit": "pct",
+        "router_qps": router_best["qps"],
+        "router_p50_ms": router_best["p50_ms"],
+        "router_p99_ms": router_best["p99_ms"],
+        "direct_qps": direct_best["qps"],
+        "direct_p50_ms": direct_best["p50_ms"],
+        "router_round_qps": router_rounds,
+        "direct_round_qps": direct_rounds,
+        "router_replicas": 2,
+        "router_workers": 2,
+        "errors": router_best["errors"] + direct_best["errors"],
+        "router_retries": router_stats.get("retries", 0),
+        "router_sheds": router_stats.get("sheds", 0),
+        "router_no_backend": router_stats.get("no_backend", 0),
+        "router_group_spills": router_stats.get("group_spills", 0),
+        "clients": clients,
+    }
+
+
 def bench_section(clients: int = DEF_CLIENTS) -> dict:
     """The ``serving_path`` section for bench.py's round artifact:
     the same phases at reduced volume, keys prefixed for the merged
     BENCH line."""
     r = bench_serving(clients=clients, per_client=16)
+    rt = bench_router(clients=clients, per_client=16)
     return {
         f"serving_qps_adaptive_{clients}c": r["value"],
         f"serving_qps_per_query_{clients}c": r["per_query_qps"],
@@ -459,6 +660,8 @@ def bench_section(clients: int = DEF_CLIENTS) -> dict:
         "serving_tracing_overhead_pct": r["tracing_overhead_pct"],
         "serving_cached_qps": r["cached_qps"],
         "serving_cache_hit_ratio": r["cache_hit_ratio"],
+        "serving_router_qps": rt["router_qps"],
+        "serving_router_overhead_pct": rt["value"],
     }
 
 
@@ -468,6 +671,13 @@ def main() -> None:
     if "--client" in sys.argv:
         # load-generator subprocess entry (spawned by _run_round)
         _client_main([a for a in sys.argv[1:] if a != "--client"])
+        return
+    if "--replica" in sys.argv:
+        # replica-server subprocess entry (spawned by bench_router)
+        _replica_main([a for a in sys.argv[1:] if a != "--replica"])
+        return
+    if "--router" in sys.argv:
+        _router_main([a for a in sys.argv[1:] if a != "--router"])
         return
     # 48+ threads at CPython's default 5ms GIL switch interval add
     # multi-ms scheduling jitter per request; tighten it for the
@@ -480,8 +690,15 @@ def main() -> None:
     parser.add_argument("--per-client", type=int, default=DEF_PER_CLIENT)
     parser.add_argument("--batch-max", type=int, default=32)
     parser.add_argument("--client-procs", type=int, default=DEF_CLIENT_PROCS)
+    parser.add_argument("--router-only", action="store_true",
+                        help="run only the fleet-router overhead phase")
     args = parser.parse_args()
-    print(json.dumps(bench_serving(
+    if not args.router_only:
+        print(json.dumps(bench_serving(
+            items=args.items, rank=args.rank, clients=args.clients,
+            per_client=args.per_client, batch_max=args.batch_max,
+            procs=args.client_procs)))
+    print(json.dumps(bench_router(
         items=args.items, rank=args.rank, clients=args.clients,
         per_client=args.per_client, batch_max=args.batch_max,
         procs=args.client_procs)))

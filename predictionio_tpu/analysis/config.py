@@ -90,10 +90,12 @@ COMPUTE_PATHS = ("ops/", "models/", "e2/")
 #: request-serving hot path: handler threads, the deployed query path,
 #: the batching/cache subsystem (serving/ — PR 3), the columnar
 #: data plane's scan/view consumers (data/ — PR 4): a host sync inside
-#: the train-read loop would serialize every batch, and the
+#: the train-read loop would serialize every batch, the
 #: observability plane (obs/ — PR 5), which runs INSIDE every request
-#: and must never block on the device
-HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/", "obs/")
+#: and must never block on the device, and the fleet router
+#: (fleet/ — PR 6), which sits on EVERY fleet query
+HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/", "obs/",
+             "fleet/")
 
 
 def default_config() -> LintConfig:
@@ -109,8 +111,13 @@ def default_config() -> LintConfig:
                 # resilient() wrappers, and the observability plane
                 # must never do network I/O of its own (scrapers pull;
                 # the plane never pushes)
-                paths=("storage/", "serving/", "data/", "obs/",
-                       "api/event_server.py"),
+                # fleet/ and the router's HTTP surface ride along
+                # (PR 6): the router's ONE raw-socket site is the
+                # transport's connect, declared below; everything else
+                # in the fleet tier must reach replicas only through
+                # resilient()-routed exchanges
+                paths=("storage/", "serving/", "data/", "obs/", "fleet/",
+                       "api/event_server.py", "api/router_server.py"),
                 options={
                     # raw-network callables we police
                     "net_calls": ["urlopen", "create_connection"],
@@ -122,6 +129,7 @@ def default_config() -> LintConfig:
                         "pgwire.py": ["_open_socket"],
                         "postgres.py": [],
                         "hdfs.py": [],
+                        "transport.py": ["BackendTransport._connect"],
                     },
                     # module basename -> functions referable (outside
                     # their own def) only inside a resilient(...) call
@@ -140,6 +148,14 @@ def default_config() -> LintConfig:
                     "call_guard": {
                         "pgwire.py": {
                             "_open_socket": ["PGConnection.__init__"],
+                        },
+                        # the fleet transport's socket opener is
+                        # reachable only from the request exchange,
+                        # whose callers route through
+                        # resilient(backend.resilience, ...) at the
+                        # router layer (fleet/router._exchange)
+                        "transport.py": {
+                            "_connect": ["BackendTransport.request"],
                         },
                     },
                     # module basename -> {ClassName: enclosing function}:

@@ -51,7 +51,6 @@ import contextvars
 import dataclasses
 import json
 import logging
-import math
 import queue
 import threading
 import time
@@ -70,6 +69,7 @@ from predictionio_tpu.api.http_base import (
     bounded_probe,
     emit_access_log,
     ensure_access_log_handler,
+    parse_deadline_budget,
     resolve_request_id,
 )
 from predictionio_tpu.api.stats import ServingStats, resilience_snapshot
@@ -301,6 +301,14 @@ class EngineService:
         #: holding the socket (threads spawn lazily; idle pool is free)
         self._query_pool = ThreadPoolExecutor(
             max_workers=64, thread_name_prefix="pio-query-deadline")
+        #: /reload-in-flight count: while > 0, /readyz reports 503 so a
+        #: fleet router's membership loop stops routing here mid-model-
+        #: swap instead of racing the hot swap (docs/fleet.md); queries
+        #: already in flight still answer (last-known-good semantics on
+        #: reload failure are unchanged). Lock-guarded at writer and
+        #: readers (handler threads on both sides).
+        self._reload_lock = threading.Lock()
+        self._reloads_in_flight = 0
 
     # -- auth (KeyAuthentication.withAccessKeyFromFile) ---------------------
     def _check_server_key(self, params: Mapping[str, str]) -> None:
@@ -398,6 +406,15 @@ class EngineService:
         Retry-After) until both hold — load balancers drain, clients
         back off, and a wedged dependency never looks like a live
         replica."""
+        with self._reload_lock:
+            reloading = self._reloads_in_flight > 0
+        if reloading:
+            # a replica mid-model-swap must drain from routers/load
+            # balancers: not-ready (NOT ready-with-stale) until the
+            # swap commits or fails back to last-known-good
+            return (503, {"status": "reloading",
+                          "model": self.deployed.instance.id},
+                    {"Retry-After": "1"})
         checks: dict[str, str] = {}
         ready = True
         if self.deployed is not None:
@@ -499,23 +516,16 @@ class EngineService:
         )
 
     def _deadline_budget(self, headers: Mapping[str, str]) -> float | None:
-        """Per-request budget (seconds): X-PIO-Deadline-Ms header may only
-        TIGHTEN the configured request_deadline_ms."""
-        budget = (self.config.request_deadline_ms / 1e3
-                  if self.config.request_deadline_ms > 0 else None)
-        raw = headers.get("x-pio-deadline-ms")
-        if raw:
-            try:
-                value = float(raw)
-            except ValueError:
-                value = float("nan")
-            if not math.isfinite(value) or value <= 0:
-                # nan/inf/zero/negative are malformed requests, not
-                # budgets — a silent 1ms budget would 503 forever
-                raise _Reject(400, f"invalid X-PIO-Deadline-Ms: {raw!r}")
-            client = max(0.001, value / 1e3)
-            budget = client if budget is None else min(budget, client)
-        return budget
+        """Per-request budget (seconds) via the shared contract
+        (http_base.parse_deadline_budget — the fleet router applies the
+        same parse, so both tiers agree on every header): the
+        X-PIO-Deadline-Ms header may only TIGHTEN the configured
+        request_deadline_ms; malformed values are a 400."""
+        try:
+            return parse_deadline_budget(self.config.request_deadline_ms,
+                                         headers)
+        except ValueError as exc:
+            raise _Reject(400, str(exc))
 
     def handle_query(self, body: Any,
                      headers: Mapping[str, str] = {}) -> tuple[int, Any]:
@@ -639,25 +649,36 @@ class EngineService:
 
     def reload(self) -> None:
         """Hot-swap to the latest completed instance
-        (CreateServer.scala:316-342)."""
-        new = load_deployed_engine(
-            storage=self.storage,
-            config=dataclasses.replace(self.config, engine_instance_id=None),
-            ctx=self.ctx,
-            engine=self.deployed.engine,
-        )
-        old_id = self.deployed.instance.id
-        self.deployed = new
-        self._query_decoder = (
-            compile_wire_decoder(qc)
-            if (qc := new.query_class) is not None else None)
-        if self.cache is not None:
-            # swap THEN invalidate: entries computed against the old
-            # model die with its generation (ResultCache docstring); a
-            # FAILED reload never reaches here, so last-known-good
-            # keeps its warm cache
-            self.cache.invalidate()
-        logger.info("reloaded: instance %s -> %s", old_id, new.instance.id)
+        (CreateServer.scala:316-342). While the reload is in flight
+        /readyz reports not-ready (503 "reloading") so fleet membership
+        drains this replica; failure semantics are unchanged — the
+        last-known-good model keeps serving and the caller maps the
+        error to 503."""
+        with self._reload_lock:
+            self._reloads_in_flight += 1
+        try:
+            new = load_deployed_engine(
+                storage=self.storage,
+                config=dataclasses.replace(self.config,
+                                           engine_instance_id=None),
+                ctx=self.ctx,
+                engine=self.deployed.engine,
+            )
+            old_id = self.deployed.instance.id
+            self.deployed = new
+            self._query_decoder = (
+                compile_wire_decoder(qc)
+                if (qc := new.query_class) is not None else None)
+            if self.cache is not None:
+                # swap THEN invalidate: entries computed against the old
+                # model die with its generation (ResultCache docstring); a
+                # FAILED reload never reaches here, so last-known-good
+                # keeps its warm cache
+                self.cache.invalidate()
+            logger.info("reloaded: instance %s -> %s", old_id, new.instance.id)
+        finally:
+            with self._reload_lock:
+                self._reloads_in_flight -= 1
 
     # -- feedback loop ------------------------------------------------------
     def _post_feedback(self, pr_id: str, query_json: dict, response: dict) -> None:

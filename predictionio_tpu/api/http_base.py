@@ -28,9 +28,11 @@ from __future__ import annotations
 import itertools
 import json
 import logging
+import math
 import os
 import random
 import re
+import socket
 import sys
 import threading
 import time
@@ -86,6 +88,28 @@ def resolve_request_id(headers: Mapping[str, str]) -> str:
     if raw and _REQUEST_ID_RE.match(raw):
         return raw
     return f"{_REQUEST_ID_PREFIX}{next(_REQUEST_ID_SEQ):08x}"
+
+
+def parse_deadline_budget(config_deadline_ms: float,
+                          headers: Mapping[str, str]) -> float | None:
+    """THE per-request deadline contract, shared by the engine server
+    and the fleet router: seconds of budget from the configured
+    ``request_deadline_ms`` (0 = none), which an ``X-PIO-Deadline-Ms``
+    header may only TIGHTEN. Malformed headers (non-numeric, nan/inf,
+    <= 0) raise ``ValueError`` — a silent 1ms budget would 503 forever,
+    so the caller maps it to a 400."""
+    budget = (config_deadline_ms / 1e3 if config_deadline_ms > 0 else None)
+    raw = headers.get("x-pio-deadline-ms")
+    if raw:
+        try:
+            value = float(raw)
+        except ValueError:
+            value = float("nan")
+        if not math.isfinite(value) or value <= 0:
+            raise ValueError(f"invalid X-PIO-Deadline-Ms: {raw!r}")
+        client = max(0.001, value / 1e3)
+        budget = client if budget is None else min(budget, client)
+    return budget
 
 
 def access_log_enabled(override: bool | None = None) -> bool:
@@ -145,10 +169,23 @@ class _PioHTTPServer(ThreadingHTTPServer):
     # stress test); match a production accept queue
     request_queue_size = 128
 
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
+    def __init__(self, addr, handler, reuse_port: bool = False):
+        # set BEFORE super().__init__: TCPServer binds inside it and
+        # server_bind reads the flag
+        self.reuse_port = reuse_port
+        super().__init__(addr, handler)
         self.client_disconnects = 0
         self._disconnect_lock = threading.Lock()
+
+    def server_bind(self):
+        if self.reuse_port:
+            # SO_REUSEPORT: N worker processes share one listen port,
+            # the kernel spreads connections across them — how the
+            # fleet router scales past one interpreter's GIL
+            # (`pio router --workers N`; docs/fleet.md)
+            self.socket.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEPORT, 1)
+        super().server_bind()
 
     def handle_error(self, request, client_address):
         # A client that goes away mid-request/response is a non-event in
@@ -209,14 +246,16 @@ class RestServer:
     bind_backoff = RetryPolicy(base_delay=1.0, max_delay=2.0,
                                jitter_floor=0.5)
 
-    def __init__(self, handler_cls: type, service, ip: str, port: int):
+    def __init__(self, handler_cls: type, service, ip: str, port: int,
+                 reuse_port: bool = False):
         self.ip = ip
         self.service = service
         handler = type("BoundHandler", (handler_cls,), {"service": service})
         rng = random.Random()
         for attempt in range(self.bind_retries):
             try:
-                self._httpd = _PioHTTPServer((ip, port), handler)
+                self._httpd = _PioHTTPServer((ip, port), handler,
+                                             reuse_port=reuse_port)
                 break
             except OSError:
                 if attempt == self.bind_retries - 1:

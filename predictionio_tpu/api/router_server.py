@@ -1,0 +1,409 @@
+"""The Fleet Router server: ``pio router`` on :8100 (docs/fleet.md).
+
+A thin HTTP process fronting N engine-server replicas. Routes:
+
+- ``POST /queries.json``   forwarded to a healthy replica (retry on a
+                           different one, optional hedging, canary
+                           split) — body bytes pass through untouched
+                           in BOTH directions: the router never pays a
+                           JSON parse on the hot path
+- ``GET /``, ``GET /fleet`` fleet status document: per-backend state,
+                           breaker, in-flight, canary, router counters
+- ``GET|POST /fleet/canary`` canary admin: read the rollout state;
+                           POST ``{"weight": 25}`` to start/resize,
+                           ``{"action": "abort"}`` to kill it
+                           (key-authenticated when ``--router-key``)
+- ``GET /healthz``         router process liveness
+- ``GET /readyz``          503 until at least one replica is routable
+- ``GET /stats.json``      router counters + upstream latency
+- ``GET /metrics``         Prometheus exposition (backend state gauge,
+                           retries/hedges/sheds, canary weight, the
+                           per-replica breaker families)
+- ``POST /stop``           shutdown (key-authenticated)
+
+Correlation: an inbound ``X-PIO-Request-Id`` is propagated to the
+chosen replica and echoed on the response; the replica's
+``X-PIO-Trace-Id`` (when it traced the query) passes back to the
+client. The HTTP handler goes one step beyond the engine server's
+hot-path discipline (keep-alive, TCP_NODELAY, chunked-body rejection):
+the router sits on EVERY fleet query and does no model work to hide
+parse costs behind, so its connection loop is a minimal single-buffer
+parser with ONE write per response instead of the stdlib
+``BaseHTTPRequestHandler`` machinery (``_read_request`` docstring).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socketserver
+import threading
+import time
+from typing import Mapping
+from urllib.parse import parse_qs
+
+from predictionio_tpu.api.http_base import (
+    REQUEST_ID_HEADER,
+    PlainTextPayload,
+    RestServer,
+    access_log_enabled,
+    emit_access_log,
+    ensure_access_log_handler,
+    resolve_request_id,
+)
+from predictionio_tpu.fleet.canary import GuardrailConfig
+from predictionio_tpu.fleet.router import (
+    FleetRouter,
+    RouterConfig,
+    RouterResponse,
+)
+from predictionio_tpu.fleet.stats import router_collector
+from predictionio_tpu.obs.exporter import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from predictionio_tpu.obs.exporter import render_prometheus
+from predictionio_tpu.obs.registry import (
+    HistogramFamily,
+    MetricRegistry,
+    resilience_collector,
+    server_info_collector,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class _Reject(Exception):
+    def __init__(self, status: int, message: str,
+                 headers: dict[str, str] | None = None):
+        self.status = status
+        self.message = message
+        self.headers = headers
+
+
+class RouterService:
+    """Transport-free request logic over a :class:`FleetRouter`."""
+
+    def __init__(self, router: FleetRouter):
+        self.router = router
+        self.config = router.config
+        self.on_stop = lambda: None
+        self.access_log = access_log_enabled(self.config.access_log)
+        if self.access_log:
+            ensure_access_log_handler()
+        self.request_latency = HistogramFamily(
+            "pio_http_request_seconds",
+            "HTTP request walltime by route (handler-measured)",
+            "route", ("queries", "fleet", "metrics", "status"))
+        self.registry = MetricRegistry()
+        self.registry.register(self.request_latency.collect)
+        self.registry.register(router_collector(
+            router.stats, router.membership, router.canary))
+        self.registry.register(resilience_collector())
+        self.registry.register(server_info_collector("router"))
+
+    # -- auth ---------------------------------------------------------------
+    def _check_router_key(self, params: Mapping[str, str]) -> None:
+        if self.config.router_key is None:
+            return
+        if params.get("accessKey") != self.config.router_key:
+            raise _Reject(401, "invalid accessKey")
+
+    # -- routes -------------------------------------------------------------
+    def handle(self, method: str, path: str, params: Mapping[str, str],
+               headers: Mapping[str, str], body: bytes,
+               request_id: str) -> RouterResponse | tuple:
+        """Returns a RouterResponse (raw passthrough) or the engine
+        server's ``(status, payload[, headers])`` tuple shape."""
+        try:
+            if method == "POST" and path == "/queries.json":
+                return self.router.route(body, headers, request_id)
+            if method == "GET" and path in ("/", "/fleet"):
+                return (200, self.fleet_doc())
+            if method == "GET" and path == "/stats.json":
+                return (200, {"router": self.router.stats.snapshot(),
+                              "canary": self.router.canary.snapshot()})
+            if method == "GET" and path == "/metrics":
+                return (200, PlainTextPayload(
+                    render_prometheus(self.registry),
+                    PROMETHEUS_CONTENT_TYPE))
+            if method == "GET" and path == "/healthz":
+                return (200, {"status": "ok"})
+            if method == "GET" and path == "/readyz":
+                return self.readyz()
+            if path == "/fleet/canary":
+                if method == "GET":
+                    return (200, self.router.canary.snapshot())
+                if method == "POST":
+                    self._check_router_key(params)
+                    return self.canary_admin(body)
+            if method == "POST" and path == "/stop":
+                self._check_router_key(params)
+                threading.Thread(target=self.on_stop, daemon=True).start()
+                return (200, {"message": "Shutting down"})
+            return (404, {"message": f"no route for {method} {path}"})
+        except _Reject as r:
+            if r.headers:
+                return (r.status, {"message": r.message}, r.headers)
+            return (r.status, {"message": r.message})
+        except Exception as e:
+            logger.exception("unhandled error in %s %s", method, path)
+            return (500, {"message": f"internal error: {e}"})
+
+    def readyz(self) -> tuple:
+        """Ready iff at least one replica is routable — a router with
+        no backends must drain from ITS OWN load balancer too."""
+        routable = len(self.router.membership.routable())
+        if routable > 0:
+            return (200, {"status": "ready", "routableBackends": routable})
+        return (503, {"status": "unavailable", "routableBackends": 0},
+                {"Retry-After": f"{max(1, round(self.router.membership.probe_interval_s)):d}"})
+
+    def fleet_doc(self) -> dict:
+        return {
+            "status": "alive",
+            "backends": self.router.membership.snapshot(),
+            "canary": self.router.canary.snapshot(),
+            "router": self.router.stats.snapshot(),
+            "inflight": self.router.inflight,
+            "maxInflight": self.config.max_inflight,
+            "hedge": self.config.hedge,
+            "probe": {
+                "intervalS": self.router.membership.probe_interval_s,
+                "timeoutS": self.router.membership.probe_timeout_s,
+                "downAfter": self.router.membership.down_after,
+                "upAfter": self.router.membership.up_after,
+            },
+        }
+
+    def canary_admin(self, body: bytes) -> tuple:
+        """POST /fleet/canary: ``{"weight": <0..100>[, "guardrail":
+        {...}]}`` starts/resizes a rollout (clearing any abort latch);
+        ``{"action": "abort"}`` kills it."""
+        try:
+            doc = json.loads(body or b"{}")
+        except json.JSONDecodeError:
+            raise _Reject(400, "the request body is not valid JSON")
+        if not isinstance(doc, dict):
+            raise _Reject(400, "the request body must be a JSON object")
+        if doc.get("action") == "abort":
+            self.router.canary.abort()
+            return (200, self.router.canary.snapshot())
+        if "weight" not in doc:
+            raise _Reject(400, 'expected {"weight": <0..100>} or '
+                               '{"action": "abort"}')
+        try:
+            weight = float(doc["weight"])
+        except (TypeError, ValueError):
+            raise _Reject(400, f"invalid weight: {doc['weight']!r}")
+        if not 0.0 <= weight <= 100.0:
+            raise _Reject(400, "weight must be within 0..100")
+        guardrail = None
+        if isinstance(doc.get("guardrail"), dict):
+            g = doc["guardrail"]
+            current = self.router.canary.guardrail
+            try:
+                guardrail = GuardrailConfig(
+                    min_requests=int(g.get("minRequests",
+                                           current.min_requests)),
+                    max_error_rate=float(g.get("maxErrorRate",
+                                               current.max_error_rate)),
+                    max_p99_ms=float(g.get("maxP99Ms", current.max_p99_ms)),
+                    window=int(g.get("window", current.window)),
+                )
+            except (TypeError, ValueError) as exc:
+                raise _Reject(400, f"invalid guardrail: {exc}")
+        self.router.canary.set_weight(weight, guardrail=guardrail)
+        logger.info("canary weight set to %.1f%%", weight)
+        return (200, self.router.canary.snapshot())
+
+
+#: canned reason phrases for the statuses the router emits (the full
+#: http.HTTPStatus table costs a lookup per response; this is a dict hit)
+_REASONS = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
+            404: "Not Found", 411: "Length Required",
+            500: "Internal Server Error", 502: "Bad Gateway",
+            503: "Service Unavailable"}
+
+_MAX_HEADER_BYTES = 64 * 1024
+
+
+class _BadRequest(Exception):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        self.message = message
+
+
+def _read_request(sock, buf: bytearray):
+    """One inbound request off a keep-alive socket: ``(method, target,
+    lower-cased header dict, body bytes)``; None on clean EOF at a
+    message boundary. Raises ``_BadRequest`` (answer-and-close) on a
+    malformed message, ``OSError``/``TimeoutError`` on transport death.
+
+    The stdlib ``BaseHTTPRequestHandler`` costs ~1-2ms CPU per request
+    (readline loop + email-parser headers + per-response strftime) —
+    the same measurement that drove bench_serving.py's raw-socket
+    clients. The router sits on EVERY fleet query, so its inbound hot
+    path uses the same minimal single-buffer parse as its upstream
+    transport; the engine server keeps the stdlib handler (its predict
+    work dwarfs the parse; the router's doesn't)."""
+    while True:
+        head_end = buf.find(b"\r\n\r\n")
+        if head_end >= 0:
+            break
+        if len(buf) > _MAX_HEADER_BYTES:
+            raise _BadRequest(400, "oversized request headers")
+        chunk = sock.recv(65536)
+        if not chunk:
+            if buf:
+                raise _BadRequest(400, "truncated request")
+            return None
+        buf += chunk
+    head = bytes(buf[:head_end]).decode("latin-1")
+    lines = head.split("\r\n")
+    parts = lines[0].split(" ")
+    if len(parts) != 3 or not parts[2].startswith("HTTP/"):
+        raise _BadRequest(400, f"malformed request line {lines[0]!r}")
+    method, target = parts[0], parts[1]
+    headers: dict[str, str] = {}
+    for line in lines[1:]:
+        name, sep, value = line.partition(":")
+        if sep:
+            headers[name.strip().lower()] = value.strip()
+    if headers.get("transfer-encoding"):
+        # chunked bodies would desync every later request on the
+        # socket — 411 and close (RFC 9112 §6.3)
+        raise _BadRequest(
+            411, "chunked request bodies are not supported; "
+                 "send Content-Length")
+    length_raw = headers.get("content-length", "0")
+    if not length_raw.isdigit():
+        raise _BadRequest(400, "invalid Content-Length")
+    need = head_end + 4 + int(length_raw)
+    while len(buf) < need:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise _BadRequest(400, "request body truncated")
+        buf += chunk
+    body = bytes(buf[head_end + 4:need])
+    del buf[:need]
+    return method, target, headers, body
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    """Lean connection loop: minimal parse → service → ONE buffered
+    write per response (status line, headers, body in a single
+    sendall), keep-alive by default, 30s idle reap. Bound to the
+    service by RestServer exactly like the stdlib handlers."""
+
+    service: RouterService  # bound per server
+    timeout = 30
+    disable_nagle_algorithm = True
+
+    _ROUTE_LABELS = {
+        "/queries.json": "queries",
+        "/fleet": "fleet",
+        "/fleet/canary": "fleet",
+        "/metrics": "metrics",
+        "/": "status",
+    }
+
+    def handle(self) -> None:
+        sock = self.connection
+        buf = bytearray()
+        while True:
+            try:
+                parsed = _read_request(sock, buf)
+            except _BadRequest as bad:
+                self._send(sock, bad.status,
+                           json.dumps({"message": bad.message}).encode(),
+                           "application/json; charset=UTF-8",
+                           {"Connection": "close"}, None)
+                return
+            except OSError:     # incl. the 30s idle-timeout reap
+                return
+            if parsed is None:
+                return          # clean close between requests
+            if not self._dispatch(sock, *parsed):
+                return
+
+    def _dispatch(self, sock, method: str, target: str,
+                  headers: Mapping[str, str], body: bytes) -> bool:
+        """Route one request; returns False when the connection must
+        close (client asked, or the write failed)."""
+        t_start = time.perf_counter()
+        path, _, query = target.partition("?")
+        request_id = resolve_request_id(headers)
+        params = ({k: v[0] for k, v in parse_qs(query).items()}
+                  if query else {})
+        status = 500
+        try:
+            result = self.service.handle(
+                method, path, params, headers, body, request_id)
+            if isinstance(result, RouterResponse):
+                status = result.status
+                ok = self._send(sock, status, result.body,
+                                result.content_type, result.headers,
+                                request_id)
+            else:
+                status, payload, *extra = result
+                if isinstance(payload, PlainTextPayload):
+                    data = str(payload).encode()
+                    ctype = payload.content_type
+                else:
+                    data = json.dumps(payload).encode()
+                    ctype = "application/json; charset=UTF-8"
+                ok = self._send(sock, status, data, ctype,
+                                extra[0] if extra else None, request_id)
+        finally:
+            dt = time.perf_counter() - t_start
+            self.service.request_latency.observe(
+                self._ROUTE_LABELS.get(path, "other"), dt)
+            if self.service.access_log:
+                emit_access_log(
+                    "router", method, path, status, dt, request_id,
+                    client=self.client_address[0])
+        return ok and headers.get("connection", "").lower() != "close"
+
+    def _send(self, sock, status: int, body: bytes, ctype: str,
+              extra_headers: Mapping[str, str] | None,
+              request_id: str | None) -> bool:
+        reason = _REASONS.get(status, "Unknown")
+        lines = [f"HTTP/1.1 {status} {reason}",
+                 f"Content-Type: {ctype}",
+                 f"Content-Length: {len(body)}"]
+        if request_id:
+            lines.append(f"{REQUEST_ID_HEADER}: {request_id}")
+        for k, v in (extra_headers or {}).items():
+            lines.append(f"{k}: {v}")
+        blob = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1") + body
+        try:
+            sock.sendall(blob)
+            return True
+        except OSError:
+            return False
+
+
+class RouterServer(RestServer):
+    """HTTP lifecycle around :class:`RouterService` — starts the
+    membership probe loop with the listener, stops both on shutdown."""
+
+    log_label = "Fleet Router"
+    thread_name = "pio-routerserver"
+
+    def __init__(self, config: RouterConfig,
+                 router: FleetRouter | None = None):
+        self.config = config
+        self.router = router or FleetRouter(config)
+        super().__init__(_Handler, RouterService(self.router),
+                         config.ip, config.port,
+                         reuse_port=config.reuse_port)
+        self.service.on_stop = self.stop
+
+    def start(self) -> None:
+        self.router.start()
+        super().start()
+
+    def serve_forever(self) -> None:
+        self.router.start()
+        super().serve_forever()
+
+    def _on_close(self) -> None:
+        self.router.close()

@@ -65,6 +65,84 @@ def _cmd_eventserver(args, storage: Storage) -> int:
     return 0
 
 
+def _router_worker(config) -> None:
+    """One extra `pio router --workers N` worker process: a full
+    RouterServer on the shared SO_REUSEPORT listen port."""
+    from predictionio_tpu.api.router_server import RouterServer
+
+    server = RouterServer(config)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+def _cmd_router(args, storage: Storage) -> int:
+    """`pio router` — the fleet tier (docs/fleet.md): a thin router
+    fronting N engine-server replicas with health-driven membership,
+    weighted canary rollout, hedged retries, and bounded admission.
+    Storage-free: the router talks HTTP to its replicas, never to the
+    event/metadata stores."""
+    import dataclasses
+
+    from predictionio_tpu.api.router_server import RouterServer
+    from predictionio_tpu.fleet.router import RouterConfig
+
+    if not args.backend:
+        print("[ERROR] at least one --backend host:port is required.")
+        return 1
+    workers = max(1, args.workers or 1)
+    config = RouterConfig(
+        ip=args.ip,
+        port=args.port,
+        backends=tuple(args.backend),
+        canary_backends=tuple(args.canary_backend or ()),
+        router_key=args.router_key,
+        access_log=args.access_log,
+        reuse_port=workers > 1,
+        **{k: v for k, v in {
+            "probe_interval_s": args.probe_interval_s,
+            "down_after": args.down_after,
+            "up_after": args.up_after,
+            "max_inflight": args.max_inflight,
+            "request_deadline_ms": args.request_deadline_ms,
+            "hedge": args.hedge,
+            "canary_weight_pct": args.canary_weight,
+        }.items() if v is not None},
+    )
+    worker_procs = []
+    if workers > 1:
+        import multiprocessing
+        import socket as _socket
+
+        if config.port == 0:
+            # every worker must share ONE concrete port; resolve the
+            # ephemeral request before forking
+            probe = _socket.socket()
+            probe.bind((config.ip, 0))
+            config = dataclasses.replace(config,
+                                         port=probe.getsockname()[1])
+            probe.close()
+        for _ in range(workers - 1):
+            proc = multiprocessing.Process(
+                target=_router_worker, args=(config,), daemon=True)
+            proc.start()
+            worker_procs.append(proc)
+    server = RouterServer(config)
+    print(f"[INFO] Fleet Router listening on {args.ip}:{server.port} "
+          f"({len(config.backends)} stable / "
+          f"{len(config.canary_backends)} canary backend(s), "
+          f"{workers} worker(s))")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    finally:
+        for proc in worker_procs:
+            proc.terminate()
+    return 0
+
+
 def _cmd_app(args, storage: Storage) -> int:
     """Parity: commands/App.scala:25-365."""
     apps = storage.get_meta_data_apps()
@@ -282,6 +360,50 @@ def build_parser() -> argparse.ArgumentParser:
                    help="structured JSON access logs (method, path, "
                         "status, latency_ms, request_id)")
 
+    p = sub.add_parser(
+        "router",
+        help="launch the fleet router fronting N engine-server replicas "
+             "(docs/fleet.md)",
+    )
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--backend", action="append", metavar="HOST:PORT",
+                   help="stable replica address (repeatable; required)")
+    p.add_argument("--canary-backend", action="append", metavar="HOST:PORT",
+                   dest="canary_backend",
+                   help="canary replica address (repeatable)")
+    p.add_argument("--canary-weight", type=float, default=None,
+                   dest="canary_weight", metavar="PCT",
+                   help="initial %% of traffic routed to the canary group")
+    # None falls through to RouterConfig's PIO_ROUTER_* env-aware
+    # defaults (the ServerConfig discipline — no re-hard-coding here)
+    p.add_argument("--probe-interval-s", type=float, default=None,
+                   dest="probe_interval_s")
+    p.add_argument("--down-after", type=int, default=None, dest="down_after",
+                   help="consecutive failed probes before mark-down")
+    p.add_argument("--up-after", type=int, default=None, dest="up_after",
+                   help="consecutive good probes before mark-up")
+    p.add_argument("--max-inflight", type=int, default=None,
+                   dest="max_inflight",
+                   help="bounded admission: concurrent in-flight requests")
+    p.add_argument("--request-deadline-ms", type=float, default=None,
+                   dest="request_deadline_ms")
+    p.add_argument("--hedge", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="tail-latency hedging: fire a second attempt on "
+                        "another replica after a p99-derived delay")
+    p.add_argument("--router-key", default=None, dest="router_key",
+                   help="when set, /fleet/canary and /stop require this key")
+    p.add_argument("--workers", type=int, default=1,
+                   help="router worker processes sharing the listen "
+                        "port via SO_REUSEPORT (one CPython process "
+                        "tops out on its GIL long before the fleet "
+                        "does); each worker probes and holds canary "
+                        "state independently — see docs/fleet.md")
+    p.add_argument("--access-log", action=argparse.BooleanOptionalAction,
+                   default=None, dest="access_log",
+                   help="structured JSON access logs")
+
     p = sub.add_parser("app", help="app administration")
     app_sub = p.add_subparsers(dest="app_command", required=True)
     pn = app_sub.add_parser("new")
@@ -342,13 +464,15 @@ def build_parser() -> argparse.ArgumentParser:
 COMPUTE_COMMANDS = frozenset({"train", "eval", "deploy", "run"})
 
 #: commands that never touch storage — they must work (CI lint hooks,
-#: version probes) even when PIO_STORAGE_* env is broken or absent
-STORAGE_FREE_COMMANDS = frozenset({"version", "lint"})
+#: version probes, the storage-free fleet router) even when
+#: PIO_STORAGE_* env is broken or absent
+STORAGE_FREE_COMMANDS = frozenset({"version", "lint", "router"})
 
 _COMMANDS = {
     "version": _cmd_version,
     "status": _cmd_status,
     "eventserver": _cmd_eventserver,
+    "router": _cmd_router,
     "app": _cmd_app,
     "accesskey": _cmd_accesskey,
     "lint": _cmd_lint,

@@ -1,0 +1,54 @@
+"""The fleet tier: a thin router process fronting N engine-server
+replicas (ROADMAP item 5; docs/fleet.md).
+
+One engine server per deployed engine is the single-process ceiling;
+serving heavy traffic needs a layer that survives replica death, slow
+nodes, and bad model rollouts without returning 5xx. The router is that
+layer — Clipper-style fault isolation between clients and model
+servers, built from the primitives the repo already proved out:
+
+- :mod:`predictionio_tpu.fleet.membership` — health-driven membership:
+  every backend's ``/healthz`` + ``/readyz`` probed on a background
+  loop with mark-down/mark-up hysteresis;
+- :mod:`predictionio_tpu.fleet.canary` — weighted canary rollout of a
+  new model generation with guardrail auto-abort;
+- :mod:`predictionio_tpu.fleet.router` — the routing core: per-backend
+  circuit breaker + one transparent retry on a *different* healthy
+  replica, optional tail-latency hedging, bounded in-flight admission,
+  end-to-end ``X-PIO-Deadline-Ms`` propagation;
+- :mod:`predictionio_tpu.fleet.transport` — the lean upstream HTTP
+  client (pooled keep-alive sockets, single-write requests);
+- :mod:`predictionio_tpu.api.router_server` — the HTTP surface
+  (``pio router``).
+"""
+
+from predictionio_tpu.fleet.canary import CanaryController, GuardrailConfig
+from predictionio_tpu.fleet.membership import (
+    DOWN,
+    UP,
+    Backend,
+    BackendSpec,
+    FleetMembership,
+)
+from predictionio_tpu.fleet.router import (
+    FleetRouter,
+    HedgePolicy,
+    RouterConfig,
+    RouterResponse,
+)
+from predictionio_tpu.fleet.stats import RouterStats
+
+__all__ = [
+    "Backend",
+    "BackendSpec",
+    "CanaryController",
+    "DOWN",
+    "FleetMembership",
+    "FleetRouter",
+    "GuardrailConfig",
+    "HedgePolicy",
+    "RouterConfig",
+    "RouterResponse",
+    "RouterStats",
+    "UP",
+]

@@ -1,0 +1,293 @@
+"""Health-driven fleet membership with mark-down/mark-up hysteresis.
+
+Every backend's ``/healthz`` AND ``/readyz`` are probed on a background
+loop (PR 1 gave every server both surfaces; PR 6's engine server
+additionally reports not-ready while a ``/reload`` is in flight, so a
+replica mid-model-swap drains here automatically). Hysteresis keeps a
+flapping replica from oscillating the routing table: ``down_after``
+consecutive probe failures mark a backend DOWN, ``up_after``
+consecutive successes mark it UP again. A DOWN backend stops receiving
+routed traffic but keeps being probed — mark-up is automatic.
+
+The probe clock is injectable (:class:`~predictionio_tpu.utils.
+resilience.Clock`) and the loop can be driven synchronously
+(:meth:`FleetMembership.probe_once`) so hysteresis transitions are
+deterministic in tests without wall-time sleeps.
+
+Concurrency: per-:class:`Backend` mutable state (probe streaks, state,
+in-flight count) sits under the backend's own lock; the membership
+object itself is immutable after construction apart from the loop
+thread handle. Handler threads read state through the locked accessors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Sequence
+
+from predictionio_tpu.fleet.transport import BackendTransport
+from predictionio_tpu.utils.resilience import (
+    SYSTEM_CLOCK,
+    CircuitBreaker,
+    Clock,
+    Resilience,
+    RetryPolicy,
+)
+
+logger = logging.getLogger(__name__)
+
+UP, DOWN = "up", "down"
+
+STABLE, CANARY = "stable", "canary"
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendSpec:
+    """One replica's address and rollout group, parsed from
+    ``host:port`` (stable) / ``pio router --canary-backend`` (canary)."""
+
+    host: str
+    port: int
+    group: str = STABLE
+    id: str = ""
+
+    def __post_init__(self):
+        if not self.id:
+            object.__setattr__(self, "id", f"{self.host}:{self.port}")
+
+    @classmethod
+    def parse(cls, addr: str, group: str = STABLE) -> "BackendSpec":
+        host, sep, port = addr.rpartition(":")
+        if not sep or not port.isdigit():
+            raise ValueError(f"backend address {addr!r} is not host:port")
+        return cls(host=host or "127.0.0.1", port=int(port), group=group)
+
+
+class Backend:
+    """One replica: transport pool, resilience policy (breaker), and
+    lock-guarded membership state."""
+
+    def __init__(self, spec: BackendSpec,
+                 breaker_threshold: int = 3,
+                 breaker_reset_s: float = 5.0,
+                 clock: Clock = SYSTEM_CLOCK):
+        self.spec = spec
+        self.transport = BackendTransport(spec.host, spec.port)
+        #: max_attempts=1 — the ROUTER owns retries (on a different
+        #: replica, never this one); the policy contributes breaker
+        #: accounting and failure classification per attempt
+        self.resilience = Resilience(
+            f"router/{spec.id}",
+            policy=RetryPolicy(max_attempts=1),
+            breaker=CircuitBreaker(
+                f"router/{spec.id}",
+                failure_threshold=breaker_threshold,
+                reset_timeout=breaker_reset_s,
+                clock=clock),
+            clock=clock,
+        )
+        self._lock = threading.Lock()
+        self._state = UP
+        self._ok_streak = 0
+        self._fail_streak = 0
+        self._last_error: str | None = None
+        self._inflight = 0
+        self._transitions = 0
+
+    # -- membership state (locked at writers and readers) -------------------
+    @property
+    def id(self) -> str:
+        return self.spec.id
+
+    @property
+    def group(self) -> str:
+        return self.spec.group
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def is_routable(self) -> bool:
+        """UP and not breaker-open. A half-open breaker stays routable:
+        its single admitted probe is exactly how the breaker re-learns
+        the replica's health."""
+        with self._lock:
+            if self._state != UP:
+                return False
+        breaker = self.resilience.breaker
+        return breaker is None or breaker.state != "open"
+
+    def begin(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def done(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    def record_probe(self, ok: bool, error: str | None,
+                     down_after: int, up_after: int) -> str | None:
+        """Fold one probe result into the hysteresis streaks. Returns
+        the new state when a transition happened, else None."""
+        with self._lock:
+            if ok:
+                self._ok_streak += 1
+                self._fail_streak = 0
+                self._last_error = None
+                if self._state == DOWN and self._ok_streak >= up_after:
+                    self._state = UP
+                    self._transitions += 1
+                    return UP
+            else:
+                self._fail_streak += 1
+                self._ok_streak = 0
+                self._last_error = error
+                if self._state == UP and self._fail_streak >= down_after:
+                    self._state = DOWN
+                    self._transitions += 1
+                    return DOWN
+        return None
+
+    def mark_down(self, error: str) -> bool:
+        """Immediate mark-down from the DATA path (a forward failed
+        hard) — the probe loop will mark it back up. Returns True on an
+        actual transition."""
+        with self._lock:
+            self._ok_streak = 0
+            self._last_error = error
+            if self._state == UP:
+                self._state = DOWN
+                self._transitions += 1
+                return True
+        return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            doc = {
+                "id": self.spec.id,
+                "group": self.spec.group,
+                "state": self._state,
+                "inflight": self._inflight,
+                "okStreak": self._ok_streak,
+                "failStreak": self._fail_streak,
+                "transitions": self._transitions,
+                **({"lastError": self._last_error}
+                   if self._last_error else {}),
+            }
+        breaker = self.resilience.breaker
+        if breaker is not None:
+            doc["breaker"] = {"state": breaker.state, "opens": breaker.opens}
+        return doc
+
+    def close(self) -> None:
+        self.transport.close()
+
+
+class FleetMembership:
+    """The probe loop + routable-backend views (module docstring)."""
+
+    def __init__(self, backends: Sequence[Backend],
+                 probe_interval_s: float = 1.0,
+                 probe_timeout_s: float = 1.0,
+                 down_after: int = 2,
+                 up_after: int = 2):
+        self.backends = list(backends)
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.down_after = max(1, down_after)
+        self.up_after = max(1, up_after)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- views --------------------------------------------------------------
+    def routable(self, group: str | None = None,
+                 exclude: frozenset[str] | tuple = ()) -> list[Backend]:
+        return [
+            b for b in self.backends
+            if (group is None or b.group == group)
+            and b.id not in exclude
+            and b.is_routable()
+        ]
+
+    def by_id(self, backend_id: str) -> Backend | None:
+        return next((b for b in self.backends if b.id == backend_id), None)
+
+    def snapshot(self) -> list[dict]:
+        return [b.snapshot() for b in self.backends]
+
+    # -- probing ------------------------------------------------------------
+    def probe_backend(self, backend: Backend) -> tuple[bool, str | None]:
+        """One health probe: ``/healthz`` then ``/readyz``, both must
+        answer 200 inside ``probe_timeout_s`` each."""
+        for path in ("/healthz", "/readyz"):
+            try:
+                response = backend.transport.request(
+                    "GET", path, timeout=self.probe_timeout_s)
+            except Exception as exc:  # transport/protocol failures
+                return False, f"{path}: {exc}"
+            if response.status != 200:
+                return False, f"{path}: HTTP {response.status}"
+        return True, None
+
+    def _probe_and_record(self, backend: Backend) -> None:
+        ok, error = self.probe_backend(backend)
+        transition = backend.record_probe(
+            ok, error, self.down_after, self.up_after)
+        if transition is not None:
+            log = logger.warning if transition == DOWN else logger.info
+            log("fleet backend %s marked %s%s", backend.id, transition,
+                f" ({error})" if error else "")
+
+    def probe_once(self) -> None:
+        """One synchronous probe pass over every backend — the loop
+        body, also the deterministic test hook. Backends are probed
+        CONCURRENTLY: a black-holed replica eats its own probe timeout,
+        not everyone else's — sequential probing made one partitioned
+        backend stretch every pass by its timeout, delaying mark-down
+        and mark-up of healthy-streak transitions fleet-wide."""
+        if len(self.backends) <= 1:
+            for backend in self.backends:
+                self._probe_and_record(backend)
+            return
+        threads = [
+            threading.Thread(target=self._probe_and_record,
+                             args=(backend,), daemon=True,
+                             name=f"pio-fleet-probe-{backend.id}")
+            for backend in self.backends
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.probe_once()
+            # Event.wait doubles as the interval sleep AND the prompt
+            # stop signal (a bare sleep would hold stop() for a full
+            # interval)
+            self._stop.wait(self.probe_interval_s)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="pio-fleet-probe", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for backend in self.backends:
+            backend.close()

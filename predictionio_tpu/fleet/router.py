@@ -1,0 +1,571 @@
+"""The fleet routing core: pick, forward, retry, hedge, degrade.
+
+One request's life through :meth:`FleetRouter.route`:
+
+1. **admission** — bounded in-flight slots; a saturated fleet sheds
+   with 503 + Retry-After instead of queueing into collapse;
+2. **deadline** — ``X-PIO-Deadline-Ms`` (tightened by the router's own
+   ``request_deadline_ms``) becomes an absolute deadline; an already
+   -dead request is never forwarded, and every forward carries the
+   REMAINING budget so the backend's own expiry machinery (PR 1/PR 3)
+   sees the end-to-end number;
+3. **pick** — the canary controller splits traffic stable/canary by
+   weight; within the group the least-loaded routable replica wins
+   (UP per membership, breaker not open). A group with no routable
+   replica spills to the other group (degraded-but-correct, counted)
+   rather than failing the request;
+4. **forward** — the exchange runs under the backend's per-replica
+   :class:`~predictionio_tpu.utils.resilience.Resilience` (breaker
+   accounting, transient classification via the shared
+   ``is_transient_http_status`` contract);
+5. **hedge** (opt-in) — when the primary has not answered after a
+   p99-derived delay and a second routable replica exists, a hedge
+   fires there and the first answer wins (tail-latency insurance, The
+   Tail at Scale);
+6. **retry** — a failed or breaker-open replica gets ONE transparent
+   retry on a DIFFERENT routable replica, never the same one;
+7. **outcome** — canary guardrails fold the result in (5xx/transport
+   failures count against the canary; client-side 4xx do not) and may
+   auto-abort the rollout.
+
+A request only surfaces 5xx to the client when every routable replica
+failed it — "zero 5xx while a healthy replica exists" is the chaos
+suite's pinned invariant (tests/test_fleet_router.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
+from typing import Mapping
+
+from predictionio_tpu.api.http_base import parse_deadline_budget
+from predictionio_tpu.fleet.canary import CanaryController, GuardrailConfig
+from predictionio_tpu.fleet.membership import (
+    CANARY,
+    Backend,
+    BackendSpec,
+    FleetMembership,
+)
+from predictionio_tpu.fleet.stats import RouterStats
+from predictionio_tpu.fleet.transport import UpstreamResponse
+from predictionio_tpu.obs.histogram import LatencyHistogram
+from predictionio_tpu.utils.resilience import (
+    SYSTEM_CLOCK,
+    Clock,
+    StorageUnavailableError,
+    TransientError,
+    is_transient_http_status,
+    resilient,
+)
+
+logger = logging.getLogger(__name__)
+
+#: request headers the router forwards verbatim to the backend (plus
+#: the recomputed deadline and the correlation id)
+_FORWARD_HEADERS = ("content-type", "accept")
+
+
+class UpstreamStatusError(TransientError):
+    """The upstream ANSWERED with a transient status (5xx/429) — a
+    health signal for the breaker, but the response itself survives on
+    the exception so the router can still return it when no other
+    replica is available."""
+
+    def __init__(self, backend_id: str, response: UpstreamResponse):
+        super().__init__(f"upstream {backend_id} answered "
+                         f"HTTP {response.status}")
+        self.response = response
+
+
+@dataclasses.dataclass
+class RouterResponse:
+    """What the HTTP layer writes back: status, raw body bytes (passed
+    through, never re-encoded), content type, extra headers."""
+
+    status: int
+    body: bytes
+    content_type: str = "application/json; charset=UTF-8"
+    headers: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def error(cls, status: int, message: str,
+              headers: dict[str, str] | None = None) -> "RouterResponse":
+        import json
+
+        return cls(status, json.dumps({"message": message}).encode(),
+                   headers=headers or {})
+
+
+class HedgePolicy:
+    """When and how late to fire a tail-latency hedge.
+
+    The delay derives from the observed upstream latency distribution:
+    ``quantile`` (default p99) of everything the router has seen,
+    clamped to ``[min_delay_ms, max_delay_ms]``. Until ``min_samples``
+    observations exist the clamp floor applies — hedging too eagerly on
+    no data would double fleet load for nothing. Deterministic given
+    its observation history (pinned on ManualClock-style tests: no
+    clock reads, no randomness)."""
+
+    def __init__(self, min_delay_ms: float = 10.0,
+                 max_delay_ms: float = 500.0,
+                 quantile: float = 0.99,
+                 min_samples: int = 20):
+        self.min_delay_s = min_delay_ms / 1e3
+        self.max_delay_s = max_delay_ms / 1e3
+        self.quantile = quantile
+        self.min_samples = min_samples
+        self._latency = LatencyHistogram()
+
+    def observe(self, seconds: float) -> None:
+        self._latency.observe(seconds)
+
+    def delay_s(self) -> float:
+        """Seconds to wait for the primary before hedging."""
+        snap = self._latency.snapshot()
+        if snap.count < self.min_samples:
+            return self.min_delay_s
+        q = snap.quantile(self.quantile)
+        if q is None:
+            return self.min_delay_s
+        return min(self.max_delay_s, max(self.min_delay_s, q))
+
+    def should_hedge(self, alternates: int,
+                     remaining_budget: float | None) -> bool:
+        """A hedge needs somewhere to go and enough budget that the
+        hedged attempt could still answer in time."""
+        if alternates <= 0:
+            return False
+        if remaining_budget is not None \
+                and remaining_budget <= self.delay_s():
+            return False
+        return True
+
+
+def _env_default(key: str, default, cast):
+    import os
+
+    raw = os.environ.get(f"PIO_ROUTER_{key}")
+    if raw is None:
+        return default
+    try:
+        return cast(raw)
+    except (TypeError, ValueError):
+        logger.warning("ignoring malformed PIO_ROUTER_%s=%r (using %r)",
+                       key, raw, default)
+        return default
+
+
+def _env_field(key: str, default, cast):
+    """``PIO_ROUTER_<KEY>`` env-overridable frozen-dataclass default,
+    read at construction time (the ServerConfig discipline)."""
+    return dataclasses.field(
+        default_factory=lambda: _env_default(key, default, cast))
+
+
+def _cast_bool(raw: str) -> bool:
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """`pio router` knobs (docs/fleet.md has the full table)."""
+
+    ip: str = "0.0.0.0"
+    port: int = 8100
+    #: stable replica addresses, ``host:port``
+    backends: tuple[str, ...] = ()
+    #: canary replica addresses (the new model generation)
+    canary_backends: tuple[str, ...] = ()
+    #: membership probe loop (fleet/membership.py)
+    probe_interval_s: float = _env_field("PROBE_INTERVAL_S", 1.0, float)
+    probe_timeout_s: float = _env_field("PROBE_TIMEOUT_S", 1.0, float)
+    down_after: int = _env_field("DOWN_AFTER", 2, int)
+    up_after: int = _env_field("UP_AFTER", 2, int)
+    #: per-backend breaker (utils/resilience.CircuitBreaker)
+    breaker_threshold: int = _env_field("BREAKER_THRESHOLD", 3, int)
+    breaker_reset_s: float = _env_field("BREAKER_RESET_S", 5.0, float)
+    #: socket bound per upstream attempt (tightened by the deadline)
+    upstream_timeout_s: float = _env_field("UPSTREAM_TIMEOUT_S", 30.0, float)
+    #: bounded admission: concurrent requests in flight through the
+    #: router; beyond it requests shed with 503 + Retry-After
+    max_inflight: int = _env_field("MAX_INFLIGHT", 128, int)
+    #: router-imposed request budget (0 = none); clients may only
+    #: tighten via X-PIO-Deadline-Ms
+    request_deadline_ms: float = _env_field("REQUEST_DEADLINE_MS", 0.0, float)
+    #: tail-latency hedging (off by default: it spends fleet capacity)
+    hedge: bool = _env_field("HEDGE", False, _cast_bool)
+    hedge_min_delay_ms: float = _env_field("HEDGE_MIN_DELAY_MS", 10.0, float)
+    hedge_max_delay_ms: float = _env_field("HEDGE_MAX_DELAY_MS", 500.0, float)
+    #: initial canary traffic share (0..100) and guardrails
+    canary_weight_pct: float = _env_field("CANARY_WEIGHT_PCT", 0.0, float)
+    guardrail_min_requests: int = _env_field("GUARDRAIL_MIN_REQUESTS", 20, int)
+    guardrail_max_error_rate: float = _env_field(
+        "GUARDRAIL_MAX_ERROR_RATE", 0.5, float)
+    guardrail_max_p99_ms: float = _env_field("GUARDRAIL_MAX_P99_MS", 0.0, float)
+    guardrail_window: int = _env_field("GUARDRAIL_WINDOW", 200, int)
+    #: when set, /fleet/canary and /stop require ?accessKey=<router_key>
+    router_key: str | None = None
+    #: structured access logs; None defers to PIO_ACCESS_LOG
+    access_log: bool | None = None
+    #: bind with SO_REUSEPORT so N router worker processes share one
+    #: listen port (`pio router --workers N`): one CPython router tops
+    #: out on its GIL long before the fleet does — workers scale the
+    #: router tier horizontally exactly like replicas scale the model
+    #: tier. Caveat: each worker holds its own canary/membership state
+    #: (docs/fleet.md), so canary admin calls address ONE worker.
+    reuse_port: bool = False
+
+    def guardrail(self) -> GuardrailConfig:
+        return GuardrailConfig(
+            min_requests=self.guardrail_min_requests,
+            max_error_rate=self.guardrail_max_error_rate,
+            max_p99_ms=self.guardrail_max_p99_ms,
+            window=self.guardrail_window,
+        )
+
+
+class FleetRouter:
+    """Transport-free routing logic; the HTTP surface lives in
+    api/router_server.py."""
+
+    def __init__(self, config: RouterConfig,
+                 membership: FleetMembership | None = None,
+                 canary: CanaryController | None = None,
+                 stats: RouterStats | None = None,
+                 hedge_policy: HedgePolicy | None = None,
+                 clock: Clock = SYSTEM_CLOCK):
+        self.config = config
+        if membership is None:
+            backends = [
+                Backend(BackendSpec.parse(addr, group),
+                        breaker_threshold=config.breaker_threshold,
+                        breaker_reset_s=config.breaker_reset_s,
+                        clock=clock)
+                for group, addrs in (("stable", config.backends),
+                                     ("canary", config.canary_backends))
+                for addr in addrs
+            ]
+            membership = FleetMembership(
+                backends,
+                probe_interval_s=config.probe_interval_s,
+                probe_timeout_s=config.probe_timeout_s,
+                down_after=config.down_after,
+                up_after=config.up_after)
+        self.membership = membership
+        self.canary = canary or CanaryController(
+            weight_pct=config.canary_weight_pct,
+            guardrail=config.guardrail())
+        if (self.canary.weight_pct > 0.0
+                and not any(b.group == CANARY
+                            for b in self.membership.backends)):
+            # a positive weight with an empty canary set would send
+            # weight% of picks through the spill path forever: the
+            # group_spills alarm counter climbs on a healthy fleet and
+            # the guardrail can never evaluate (no canary ever serves)
+            logger.warning(
+                "canary weight %.1f%% configured with no canary "
+                "backends — forcing weight to 0", self.canary.weight_pct)
+            self.canary.set_weight(0.0)
+        self.stats = stats or RouterStats()
+        self.hedge_policy = hedge_policy or HedgePolicy(
+            min_delay_ms=config.hedge_min_delay_ms,
+            max_delay_ms=config.hedge_max_delay_ms)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        #: rotation tiebreak for the least-loaded pick: under light or
+        #: perfectly balanced load every replica's in-flight count is
+        #: zero and a bare min() would pin all traffic to the first
+        #: replica (itertools.count is a single C call, GIL-atomic)
+        self._rr = itertools.count()
+        #: hedge attempts run on pool threads so the handler can race
+        #: primary vs hedge; sized for two attempts per admitted request
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * config.max_inflight),
+            thread_name_prefix="pio-router-hedge")
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self.membership.start()
+
+    def close(self) -> None:
+        self.membership.stop()
+        self._pool.shutdown(wait=False)
+
+    # -- admission + deadline -----------------------------------------------
+    def _admit(self) -> bool:
+        with self._inflight_lock:
+            if self._inflight >= self.config.max_inflight:
+                return False
+            self._inflight += 1
+            return True
+
+    def _release(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def _deadline_budget(self, headers: Mapping[str, str]) -> float | None:
+        """Seconds of budget via the shared engine-server contract
+        (http_base.parse_deadline_budget: the client header may only
+        tighten). Raises ValueError on a malformed header (the
+        caller's 400)."""
+        return parse_deadline_budget(self.config.request_deadline_ms,
+                                     headers)
+
+    # -- the route ----------------------------------------------------------
+    def route(self, body: bytes, headers: Mapping[str, str],
+              request_id: str) -> RouterResponse:
+        """Forward one ``POST /queries.json`` (module docstring)."""
+        if not self._admit():
+            self.stats.bump("requests")
+            self.stats.bump("sheds")
+            return RouterResponse.error(
+                503, "fleet saturated; retry shortly",
+                {"Retry-After": "1"})
+        try:
+            try:
+                budget = self._deadline_budget(headers)
+            except ValueError as exc:
+                self.stats.bump("requests")
+                return RouterResponse.error(400, str(exc))
+            deadline = (time.monotonic() + budget
+                        if budget is not None else None)
+            group = self.canary.pick_group()
+            self.stats.bump_request(group)
+            return self._route_with_retry(group, body, headers,
+                                          request_id, deadline)
+        finally:
+            self._release()
+
+    def _remaining(self, deadline: float | None) -> float | None:
+        if deadline is None:
+            return None
+        return deadline - time.monotonic()
+
+    def _pick(self, group: str, exclude: set[str]) -> tuple[Backend | None, str]:
+        """Least-loaded routable replica in ``group``; an empty group
+        spills to the other one (counted). Returns (backend, group it
+        actually came from)."""
+        candidates = self.membership.routable(group, exclude=exclude)
+        actual = group
+        if not candidates:
+            other = "canary" if group == "stable" else "stable"
+            candidates = self.membership.routable(other, exclude=exclude)
+            if candidates:
+                self.stats.bump("group_spills")
+                actual = other
+        if not candidates:
+            return None, actual
+        # read each in-flight count ONCE: concurrent requests move the
+        # counts between reads, and a min()-then-filter over live reads
+        # can produce an empty tie set mid-burst
+        loads = [(b.inflight, b) for b in candidates]
+        lowest = min(load for load, _ in loads)
+        ties = [b for load, b in loads if load == lowest]
+        return ties[next(self._rr) % len(ties)], actual
+
+    def _route_with_retry(self, group: str, body: bytes,
+                          headers: Mapping[str, str], request_id: str,
+                          deadline: float | None) -> RouterResponse:
+        tried: set[str] = set()
+        last_failure: BaseException | None = None
+        for attempt in (0, 1):
+            remaining = self._remaining(deadline)
+            if remaining is not None and remaining <= 0:
+                self.stats.bump("expired")
+                return RouterResponse.error(
+                    503, "request deadline exceeded before a replica "
+                         "could answer", {"Retry-After": "1"})
+            backend, actual_group = self._pick(group, tried)
+            if backend is None:
+                if last_failure is not None:
+                    break  # no replica left to retry on
+                self.stats.bump("no_backend")
+                return RouterResponse.error(
+                    503, "no healthy replica available",
+                    {"Retry-After": f"{max(1, round(self.membership.probe_interval_s)):d}"})
+            if attempt > 0:
+                self.stats.bump("retries")
+            try:
+                response = self._forward(backend, actual_group, tried,
+                                         body, headers, request_id,
+                                         deadline)
+                return self._passthrough(response)
+            except StorageUnavailableError as exc:
+                self.stats.bump("upstream_errors")
+                last_failure = exc
+                tried.add(backend.id)
+                continue
+        # every routable replica failed: surface the most informative
+        # thing we have — a real upstream response when one exists,
+        # else a 502 naming the failure
+        response = _embedded_response(last_failure)
+        if response is not None:
+            return self._passthrough(response)
+        return RouterResponse.error(
+            502, f"all replicas failed: {last_failure}",
+            {"Retry-After": "1"})
+
+    def _passthrough(self, response: UpstreamResponse) -> RouterResponse:
+        out = RouterResponse(
+            status=response.status,
+            body=response.body,
+            content_type=response.header(
+                "content-type", "application/json; charset=UTF-8"),
+        )
+        for name in ("retry-after", "x-pio-trace-id"):
+            value = response.header(name)
+            if value:
+                out.headers["-".join(p.capitalize()
+                                     for p in name.split("-"))] = value
+        return out
+
+    # -- forwarding (single + hedged) ---------------------------------------
+    def _forward_headers(self, headers: Mapping[str, str],
+                         request_id: str,
+                         deadline: float | None) -> dict[str, str]:
+        fwd = {"X-PIO-Request-Id": request_id}
+        for name in _FORWARD_HEADERS:
+            value = headers.get(name)
+            if value:
+                fwd[name] = value
+        if deadline is not None:
+            # the REMAINING budget, floored at 1ms: the backend must
+            # see the end-to-end deadline, not the client's original
+            remaining_ms = max(1.0, (deadline - time.monotonic()) * 1e3)
+            fwd["X-PIO-Deadline-Ms"] = f"{remaining_ms:.0f}"
+        return fwd
+
+    def _exchange(self, backend: Backend, group: str,
+                  body: bytes, headers: Mapping[str, str],
+                  request_id: str,
+                  deadline: float | None) -> UpstreamResponse:
+        """ONE attempt against ONE replica under its resilience policy.
+        Raises StorageUnavailableError on transport failure, transient
+        status, or an open breaker; returns any other response."""
+
+        def attempt() -> UpstreamResponse:
+            nonlocal attempted
+            attempted = True
+            remaining = self._remaining(deadline)
+            timeout = self.config.upstream_timeout_s
+            if remaining is not None:
+                timeout = max(0.001, min(timeout, remaining))
+            response = backend.transport.request(
+                "POST", "/queries.json",
+                headers=self._forward_headers(headers, request_id, deadline),
+                body=body, timeout=timeout)
+            if is_transient_http_status(response.status):
+                # the shared retryability contract (utils/resilience):
+                # 5xx/429 are health signals; other statuses —
+                # including the backend's 4xx — are application answers
+                raise UpstreamStatusError(backend.id, response)
+            return response
+
+        backend.begin()
+        t0 = time.perf_counter()
+        ok = False
+        attempted = False
+        try:
+            response = resilient(backend.resilience, attempt)
+            ok = True
+            return response
+        except StorageUnavailableError as exc:
+            cause = exc.__cause__
+            if isinstance(cause, (ConnectionRefusedError,
+                                  ConnectionResetError)):
+                # nothing is listening / the peer died mid-exchange:
+                # don't wait for the probe loop to notice (it will
+                # mark it back up when the replica returns)
+                if backend.mark_down(str(cause)):
+                    logger.warning(
+                        "fleet backend %s marked down from the data "
+                        "path: %s", backend.id, cause)
+            raise
+        finally:
+            dt = time.perf_counter() - t0
+            backend.done()
+            if attempted:
+                # a breaker short-circuit never reached the replica:
+                # it says nothing about the replica's health, so it
+                # must not feed the canary guardrail window (a burst
+                # racing one half-open probe slot would spuriously
+                # abort a recovered canary) or the latency histograms
+                self.stats.observe_upstream(group, dt)
+                if ok and self.config.hedge:
+                    # the hedge-delay histogram only matters when
+                    # hedging can fire; disabled, its lock+bisect
+                    # stays off the path
+                    self.hedge_policy.observe(dt)
+                if self.canary.record(group, ok, dt):
+                    self.stats.bump("canary_aborts")
+
+    def _forward(self, backend: Backend, group: str, tried: set[str],
+                 body: bytes, headers: Mapping[str, str], request_id: str,
+                 deadline: float | None) -> UpstreamResponse:
+        """The primary exchange, optionally raced against one hedge."""
+        if not self.config.hedge:
+            return self._exchange(backend, group, body, headers,
+                                  request_id, deadline)
+        remaining = self._remaining(deadline)
+        alternates = self.membership.routable(
+            group, exclude=tried | {backend.id})
+        if not self.hedge_policy.should_hedge(len(alternates), remaining):
+            return self._exchange(backend, group, body, headers,
+                                  request_id, deadline)
+        primary: Future = self._pool.submit(
+            self._exchange, backend, group, body, headers, request_id,
+            deadline)
+        done, _ = wait([primary], timeout=self.hedge_policy.delay_s())
+        if done:
+            tried.add(backend.id)
+            return primary.result()  # raises through to the retry loop
+        hedge_backend = min(alternates, key=lambda b: b.inflight)
+        self.stats.bump("hedges")
+        hedge: Future = self._pool.submit(
+            self._exchange, hedge_backend, group, body, headers,
+            request_id, deadline)
+        tried.add(backend.id)
+        tried.add(hedge_backend.id)
+        pending = {primary, hedge}
+        failure: BaseException | None = None
+        while pending:
+            remaining = self._remaining(deadline)
+            done, pending = wait(
+                pending, timeout=remaining, return_when=FIRST_COMPLETED)
+            if not done:          # deadline expired while both pending
+                break
+            for fut in done:
+                exc = fut.exception()
+                if exc is None:
+                    if fut is hedge:
+                        self.stats.bump("hedge_wins")
+                    return fut.result()
+                failure = exc
+        if failure is not None:
+            raise failure
+        raise StorageUnavailableError(
+            "router/hedge", "deadline expired with attempts in flight",
+            retry_after=1.0)
+
+
+def _embedded_response(exc: BaseException | None) -> UpstreamResponse | None:
+    """The upstream response a failure carried, when the failure was a
+    transient HTTP status rather than a transport error."""
+    seen: set[int] = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if isinstance(exc, UpstreamStatusError):
+            return exc.response
+        exc = exc.__cause__
+    return None

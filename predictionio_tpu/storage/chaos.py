@@ -31,8 +31,12 @@ Registered in the storage registry as type ``chaos``. Config
 - ``ERROR`` (default ``chaos``) — injected class: ``chaos``
   (:class:`ChaosError`), ``connection`` (ConnectionError) or
   ``timeout`` (TimeoutError).
-- ``LATENCY_MS`` (default ``0``) — mean injected latency;
-  ``LATENCY_JITTER_MS`` adds a uniform spread.
+- ``LATENCY_MS`` (default ``0``; ``DELAY_MS`` is an alias) — mean
+  injected latency; ``LATENCY_JITTER_MS`` adds a uniform spread.
+- ``DELAY_PROB`` (default ``1.0``) — probability a call is delayed at
+  all, drawn from the same seeded stream as the faults: slow-backend
+  behavior (some calls slow, most fast — the long-tail shape that
+  defeats a fixed timeout) becomes testable deterministically.
 - the standard ``RETRY_*``/``BREAKER_*`` knobs (defaults here are
   retry-heavy: 12 attempts at 1ms base, breaker off) so a 30% fault
   rate is absorbed invisibly unless the operator tightens the policy.
@@ -82,6 +86,7 @@ class ChaosInjector:
         error: str = "chaos",
         latency_ms: float = 0.0,
         latency_jitter_ms: float = 0.0,
+        delay_prob: float = 1.0,
         clock: Clock = SYSTEM_CLOCK,
     ):
         if error not in _ERROR_CLASSES:
@@ -93,10 +98,14 @@ class ChaosInjector:
         self._error = _ERROR_CLASSES[error]
         self._latency = latency_ms / 1e3
         self._jitter = latency_jitter_ms / 1e3
+        #: probability a call is delayed at all (1.0 = every call, the
+        #: pre-PR 6 behavior); < 1.0 models a long-tail slow backend
+        self._delay_prob = delay_prob
         self._clock = clock
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self.faults_injected = 0
+        self.delays_injected = 0
         self.calls = 0
 
     def before(self, op: str) -> None:
@@ -106,7 +115,15 @@ class ChaosInjector:
             roll = self._rng.random()
             latency = 0.0
             if self._latency or self._jitter:
-                latency = self._latency + self._rng.uniform(0, self._jitter)
+                # the delay roll is drawn only when delay_prob < 1.0,
+                # keeping the (seed, op-sequence) fault stream of
+                # always-delay and no-latency configs unchanged
+                delayed = (self._delay_prob >= 1.0
+                           or self._rng.random() < self._delay_prob)
+                if delayed:
+                    latency = (self._latency
+                               + self._rng.uniform(0, self._jitter))
+                    self.delays_injected += 1
             fault = roll < self.fault_rate
             if fault:
                 self.faults_injected += 1
@@ -181,8 +198,10 @@ class ChaosStorageClient(BaseStorageClient):
                 fault_rate=float(props.get("FAULT_RATE", "0.3")),
                 seed=int(props.get("SEED", "0")),
                 error=props.get("ERROR", "chaos"),
-                latency_ms=float(props.get("LATENCY_MS", "0")),
+                latency_ms=float(props.get(
+                    "LATENCY_MS", props.get("DELAY_MS", "0"))),
                 latency_jitter_ms=float(props.get("LATENCY_JITTER_MS", "0")),
+                delay_prob=float(props.get("DELAY_PROB", "1.0")),
             ),
             resilience=Resilience.from_properties(
                 f"chaos/{source}", props,
@@ -207,6 +226,8 @@ class ChaosStorageClient(BaseStorageClient):
         seed: int = 0,
         error: str = "chaos",
         latency_ms: float = 0.0,
+        latency_jitter_ms: float = 0.0,
+        delay_prob: float = 1.0,
         resilience: Resilience | None = None,
         name: str = "chaos",
         clock: Clock = SYSTEM_CLOCK,
@@ -218,7 +239,8 @@ class ChaosStorageClient(BaseStorageClient):
             inner,
             injector=ChaosInjector(
                 fault_rate=fault_rate, seed=seed, error=error,
-                latency_ms=latency_ms, clock=clock),
+                latency_ms=latency_ms, latency_jitter_ms=latency_jitter_ms,
+                delay_prob=delay_prob, clock=clock),
             resilience=resilience or Resilience(
                 name,
                 policy=RetryPolicy(max_attempts=12, base_delay=0.001,

@@ -1,4 +1,4 @@
-"""Sharded model checkpointing via orbax.
+"""Sharded model checkpointing via orbax — crash-safe.
 
 The reference cannot auto-persist distributed models — a PAlgorithm's
 RDD model forces either a custom PersistentModel or a full retrain at
@@ -9,12 +9,37 @@ restore places shards straight back onto the target mesh — no
 gather-to-host, no retrain-on-deploy, which is the SURVEY.md §7
 "better than the reference" contract for sharded model persistence.
 
-A plain-numpy fallback (`save_arrays`/`load_arrays`) keeps the same
-directory API working when orbax is unavailable.
+A plain-numpy fallback (the ``npz`` backend) keeps the same directory
+API working when orbax is unavailable.
+
+Crash safety (docs/fleet.md "trustworthy generations"): a canary-vs-
+stable rollout is only meaningful when each replica group really runs
+the generation it claims, so a torn or bit-flipped checkpoint must
+fail LOUDLY at load, never deploy garbage:
+
+- the npz payload is written to a temp path, fsync'd, and atomically
+  renamed to a CONTENT-ADDRESSED name (``arrays-<digest>.npz``); the
+  atomically replaced ``checkpoint_meta.json`` then names that payload
+  — the meta replace is the commit point, so a crash anywhere mid-save
+  leaves the previous meta pointing at the previous (still present)
+  payload, never a new payload under an old manifest;
+- :func:`save_sharded` writes a manifest (inside the meta) naming
+  every array with its shape, dtype and — on the npz path, where the
+  bytes are host-local — a SHA-256 content checksum;
+- :func:`load_sharded` verifies the manifest: missing/extra arrays,
+  shape/dtype drift, or a checksum mismatch raise
+  :class:`CheckpointCorruptError`. (Orbax arrays may be device-sharded
+  across hosts, so their manifest carries shape/dtype only — hashing
+  would force the gather-to-host this module exists to avoid; orbax's
+  own OCDBT format detects truncation.)
+
+Pre-manifest checkpoints (version 1) load without verification, so
+existing artifacts keep working.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -26,6 +51,15 @@ logger = logging.getLogger(__name__)
 
 _ORBAX_SUBDIR = "orbax"
 _META_FILE = "checkpoint_meta.json"
+_NPZ_FILE = "arrays.npz"
+_META_VERSION = 2
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The persisted checkpoint fails integrity verification (torn
+    write, bit flip, missing file). Callers must treat the checkpoint
+    as unusable — the deploy path surfaces this instead of serving a
+    silently wrong model."""
 
 
 def _ocp():
@@ -37,10 +71,21 @@ def _ocp():
         return None
 
 
+def _array_meta(name: str, value: Any, checksum: bool) -> dict:
+    meta: dict[str, Any] = {
+        "shape": list(getattr(value, "shape", ())),
+        "dtype": str(getattr(value, "dtype", "")),
+    }
+    if checksum:
+        host = np.ascontiguousarray(np.asarray(value))
+        meta["sha256"] = hashlib.sha256(host.tobytes()).hexdigest()
+    return meta
+
+
 def save_sharded(directory: str, arrays: Mapping[str, Any]) -> str:
     """Persist a flat {name: jax.Array|np.ndarray} mapping. Sharded
     arrays are written shard-locally by orbax; returns the backend used
-    ("orbax" or "npz")."""
+    ("orbax" or "npz"). Crash-safe: see the module docstring."""
     os.makedirs(directory, exist_ok=True)
     ocp = _ocp()
     if ocp is not None:
@@ -48,15 +93,46 @@ def save_sharded(directory: str, arrays: Mapping[str, Any]) -> str:
             path = os.path.join(os.path.abspath(directory), _ORBAX_SUBDIR)
             with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
                 ckptr.save(path, dict(arrays), force=True)
-            _write_meta(directory, "orbax")
+            # shape/dtype manifest only: hashing a sharded array would
+            # gather it to host (module docstring)
+            _write_meta(directory, "orbax", {
+                name: _array_meta(name, v, checksum=False)
+                for name, v in arrays.items()
+            })
             return "orbax"
         except Exception as exc:
             logger.warning("orbax save failed (%s); falling back to npz", exc)
-    np.savez(
-        os.path.join(directory, "arrays.npz"),
-        **{k: np.asarray(v) for k, v in arrays.items()},
-    )
-    _write_meta(directory, "npz")
+    manifest = {
+        name: _array_meta(name, v, checksum=True)
+        for name, v in arrays.items()
+    }
+    # content-addressed payload name: the meta (written LAST, replaced
+    # atomically) is the commit point. A crash between payload and meta
+    # leaves the previous meta naming the previous payload — which is
+    # still on disk, because a new generation never overwrites it.
+    digest = hashlib.sha256(json.dumps(manifest, sort_keys=True)
+                            .encode()).hexdigest()[:16]
+    payload_name = f"arrays-{digest}.npz"
+    final = os.path.join(directory, payload_name)
+    tmp = f"{final}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    _write_meta(directory, "npz", manifest, payload=payload_name)
+    # the commit landed: previous generations' payloads are garbage now
+    for stale in os.listdir(directory):
+        if (stale.startswith("arrays-") and stale.endswith(".npz")
+                and stale != payload_name) or stale == _NPZ_FILE:
+            try:
+                os.unlink(os.path.join(directory, stale))
+            except OSError:
+                pass
     return "npz"
 
 
@@ -64,13 +140,17 @@ def load_sharded(
     directory: str,
     shardings: Mapping[str, Any] | None = None,
 ) -> dict[str, Any]:
-    """Restore a mapping saved by :func:`save_sharded`.
+    """Restore a mapping saved by :func:`save_sharded`, verifying the
+    integrity manifest when one exists (raises
+    :class:`CheckpointCorruptError` on any mismatch).
 
     ``shardings`` optionally maps names to ``jax.sharding.Sharding``
     targets — orbax then materialises each array directly with that
     placement (shard-by-shard on multi-host meshes). Without it, arrays
     restore host-local."""
-    backend = _read_meta(directory)
+    meta = _read_meta(directory)
+    backend = meta.get("backend", "npz")
+    manifest: Mapping[str, Any] | None = meta.get("arrays")
     if backend == "orbax":
         ocp = _ocp()
         if ocp is None:
@@ -83,9 +163,13 @@ def load_sharded(
         path = os.path.join(os.path.abspath(directory), _ORBAX_SUBDIR)
         with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
             if shardings:
-                meta = ckptr.metadata(path)
+                ckpt_meta = ckptr.metadata(path)
+                # orbax API drift: metadata() returns an object with
+                # .item_metadata on older releases, a plain dict of
+                # per-array metadata on newer ones
+                items = getattr(ckpt_meta, "item_metadata", ckpt_meta)
                 targets = {}
-                for name, m in meta.item_metadata.items():
+                for name, m in items.items():
                     sh = shardings.get(name)
                     if sh is not None:
                         targets[name] = jax.ShapeDtypeStruct(
@@ -93,10 +177,25 @@ def load_sharded(
                         )
                     else:
                         targets[name] = jax.ShapeDtypeStruct(m.shape, m.dtype)
-                return dict(ckptr.restore(path, targets))
-            return dict(ckptr.restore(path))
-    data = np.load(os.path.join(directory, "arrays.npz"))
-    out: dict[str, Any] = {k: data[k] for k in data.files}
+                out = dict(ckptr.restore(path, targets))
+            else:
+                out = dict(ckptr.restore(path))
+        _verify(directory, out, manifest, check_sums=False)
+        return out
+    payload_name = meta.get("payload", _NPZ_FILE)
+    npz_path = os.path.join(directory, payload_name)
+    try:
+        data = np.load(npz_path)
+        out = {k: data[k] for k in data.files}
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"checkpoint at {directory} is missing {payload_name} — "
+            "incomplete or deleted save") from None
+    except Exception as exc:  # truncated/garbled zip payload
+        raise CheckpointCorruptError(
+            f"checkpoint at {directory} is unreadable ({exc}) — "
+            "torn write or corruption") from exc
+    _verify(directory, out, manifest, check_sums=True)
     if shardings:
         import jax
 
@@ -106,25 +205,75 @@ def load_sharded(
     return out
 
 
-def _write_meta(directory: str, backend: str) -> None:
-    # atomic: a crash between the checkpoint write and the meta landing
-    # must never leave a readable-but-stale meta; os.replace is atomic so
-    # readers see either the old complete meta or the new one
+def _verify(directory: str, arrays: Mapping[str, Any],
+            manifest: Mapping[str, Any] | None, check_sums: bool) -> None:
+    """Arrays-vs-manifest integrity check; no-op for pre-manifest
+    (version 1) checkpoints."""
+    if manifest is None:
+        return
+    have, want = set(arrays), set(manifest)
+    if have != want:
+        raise CheckpointCorruptError(
+            f"checkpoint at {directory} does not match its manifest: "
+            f"missing {sorted(want - have)}, unexpected {sorted(have - want)}")
+    for name, meta in manifest.items():
+        value = arrays[name]
+        if list(getattr(value, "shape", ())) != list(meta.get("shape", ())):
+            raise CheckpointCorruptError(
+                f"checkpoint array {name!r} at {directory} has shape "
+                f"{list(value.shape)}, manifest says {meta.get('shape')}")
+        if str(getattr(value, "dtype", "")) != meta.get("dtype", ""):
+            raise CheckpointCorruptError(
+                f"checkpoint array {name!r} at {directory} has dtype "
+                f"{value.dtype}, manifest says {meta.get('dtype')}")
+        expected = meta.get("sha256")
+        if check_sums and expected:
+            host = np.ascontiguousarray(np.asarray(value))
+            actual = hashlib.sha256(host.tobytes()).hexdigest()
+            if actual != expected:
+                raise CheckpointCorruptError(
+                    f"checkpoint array {name!r} at {directory} fails its "
+                    f"content checksum — bit flip or torn write; refusing "
+                    f"to load a corrupted model")
+
+
+def _write_meta(directory: str, backend: str,
+                arrays: Mapping[str, Any] | None = None,
+                payload: str | None = None) -> None:
+    # atomic + durable: a crash between the checkpoint write and the
+    # meta landing must never leave a readable-but-stale meta; fsync
+    # then os.replace so readers see either the old complete meta or
+    # the new one
     path = os.path.join(directory, _META_FILE)
     tmp = f"{path}.tmp.{os.getpid()}"
+    doc: dict[str, Any] = {"backend": backend, "version": _META_VERSION}
+    if arrays is not None:
+        doc["arrays"] = dict(arrays)
+    if payload is not None:
+        doc["payload"] = payload
     with open(tmp, "w") as f:
-        json.dump({"backend": backend, "version": 1}, f)
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 
-def _read_meta(directory: str) -> str:
+def _read_meta(directory: str) -> dict:
     meta_path = os.path.join(directory, _META_FILE)
     if not os.path.exists(meta_path):
         # no meta: prefer a complete orbax checkpoint over legacy npz (a
         # crash after the orbax write but before the meta landed must not
         # silently resurrect a stale npz from an earlier save)
         if os.path.isdir(os.path.join(directory, _ORBAX_SUBDIR)):
-            return "orbax"
-        return "npz"
-    with open(meta_path) as f:
-        return json.load(f).get("backend", "npz")
+            return {"backend": "orbax"}
+        return {"backend": "npz"}
+    try:
+        with open(meta_path) as f:
+            doc = json.load(f)
+    except (json.JSONDecodeError, OSError) as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint meta at {meta_path} is unreadable ({exc})") from exc
+    if not isinstance(doc, dict):
+        raise CheckpointCorruptError(
+            f"checkpoint meta at {meta_path} is not a JSON object")
+    return doc

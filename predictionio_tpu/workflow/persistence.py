@@ -13,11 +13,21 @@ persistence modes of BaseAlgorithm.makePersistentModel
 
 numpy/jax arrays inside models are converted to numpy before pickling so
 blobs are backend-portable.
+
+Integrity (beyond reference; the fleet tier's "trustworthy generations"
+contract, docs/fleet.md): every blob carries a magic header and a
+SHA-256 digest of its payload. :func:`deserialize_models` verifies the
+digest before unpickling — a bit-flipped or truncated blob raises
+:class:`ModelIntegrityError` at load instead of deploying garbage (or
+feeding corrupted bytes to pickle), and the engine server's ``/reload``
+keeps serving the last-known-good model. Pre-checksum blobs (no magic)
+still load, so existing stored instances keep working.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import io
 import pickle
 from typing import Any, Sequence
@@ -27,6 +37,17 @@ from predictionio_tpu.storage.base import Model
 from predictionio_tpu.storage.registry import Storage
 
 _FORMAT_VERSION = 1
+
+#: blob header: magic + format byte, then a 32-byte SHA-256 of the
+#: pickled payload, then the payload
+_MAGIC = b"PIOM\x01"
+_DIGEST_LEN = hashlib.sha256().digest_size
+
+
+class ModelIntegrityError(ValueError):
+    """The persisted model blob fails its checksum (bit flip, torn or
+    truncated write). The deploy path must fail loudly — never
+    unpickle, never serve — and a /reload keeps last-known-good."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,13 +106,29 @@ def serialize_models(persisted: Sequence[Any]) -> bytes:
             entries.append(("auto", _to_host(p)))
     buf = io.BytesIO()
     pickle.dump(_Envelope(_FORMAT_VERSION, tuple(entries)), buf, protocol=pickle.HIGHEST_PROTOCOL)
-    return buf.getvalue()
+    payload = buf.getvalue()
+    return _MAGIC + hashlib.sha256(payload).digest() + payload
 
 
 def deserialize_models(blob: bytes) -> list[Any]:
     """Returns the per-algo persisted list (model | manifest | None) for
-    Engine.prepare_deploy."""
-    env: _Envelope = pickle.loads(blob)
+    Engine.prepare_deploy. Verifies the blob's content digest FIRST
+    (module docstring) — corruption raises :class:`ModelIntegrityError`
+    before any byte reaches pickle."""
+    if blob.startswith(_MAGIC):
+        header_len = len(_MAGIC) + _DIGEST_LEN
+        if len(blob) < header_len:
+            raise ModelIntegrityError(
+                "model blob is truncated inside its integrity header")
+        digest = blob[len(_MAGIC):header_len]
+        payload = blob[header_len:]
+        if hashlib.sha256(payload).digest() != digest:
+            raise ModelIntegrityError(
+                "model blob fails its SHA-256 checksum — bit flip or torn "
+                "write; refusing to deserialize a corrupted model")
+    else:
+        payload = blob  # pre-checksum blob (legacy stored instance)
+    env: _Envelope = pickle.loads(payload)
     if env.version != _FORMAT_VERSION:
         raise ValueError(f"unsupported model blob version {env.version}")
     return [payload for _, payload in env.entries]

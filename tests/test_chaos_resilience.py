@@ -137,6 +137,89 @@ class TestChaosInjector:
         assert len(ids) == len(set(ids)) == 200
 
 
+class TestChaosLatencyInjection:
+    """Seeded latency mode (PR 6): slow-backend behavior is
+    deterministic and request deadlines still fire under slowness."""
+
+    def test_delay_probability_is_seeded_and_deterministic(self):
+        from predictionio_tpu.storage.chaos import ChaosInjector
+        from predictionio_tpu.utils.resilience import ManualClock
+
+        def stream(seed):
+            clock = ManualClock()
+            inj = ChaosInjector(fault_rate=0.0, seed=seed, latency_ms=50,
+                                delay_prob=0.4, clock=clock)
+            for _ in range(100):
+                inj.before("op")
+            return inj.delays_injected, clock.slept
+
+        assert stream(11) == stream(11)
+        delays, slept = stream(11)
+        assert 0 < delays < 100           # some calls slow, most fast
+        assert len(slept) == delays
+        assert all(s == pytest.approx(0.05) for s in slept)
+        assert stream(11) != stream(12)
+
+    def test_delay_prob_never_shifts_the_no_latency_fault_stream(self):
+        """The delay roll is drawn only when latency is configured, so
+        the (seed, op-sequence) fault stream of every pre-existing
+        latency-free chaos config is pinned unchanged; and delay_prob's
+        default (1.0) is explicit-1.0-equivalent."""
+        from predictionio_tpu.storage.chaos import ChaosInjector
+        from predictionio_tpu.utils.resilience import ManualClock
+
+        def faults(**kwargs):
+            inj = ChaosInjector(fault_rate=0.3, seed=77,
+                                clock=ManualClock(), **kwargs)
+            out = []
+            for _ in range(50):
+                try:
+                    inj.before("op")
+                    out.append(0)
+                except ChaosError:
+                    out.append(1)
+            return out
+
+        assert faults() == faults(delay_prob=0.5)        # no latency set
+        assert faults(latency_ms=1) == faults(latency_ms=1, delay_prob=1.0)
+
+    def test_request_deadline_fires_under_slow_backend(self):
+        """The satellite pin: a storage-touching query path over a
+        chaos backend injecting 200ms per call must 503 inside a 50ms
+        request budget — slowness degrades to a deadline error, never
+        a socket held for the backend's pleasure."""
+        import types
+
+        from predictionio_tpu.api.engine_server import EngineService
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        chaos = ChaosStorageClient.wrap(
+            MemoryStorageClient(), fault_rate=0.0, seed=1, latency_ms=200)
+        chaos.events().init(1)
+
+        class SlowStorageDeployed:
+            query_class = None
+            instance = types.SimpleNamespace(id="inst-slowstore")
+            engine = None
+
+            def query(self, q):
+                # a serving path that reads live storage per query
+                # (custom Serving pattern) — every read eats the
+                # injected latency
+                for _ in range(5):
+                    list(chaos.events().find(1))
+                return {"ok": True}
+
+        service = EngineService(
+            SlowStorageDeployed(),
+            config=ServerConfig(request_deadline_ms=50.0))
+        t0 = time.monotonic()
+        result = service.handle("POST", "/queries.json", {}, {}, {"x": 1})
+        elapsed = time.monotonic() - t0
+        assert result[0] == 503 and "deadline" in result[1]["message"]
+        assert elapsed < 0.6      # answered ~at the budget, not 5x200ms
+
+
 class TestChaosRegistryIntegration:
     def test_chaos_source_wraps_target_type(self, tmp_path):
         env = {
@@ -401,6 +484,51 @@ class TestServingDegradation:
             assert server.service.deployed.instance.id == served_id
             status, r = _post_json(f"{base}/queries.json", {"x": 4})
             assert (status, r["value"]) == (200, 8)
+        finally:
+            server.stop()
+
+    def test_corrupted_model_blob_rejected_and_last_known_good_serves(
+            self, storage):
+        """The PR 6 acceptance pin: a bit-flipped persisted model is
+        rejected at load with a clear error (never unpickled, never
+        deployed) and a /reload that hits it keeps serving the
+        last-known-good model."""
+        from predictionio_tpu.api.engine_server import create_engine_server
+        from predictionio_tpu.storage.base import Model
+        from predictionio_tpu.workflow.deploy import ServerConfig
+        from predictionio_tpu.workflow.persistence import (
+            ModelIntegrityError,
+            load_models,
+        )
+
+        _train(storage, mult=2)
+        server = create_engine_server(
+            storage=storage, config=ServerConfig(ip="127.0.0.1", port=0))
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, r = _post_json(f"{base}/queries.json", {"x": 4})
+            assert (status, r["value"]) == (200, 8)
+
+            # a new generation trains, then its stored blob bit-flips
+            second = _train(storage, mult=5)
+            models = storage.get_model_data_models()
+            blob = bytearray(models.get(second.instance_id).models)
+            blob[-3] ^= 0x40
+            models.insert(Model(id=second.instance_id, models=bytes(blob)))
+
+            # rejected at load with a clear error, before pickle
+            with pytest.raises(ModelIntegrityError, match="checksum"):
+                load_models(storage, second.instance_id)
+
+            # /reload resolves the corrupted latest instance, fails
+            # loudly, keeps serving the old model
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/reload", timeout=10)
+            assert e.value.code == 503
+            assert "still serving" in json.loads(e.value.read())["message"]
+            status, r = _post_json(f"{base}/queries.json", {"x": 4})
+            assert (status, r["value"]) == (200, 8)      # still mult=2
         finally:
             server.stop()
 

@@ -258,3 +258,226 @@ class TestShardedCheckpoint:
             np.asarray(back.item_factors), np.asarray(model.item_factors))
         assert back.item_ids["i3"] == 3
         assert back.seen_by_user[0].tolist() == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# crash-safe persistence: manifests, checksums, loud corruption failures
+# ---------------------------------------------------------------------------
+
+class TestCheckpointIntegrity:
+    """utils/checkpoint (PR 6): atomic npz writes + a per-array checksum
+    manifest; a torn or bit-flipped checkpoint fails LOUDLY at load —
+    this is what makes canary-vs-stable model generations trustworthy
+    (docs/fleet.md)."""
+
+    def _save_npz(self, directory, monkeypatch, arrays=None):
+        import numpy as np
+
+        import predictionio_tpu.utils.checkpoint as ckpt
+
+        # force the npz path (the deterministic host-local backend)
+        monkeypatch.setattr(ckpt, "_ocp", lambda: None)
+        arrays = arrays or {
+            "user": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "item": np.ones((2, 4), dtype=np.float32),
+        }
+        assert ckpt.save_sharded(str(directory), arrays) == "npz"
+        return arrays
+
+    @staticmethod
+    def _payload_path(directory):
+        """The committed content-addressed payload the meta names."""
+        import json
+
+        meta = json.loads((directory / "checkpoint_meta.json").read_text())
+        return directory / meta["payload"]
+
+    def test_roundtrip_and_manifest(self, tmp_path, monkeypatch):
+        import json
+
+        import numpy as np
+
+        from predictionio_tpu.utils.checkpoint import load_sharded
+
+        arrays = self._save_npz(tmp_path, monkeypatch)
+        out = load_sharded(str(tmp_path))
+        for name, value in arrays.items():
+            np.testing.assert_array_equal(out[name], value)
+        meta = json.loads((tmp_path / "checkpoint_meta.json").read_text())
+        assert meta["version"] == 2
+        assert set(meta["arrays"]) == {"user", "item"}
+        assert all(len(m["sha256"]) == 64 for m in meta["arrays"].values())
+
+    def test_bit_flip_rejected_at_load(self, tmp_path, monkeypatch):
+        import pytest
+
+        from predictionio_tpu.utils.checkpoint import (
+            CheckpointCorruptError,
+            load_sharded,
+        )
+
+        import numpy as np
+
+        self._save_npz(tmp_path, monkeypatch)
+        npz = self._payload_path(tmp_path)
+        blob = bytearray(npz.read_bytes())
+        # flip one bit INSIDE the "user" array's stored payload (npz
+        # entries are uncompressed .npy blocks, so the raw bytes are
+        # findable) — the checksum manifest must catch it
+        payload = np.arange(12, dtype=np.float32).tobytes()
+        at = blob.find(payload)
+        assert at > 0, "array payload not found in npz"
+        blob[at + 5] ^= 0x01
+        npz.write_bytes(bytes(blob))
+        with pytest.raises(CheckpointCorruptError):
+            load_sharded(str(tmp_path))
+
+    def test_missing_payload_rejected_at_load(self, tmp_path, monkeypatch):
+        import pytest
+
+        from predictionio_tpu.utils.checkpoint import (
+            CheckpointCorruptError,
+            load_sharded,
+        )
+
+        self._save_npz(tmp_path, monkeypatch)
+        self._payload_path(tmp_path).unlink()
+        with pytest.raises(CheckpointCorruptError, match="missing"):
+            load_sharded(str(tmp_path))
+
+    def test_truncated_payload_rejected_at_load(self, tmp_path, monkeypatch):
+        import pytest
+
+        from predictionio_tpu.utils.checkpoint import (
+            CheckpointCorruptError,
+            load_sharded,
+        )
+
+        self._save_npz(tmp_path, monkeypatch)
+        npz = self._payload_path(tmp_path)
+        npz.write_bytes(npz.read_bytes()[: npz.stat().st_size // 2])
+        with pytest.raises(CheckpointCorruptError):
+            load_sharded(str(tmp_path))
+
+    def test_pre_manifest_checkpoint_still_loads(self, tmp_path, monkeypatch):
+        import json
+
+        import numpy as np
+
+        from predictionio_tpu.utils.checkpoint import load_sharded
+
+        self._save_npz(tmp_path, monkeypatch)
+        # rewrite the checkpoint into its version-1 (pre-manifest)
+        # shape: a fixed arrays.npz named by nothing but convention
+        self._payload_path(tmp_path).rename(tmp_path / "arrays.npz")
+        (tmp_path / "checkpoint_meta.json").write_text(
+            json.dumps({"backend": "npz", "version": 1}))
+        out = load_sharded(str(tmp_path))
+        assert set(out) == {"user", "item"}
+        np.testing.assert_array_equal(
+            out["user"], np.arange(12, dtype=np.float32).reshape(3, 4))
+
+    def test_save_never_leaves_a_torn_file_behind(self, tmp_path, monkeypatch):
+        """A save is tmp-write + fsync + atomic rename with the meta as
+        the commit point: after a save over an EXISTING checkpoint, no
+        temp debris or stale payload generations remain and the
+        directory holds a loadable checkpoint."""
+        import numpy as np
+
+        from predictionio_tpu.utils.checkpoint import load_sharded
+
+        self._save_npz(tmp_path, monkeypatch)
+        first_payload = self._payload_path(tmp_path)
+        self._save_npz(tmp_path, monkeypatch, arrays={
+            "user": np.zeros((1, 2), np.float32),
+            "item": np.zeros((1, 2), np.float32),
+        })
+        assert not [p for p in tmp_path.iterdir() if ".tmp." in p.name]
+        assert not first_payload.exists()       # stale generation reaped
+        out = load_sharded(str(tmp_path))
+        assert out["user"].shape == (1, 2)
+
+    def test_crash_between_payload_and_meta_keeps_previous_generation(
+            self, tmp_path, monkeypatch):
+        """The commit point is the meta replace: a save that dies after
+        writing its payload but before its meta leaves the PREVIOUS
+        generation fully loadable (content-addressed payload names —
+        the new payload never overwrites the old one)."""
+        import numpy as np
+
+        import predictionio_tpu.utils.checkpoint as ckpt
+        from predictionio_tpu.utils.checkpoint import load_sharded
+
+        arrays = self._save_npz(tmp_path, monkeypatch)
+
+        def crash(*a, **k):
+            raise RuntimeError("killed before the meta landed")
+
+        monkeypatch.setattr(ckpt, "_write_meta", crash)
+        with np.testing.assert_raises(RuntimeError):
+            ckpt.save_sharded(str(tmp_path), {
+                "user": np.zeros((9, 9), np.float32),
+                "item": np.zeros((9, 9), np.float32),
+            })
+        out = load_sharded(str(tmp_path))       # old generation intact
+        np.testing.assert_array_equal(out["user"], arrays["user"])
+
+
+class TestModelBlobIntegrity:
+    """workflow/persistence (PR 6): every model blob carries a SHA-256
+    digest; corruption is rejected before pickle ever sees a byte."""
+
+    def test_roundtrip_and_magic_header(self):
+        from predictionio_tpu.workflow.persistence import (
+            deserialize_models,
+            serialize_models,
+        )
+
+        blob = serialize_models([{"w": [1, 2, 3]}, None])
+        assert blob.startswith(b"PIOM\x01")
+        assert deserialize_models(blob) == [{"w": [1, 2, 3]}, None]
+
+    def test_bit_flip_rejected_before_unpickling(self):
+        import pytest
+
+        from predictionio_tpu.workflow.persistence import (
+            ModelIntegrityError,
+            deserialize_models,
+            serialize_models,
+        )
+
+        blob = bytearray(serialize_models([{"w": [1, 2, 3]}]))
+        blob[-3] ^= 0x40                       # flip a payload bit
+        with pytest.raises(ModelIntegrityError, match="checksum"):
+            deserialize_models(bytes(blob))
+
+    def test_truncation_rejected(self):
+        import pytest
+
+        from predictionio_tpu.workflow.persistence import (
+            ModelIntegrityError,
+            deserialize_models,
+            serialize_models,
+        )
+
+        blob = serialize_models([{"w": [1, 2, 3]}])
+        with pytest.raises(ModelIntegrityError):
+            deserialize_models(blob[: len(blob) // 2])
+        with pytest.raises(ModelIntegrityError, match="truncated"):
+            deserialize_models(blob[:10])      # dies inside the header
+
+    def test_legacy_blob_without_magic_still_loads(self):
+        """Blobs persisted before the checksum envelope (plain pickled
+        _Envelope) keep loading — stored engine instances survive the
+        upgrade."""
+        import io
+        import pickle
+
+        from predictionio_tpu.workflow.persistence import (
+            _Envelope,
+            deserialize_models,
+        )
+
+        buf = io.BytesIO()
+        pickle.dump(_Envelope(1, (("auto", {"w": 7}),)), buf)
+        assert deserialize_models(buf.getvalue()) == [{"w": 7}]
