@@ -176,8 +176,30 @@ def default_config() -> LintConfig:
             "dtype-discipline": RuleConfig(paths=COMPUTE_PATHS),
             # storage/ included: the deleted PR 1 test pinned pgwire's
             # exact connect line partly to keep its timeout — a blocked
-            # connect is not interruptible by the retry layer
-            "untimed-blocking-io": RuleConfig(paths=("api/", "storage/")),
+            # connect is not interruptible by the retry layer.
+            # fleet/ + obs/ + cli/ cover the fleet-observability
+            # fan-out paths (worker-peer fetches, /fleet/metrics
+            # replica scrapes, /traces.json stitching, `pio trace`):
+            # every cross-process fetch must carry a timeout, so the
+            # transport's kw-only `timeout` is policed too (`request`
+            # with a large positional index: it can only be passed by
+            # keyword, and its absence is the finding)
+            "untimed-blocking-io": RuleConfig(
+                paths=("api/", "storage/", "fleet/", "obs/", "cli/"),
+                options={
+                    "policed_calls": {
+                        "urlopen": 2, "create_connection": 1,
+                        "request": 99,
+                    },
+                    # "request" means the fleet transport's exchange
+                    # only on the fan-out paths; the ES client's own
+                    # request() binds its timeout internally
+                    "call_paths": {
+                        "request": ["fleet/", "obs/",
+                                    "api/router_server.py"],
+                    },
+                },
+            ),
             "lock-discipline": RuleConfig(paths=("",)),
         },
         exclude=("__pycache__/",),

@@ -61,10 +61,15 @@ class Suppression:
 class ModuleInfo:
     """A parsed source file handed to every applicable rule."""
 
-    def __init__(self, path: str, source: str, tree: ast.Module):
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 relpath: str = ""):
         self.path = path
         self.source = source
         self.tree = tree
+        #: package-relative path (forward slashes) when linted from a
+        #: tree root; "" for ad-hoc single files. Rules with per-call
+        #: path scoping (untimed-blocking-io's call_paths) match on it.
+        self.relpath = relpath
         self.lines = source.splitlines()
         self.suppressions = parse_suppressions(source)
         self._parents: dict[ast.AST, ast.AST] | None = None

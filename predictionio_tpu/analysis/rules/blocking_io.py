@@ -34,12 +34,24 @@ class UntimedBlockingIORule(Rule):
 
     def check(self, module: ModuleInfo, options: dict[str, Any]) -> list[Finding]:
         policed = dict(options.get("policed_calls", DEFAULT_POLICED_CALLS))
+        # per-call path scoping: a generic method name ("request") may
+        # be policed only where it means the fleet transport's exchange
+        # — an unrelated wrapper with the same name elsewhere (the ES
+        # client's resilient request(), which binds its timeout
+        # internally) must not produce findings
+        call_paths: dict[str, list[str]] = options.get("call_paths", {})
+        from predictionio_tpu.analysis.config import path_matches
+
         findings: list[Finding] = []
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
                 continue
             name = self.call_name(node)
             if name not in policed:
+                continue
+            scoped = call_paths.get(name)
+            if scoped is not None and module.relpath \
+                    and not path_matches(module.relpath, tuple(scoped)):
                 continue
             timeout = next(
                 (kw.value for kw in node.keywords if kw.arg == "timeout"),

@@ -75,7 +75,7 @@ def lint_paths(
                     f"could not parse: {exc}",
                 ))
                 continue
-            module = ModuleInfo(fpath, source, tree)
+            module = ModuleInfo(fpath, source, tree, relpath=relpath)
             findings.extend(suppression_findings(module, relpath))
             for rule in rules.values():
                 if not path_matches(relpath, config.rule_paths(rule)):

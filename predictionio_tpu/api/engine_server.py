@@ -87,9 +87,13 @@ from predictionio_tpu.obs.registry import (
     server_info_collector,
     serving_collector,
 )
+from predictionio_tpu.obs.slo import SLOEngine, serving_pressure_collector
 from predictionio_tpu.obs.trace import (
+    PARENT_SPAN_HEADER,
+    TRACE_ID_HEADER,
     TraceLog,
     active_trace,
+    parse_trace_context,
     span,
     start_trace,
     tracing_default,
@@ -296,6 +300,13 @@ class EngineService:
         self.registry.register(serving_collector(self.serving_stats))
         self.registry.register(resilience_collector())
         self.registry.register(server_info_collector("engine"))
+        #: SLO burn-rate gauges + the queue-pressure autoscaler signal
+        #: (obs/slo.py; docs/fleet.md): outcomes recorded per query by
+        #: the handler, evaluated at scrape time only
+        self.slo = SLOEngine()
+        self.registry.register(self.slo.collector())
+        self.registry.register(
+            serving_pressure_collector(self.serving_stats))
         #: deadline enforcement for the NON-batched path: the query runs
         #: on a pool thread so a blown budget returns 503 instead of
         #: holding the socket (threads spawn lazily; idle pool is free)
@@ -395,11 +406,17 @@ class EngineService:
         "/": "status",
     }
 
-    def observe_request(self, path: str, dt: float) -> None:
+    def observe_request(self, path: str, dt: float,
+                        status: int | None = None) -> None:
         """Handler-measured request walltime into the per-route
-        latency family (unknown paths fold into ``other``)."""
+        latency family (unknown paths fold into ``other``); query
+        outcomes additionally feed the SLO ring (5xx = error-budget
+        spend; a shed 503 is budget spend too — the SLO measures what
+        callers experienced, not who was at fault)."""
         self.request_latency.observe(
             self._ROUTE_LABELS.get(path, "other"), dt)
+        if status is not None and path == "/queries.json":
+            self.slo.record(ok=status < 500, latency_s=dt)
 
     def readyz(self) -> tuple:
         """Readiness: a deployed model AND reachable storage. 503 (with
@@ -683,7 +700,12 @@ class EngineService:
     # -- feedback loop ------------------------------------------------------
     def _post_feedback(self, pr_id: str, query_json: dict, response: dict) -> None:
         """Fire-and-forget POST to the event server
-        (CreateServer.scala:550-566)."""
+        (CreateServer.scala:550-566). Forwards the ambient trace
+        context (captured HERE, on the handler thread — the posting
+        thread has no contextvars) so the event server's segment nests
+        under this query's feedback span in the stitched tree."""
+        trace = active_trace()
+        feedback_span_id = trace.reserve_span_id() if trace else None
 
         def post() -> None:
             import urllib.request
@@ -701,11 +723,16 @@ class EngineService:
                 "entityId": pr_id,
                 "properties": {"query": query_json, "prediction": response},
             }
+            headers = {"Content-Type": "application/json"}
+            if trace is not None:
+                headers[TRACE_ID_HEADER] = trace.trace_id
+                headers[PARENT_SPAN_HEADER] = feedback_span_id
+            t0 = time.perf_counter()
             try:
                 req = urllib.request.Request(
                     url,
                     data=json.dumps(event).encode(),
-                    headers={"Content-Type": "application/json"},
+                    headers=headers,
                     method="POST",
                 )
                 with urllib.request.urlopen(
@@ -714,6 +741,14 @@ class EngineService:
                     pass
             except Exception as e:
                 logger.warning("feedback event POST failed: %s", e)
+            finally:
+                if trace is not None:
+                    # best-effort: the handler has usually finished the
+                    # trace by now, but TraceLog serializes at READ time
+                    # and list.append is atomic, so the span still lands
+                    # in later scrapes (Trace's lock-free contract)
+                    trace.add_span("feedback", t0, time.perf_counter(),
+                                   span_id=feedback_span_id)
 
         threading.Thread(target=post, name="pio-feedback", daemon=True).start()
 
@@ -756,16 +791,23 @@ class _Handler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path
         self._request_id = resolve_request_id(self.headers)
         self._last_status = 0
-        self._trace = (
-            start_trace("queries.json", request_id=self._request_id)
-            if (method == "POST" and path == "/queries.json"
-                and self.service.tracing)
-            else None)
+        self._trace = None
+        if (method == "POST" and path == "/queries.json"
+                and self.service.tracing):
+            # adopt inbound cross-process context (the router's trace
+            # id + its attempt span id) when well-formed; malformed or
+            # oversized headers fall back to fresh local ids — never a
+            # rejected request (obs/trace.parse_trace_context)
+            inbound_id, inbound_parent = parse_trace_context(self.headers)
+            self._trace = start_trace(
+                "queries.json", request_id=self._request_id,
+                trace_id=inbound_id, parent_span_id=inbound_parent,
+                service="engine")
         try:
             self._dispatch_inner(method, path)
         finally:
             dt = time.perf_counter() - t_start
-            self.service.observe_request(path, dt)
+            self.service.observe_request(path, dt, self._last_status)
             if self._trace is not None:
                 self._trace.finish(status=self._last_status)
                 self.service.trace_log.record(self._trace)

@@ -77,8 +77,10 @@ from predictionio_tpu.obs.registry import (
     resilience_collector,
     server_info_collector,
 )
+from predictionio_tpu.obs.slo import SLOEngine
 from predictionio_tpu.obs.trace import (
     TraceLog,
+    parse_trace_context,
     span,
     start_trace,
     tracing_default,
@@ -196,6 +198,10 @@ class EventService:
         self.registry.register(ingest_collector(self.ingest_stats))
         self.registry.register(resilience_collector())
         self.registry.register(server_info_collector("event"))
+        #: SLO burn-rate gauges over the ingest write paths
+        #: (obs/slo.py; docs/fleet.md autoscaler contract)
+        self.slo = SLOEngine()
+        self.registry.register(self.slo.collector())
 
     # -- auth (EventServer.scala:92-131) ------------------------------------
     def authenticate(
@@ -548,8 +554,14 @@ class EventService:
             return "metrics"
         return "other"
 
-    def observe_request(self, method: str, path: str, dt: float) -> None:
+    def observe_request(self, method: str, path: str, dt: float,
+                        status: int | None = None) -> None:
         self.request_latency.observe(self.route_label(method, path), dt)
+        if status is not None and self.route_label(method, path) in (
+                "events_post", "batch"):
+            # ingest availability SLO: 5xx spends error budget; client
+            # errors (bad JSON, bad key) do not
+            self.slo.record(ok=status < 500, latency_s=dt)
 
     def handle(
         self,
@@ -684,16 +696,23 @@ class _Handler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path
         self._request_id = resolve_request_id(self.headers)
         self._last_status = 0
-        self._trace = (
-            start_trace(path.lstrip("/"), request_id=self._request_id)
-            if (method == "POST" and path in self._TRACED_PATHS
-                and self.service.tracing)
-            else None)
+        self._trace = None
+        if (method == "POST" and path in self._TRACED_PATHS
+                and self.service.tracing):
+            # inbound cross-process context adopted when well-formed
+            # (malformed falls back to fresh ids — obs/trace.py); the
+            # feedback loop's engine→event POSTs stitch this way
+            inbound_id, inbound_parent = parse_trace_context(self.headers)
+            self._trace = start_trace(
+                path.lstrip("/"), request_id=self._request_id,
+                trace_id=inbound_id, parent_span_id=inbound_parent,
+                service="event")
         try:
             self._dispatch_inner(method, path)
         finally:
             dt = time.perf_counter() - t_start
-            self.service.observe_request(method, path, dt)
+            self.service.observe_request(method, path, dt,
+                                         self._last_status)
             if self._trace is not None:
                 self._trace.finish(status=self._last_status)
                 self.service.trace_log.record(self._trace)

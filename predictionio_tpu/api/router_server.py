@@ -9,6 +9,14 @@ A thin HTTP process fronting N engine-server replicas. Routes:
                            JSON parse on the hot path
 - ``GET /``, ``GET /fleet`` fleet status document: per-backend state,
                            breaker, in-flight, canary, router counters
+- ``GET /fleet/metrics``   every replica's /metrics scraped (bounded),
+                           re-exported with replica/group labels +
+                           pio_fleet_scrape_ok + the fleet-wide
+                           pio_fleet_pressure gauge (docs/fleet.md)
+- ``GET /traces.json``     the router's own trace ring; with
+                           ``?trace_id=`` the CROSS-PROCESS stitched
+                           tree (fan-out to replicas and --workers
+                           siblings; obs/stitch.py, `pio trace`)
 - ``GET|POST /fleet/canary`` canary admin: read the rollout state;
                            POST ``{"weight": 25}`` to start/resize,
                            ``{"action": "abort"}`` to kill it
@@ -58,13 +66,33 @@ from predictionio_tpu.fleet.router import (
     RouterResponse,
 )
 from predictionio_tpu.fleet.stats import router_collector
+from predictionio_tpu.fleet.transport import fan_out
+from predictionio_tpu.fleet.workers import WorkerHub
+from predictionio_tpu.obs.aggregate import (
+    ExpositionParseError,
+    merge_snapshots,
+    merge_sources,
+    parse_exposition,
+    relabel,
+)
 from predictionio_tpu.obs.exporter import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
-from predictionio_tpu.obs.exporter import render_prometheus
+from predictionio_tpu.obs.exporter import render_metrics, render_prometheus
 from predictionio_tpu.obs.registry import (
     HistogramFamily,
+    Metric,
     MetricRegistry,
     resilience_collector,
     server_info_collector,
+)
+from predictionio_tpu.obs.slo import SLOEngine, pressure_metric
+from predictionio_tpu.obs.stitch import stitch
+from predictionio_tpu.obs.trace import (
+    TRACE_ID_HEADER,
+    TraceLog,
+    parse_trace_context,
+    start_trace,
+    tracing_default,
+    use_trace,
 )
 
 logger = logging.getLogger(__name__)
@@ -88,16 +116,41 @@ class RouterService:
         self.access_log = access_log_enabled(self.config.access_log)
         if self.access_log:
             ensure_access_log_handler()
+        #: fleet tracing (docs/observability.md): the router opens the
+        #: ROOT segment of every traced query and forwards context so
+        #: replica segments stitch under its attempt spans
+        self.tracing = (self.config.tracing
+                        if self.config.tracing is not None
+                        else tracing_default())
+        self.trace_log = TraceLog()
+        #: SLO engine (obs/slo.py): every routed query's outcome feeds
+        #: the burn-rate gauges — at the ROUTER the availability SLO
+        #: measures what CLIENTS see (sheds and all-replicas-down count
+        #: against the budget even though no replica mis-served)
+        self.slo = SLOEngine()
         self.request_latency = HistogramFamily(
             "pio_http_request_seconds",
             "HTTP request walltime by route (handler-measured)",
-            "route", ("queries", "fleet", "metrics", "status"))
+            "route", ("queries", "fleet", "metrics", "status", "traces"))
         self.registry = MetricRegistry()
         self.registry.register(self.request_latency.collect)
         self.registry.register(router_collector(
             router.stats, router.membership, router.canary))
         self.registry.register(resilience_collector())
         self.registry.register(server_info_collector("router"))
+        self.registry.register(self.slo.collector())
+        #: `--workers N` peering (fleet/workers.py): a /metrics scrape
+        #: landing on THIS worker merges every sibling's registry
+        self.worker_hub: WorkerHub | None = (
+            WorkerHub(self.config.worker_spool_dir,
+                      metrics_text=lambda: render_prometheus(self.registry),
+                      traces_snapshot=self.trace_log.snapshot,
+                      timeout_s=self.config.scrape_timeout_s)
+            if self.config.worker_spool_dir else None)
+
+    def close(self) -> None:
+        if self.worker_hub is not None:
+            self.worker_hub.close()
 
     # -- auth ---------------------------------------------------------------
     def _check_router_key(self, params: Mapping[str, str]) -> None:
@@ -122,8 +175,16 @@ class RouterService:
                               "canary": self.router.canary.snapshot()})
             if method == "GET" and path == "/metrics":
                 return (200, PlainTextPayload(
-                    render_prometheus(self.registry),
-                    PROMETHEUS_CONTENT_TYPE))
+                    self.metrics_text(), PROMETHEUS_CONTENT_TYPE))
+            if method == "GET" and path == "/fleet/metrics":
+                return (200, PlainTextPayload(
+                    self.fleet_metrics_text(), PROMETHEUS_CONTENT_TYPE))
+            if method == "GET" and path == "/traces.json":
+                trace_id = params.get("trace_id")
+                if trace_id:
+                    return self.stitched_trace(trace_id)
+                return (200, {"tracing": self.tracing,
+                              "traces": self.trace_log.snapshot()})
             if method == "GET" and path == "/healthz":
                 return (200, {"status": "ok"})
             if method == "GET" and path == "/readyz":
@@ -146,6 +207,135 @@ class RouterService:
         except Exception as e:
             logger.exception("unhandled error in %s %s", method, path)
             return (500, {"message": f"internal error: {e}"})
+
+    # -- scrape-time aggregation (docs/fleet.md) ----------------------------
+    def metrics_text(self) -> str:
+        """This worker's exposition — merged with every live sibling's
+        when `--workers N` peering is on (counters summed, histograms
+        bucket-merged, gauges labeled per worker), so a scrape landing
+        on one SO_REUSEPORT worker reports fleet-of-workers truth."""
+        own = self.registry.collect()
+        hub = self.worker_hub
+        if hub is None:
+            return render_metrics(own)
+        sources: list[tuple[str, list]] = [(hub.worker_id, own)]
+        for worker_id, body in hub.fetch_peer_bodies("/metrics"):
+            try:
+                sources.append((worker_id,
+                                parse_exposition(body.decode())))
+            except (ExpositionParseError, UnicodeDecodeError) as exc:
+                logger.warning("worker %s exposition unparseable: %s",
+                               worker_id, exc)
+        merged = merge_sources(sources, source_label="worker")
+        merged.append(Metric(
+            name="pio_router_workers", kind="gauge",
+            help="Live router worker processes folded into this scrape",
+            samples=[({}, float(len(sources)))]))
+        return render_metrics(merged)
+
+    def fleet_metrics_text(self) -> str:
+        """Scrape every replica's ``/metrics`` (bounded per replica by
+        ``scrape_timeout_s``) and re-export with ``replica``/``group``
+        labels, plus the fleet-wide ``pio_fleet_pressure`` gauge
+        derived from the bucket-merged queue-wait/device-dispatch
+        histograms. Scrapes bypass the data-path breakers on purpose: a
+        failed scrape must not mark a replica down for traffic, it just
+        reports ``pio_fleet_scrape_ok 0``."""
+        scrape_ok = Metric(
+            name="pio_fleet_scrape_ok", kind="gauge",
+            help="1 when the replica answered the fan-out scrape")
+
+        def scrape(backend) -> tuple[dict, list | None]:
+            labels = {"replica": backend.id, "group": backend.group}
+            try:
+                response = backend.transport.request(
+                    "GET", "/metrics",
+                    timeout=self.config.scrape_timeout_s)
+                if response.status != 200:
+                    raise ExpositionParseError(
+                        f"HTTP {response.status}")
+                return labels, parse_exposition(response.body.decode())
+            except Exception as exc:  # noqa: BLE001 — degrade per replica
+                logger.warning("fleet scrape of %s failed: %s",
+                               backend.id, exc)
+                return labels, None
+
+        sources: list[tuple[str, list]] = []
+        queue_snaps: list = []
+        device_snaps: list = []
+        # concurrent per replica (fan_out): the scrape pays the slowest
+        # replica's timeout, not the sum over black-holed ones
+        scraped = fan_out(self.router.membership.backends, scrape)
+        for backend, result in zip(self.router.membership.backends,
+                                   scraped):
+            if result is None:
+                continue
+            labels, families = result
+            if families is None:
+                scrape_ok.samples.append((labels, 0.0))
+                continue
+            scrape_ok.samples.append((labels, 1.0))
+            for fam in families:
+                if fam.name == "pio_serving_queue_wait_seconds":
+                    queue_snaps.extend(s for _, s in fam.histograms)
+                elif fam.name == "pio_serving_device_dispatch_seconds":
+                    device_snaps.extend(s for _, s in fam.histograms)
+            sources.append((backend.id, relabel(families, labels)))
+        merged = merge_sources(sources, source_label="replica")
+        merged.append(scrape_ok)
+        if queue_snaps and device_snaps:
+            merged.append(pressure_metric(
+                merge_snapshots(queue_snaps),
+                merge_snapshots(device_snaps)))
+        return render_metrics(merged)
+
+    def stitched_trace(self, trace_id: str) -> tuple:
+        """``GET /traces.json?trace_id=`` — fan out to every replica's
+        (and worker sibling's) trace ring, join the segments that share
+        ``trace_id`` into one tree (obs/stitch.py)."""
+        segments = self.trace_log.find(trace_id)
+        hub = self.worker_hub
+        if hub is not None:
+            for worker_id, body in hub.fetch_peer_bodies("/traces.json"):
+                try:
+                    docs = json.loads(body).get("traces", [])
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    continue
+                for doc in docs:
+                    if doc.get("traceId") == trace_id:
+                        doc.setdefault("source", f"worker:{worker_id}")
+                        segments.append(doc)
+        def fetch_ring(backend) -> list | None:
+            try:
+                response = backend.transport.request(
+                    "GET", "/traces.json",
+                    timeout=self.config.scrape_timeout_s)
+                return json.loads(response.body).get("traces", [])
+            except Exception:  # noqa: BLE001 — a dead replica's ring is gone anyway
+                return None
+
+        scrape_errors = 0
+        # concurrent per replica: the merge pays the slowest replica's
+        # timeout, not the sum (fleet/transport.fan_out)
+        rings = fan_out(self.router.membership.backends, fetch_ring)
+        for backend, docs in zip(self.router.membership.backends, rings):
+            if docs is None:
+                scrape_errors += 1
+                continue
+            for doc in docs:
+                if doc.get("traceId") == trace_id:
+                    doc.setdefault("source", backend.id)
+                    segments.append(doc)
+        tree = stitch(segments)
+        if tree is None:
+            return (404, {"traceId": trace_id, "found": False,
+                          "scrapeErrors": scrape_errors,
+                          "message": f"no segment of trace {trace_id} "
+                                     "found on router or replicas"})
+        return (200, {"traceId": trace_id, "found": True,
+                      "segments": len(segments),
+                      "scrapeErrors": scrape_errors,
+                      "trace": tree})
 
     def readyz(self) -> tuple:
         """Ready iff at least one replica is routable — a router with
@@ -302,6 +492,8 @@ class _Handler(socketserver.StreamRequestHandler):
         "/fleet": "fleet",
         "/fleet/canary": "fleet",
         "/metrics": "metrics",
+        "/fleet/metrics": "metrics",
+        "/traces.json": "traces",
         "/": "status",
     }
 
@@ -327,18 +519,57 @@ class _Handler(socketserver.StreamRequestHandler):
     def _dispatch(self, sock, method: str, target: str,
                   headers: Mapping[str, str], body: bytes) -> bool:
         """Route one request; returns False when the connection must
-        close (client asked, or the write failed)."""
+        close (client asked, or the write failed). Observability
+        envelope (docs/observability.md): optional ROOT trace segment
+        for the query path (inbound context adopted when well-formed —
+        a malformed/oversized header falls back to fresh local ids,
+        never a 500), SLO outcome recording, and the access log with
+        the routing metadata (replica, attempts, hedge/retry flags)."""
         t_start = time.perf_counter()
         path, _, query = target.partition("?")
         request_id = resolve_request_id(headers)
         params = ({k: v[0] for k, v in parse_qs(query).items()}
                   if query else {})
         status = 500
+        routed = method == "POST" and path == "/queries.json"
+        trace = None
+        if routed and self.service.tracing:
+            inbound_id, inbound_parent = parse_trace_context(headers)
+            trace = start_trace(
+                "queries.json", request_id=request_id,
+                trace_id=inbound_id, parent_span_id=inbound_parent,
+                service="router")
+        log_extra: dict = {}
         try:
-            result = self.service.handle(
-                method, path, params, headers, body, request_id)
+            if trace is not None:
+                with use_trace(trace):
+                    result = self.service.handle(
+                        method, path, params, headers, body, request_id)
+            else:
+                result = self.service.handle(
+                    method, path, params, headers, body, request_id)
             if isinstance(result, RouterResponse):
                 status = result.status
+                if routed:
+                    log_extra = {
+                        **({"replica": result.backend_id}
+                           if result.backend_id else {}),
+                        **({"group": result.group}
+                           if result.group else {}),
+                        "attempts": result.attempts,
+                        "retried": result.retried,
+                        "hedged": result.hedged,
+                    }
+                if trace is not None:
+                    # the router's trace id wins the response header:
+                    # it equals the replica's when the replica adopted
+                    # the forwarded context, and it is the only id a
+                    # client can stitch by when the replica traced
+                    # nothing
+                    result.headers = {
+                        k: v for k, v in result.headers.items()
+                        if k.lower() != "x-pio-trace-id"}
+                    result.headers[TRACE_ID_HEADER] = trace.trace_id
                 ok = self._send(sock, status, result.body,
                                 result.content_type, result.headers,
                                 request_id)
@@ -356,10 +587,19 @@ class _Handler(socketserver.StreamRequestHandler):
             dt = time.perf_counter() - t_start
             self.service.request_latency.observe(
                 self._ROUTE_LABELS.get(path, "other"), dt)
+            if routed:
+                # SLO truth at the router = what the CLIENT saw: any
+                # 5xx (shed, expired, all-replicas-failed included)
+                # spends error budget
+                self.service.slo.record(ok=status < 500, latency_s=dt)
+            if trace is not None:
+                trace.finish(status=status, **{
+                    k: v for k, v in log_extra.items() if v or k == "attempts"})
+                self.service.trace_log.record(trace)
             if self.service.access_log:
                 emit_access_log(
                     "router", method, path, status, dt, request_id,
-                    client=self.client_address[0])
+                    client=self.client_address[0], **log_extra)
         return ok and headers.get("connection", "").lower() != "close"
 
     def _send(self, sock, status: int, body: bytes, ctype: str,
@@ -406,4 +646,5 @@ class RouterServer(RestServer):
         super().serve_forever()
 
     def _on_close(self) -> None:
+        self.service.close()
         self.router.close()

@@ -99,9 +99,11 @@ def _cmd_router(args, storage: Storage) -> int:
         canary_backends=tuple(args.canary_backend or ()),
         router_key=args.router_key,
         access_log=args.access_log,
+        tracing=args.tracing,
         reuse_port=workers > 1,
         **{k: v for k, v in {
             "probe_interval_s": args.probe_interval_s,
+            "probe_timeout_s": args.probe_timeout_s,
             "down_after": args.down_after,
             "up_after": args.up_after,
             "max_inflight": args.max_inflight,
@@ -114,6 +116,7 @@ def _cmd_router(args, storage: Storage) -> int:
     if workers > 1:
         import multiprocessing
         import socket as _socket
+        import tempfile
 
         if config.port == 0:
             # every worker must share ONE concrete port; resolve the
@@ -123,6 +126,13 @@ def _cmd_router(args, storage: Storage) -> int:
             config = dataclasses.replace(config,
                                          port=probe.getsockname()[1])
             probe.close()
+        # worker peering spool (fleet/workers.py): each worker
+        # registers its loopback peer endpoint here, so a /metrics
+        # scrape landing on ONE SO_REUSEPORT worker reports ALL of
+        # them (docs/fleet.md)
+        config = dataclasses.replace(
+            config,
+            worker_spool_dir=tempfile.mkdtemp(prefix="pio-router-workers-"))
         for _ in range(workers - 1):
             proc = multiprocessing.Process(
                 target=_router_worker, args=(config,), daemon=True)
@@ -133,13 +143,82 @@ def _cmd_router(args, storage: Storage) -> int:
           f"({len(config.backends)} stable / "
           f"{len(config.canary_backends)} canary backend(s), "
           f"{workers} worker(s))")
+    if worker_procs:
+        # SIGTERM's default action kills the parent without running
+        # finally/atexit, orphaning the SO_REUSEPORT workers on the
+        # shared port (they keep serving with a stale spool). Route it
+        # through KeyboardInterrupt so the reap below always runs.
+        import signal
+
+        def _on_sigterm(signum, frame):
+            raise KeyboardInterrupt
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
-        server.stop()
+        pass
     finally:
+        server.stop()
         for proc in worker_procs:
             proc.terminate()
+        for proc in worker_procs:
+            proc.join(timeout=5)
+        if config.worker_spool_dir:
+            # terminate() is SIGTERM: workers die without running
+            # WorkerHub.close, leaving their spool entries behind —
+            # the parent mkdtemp'd the dir, the parent removes it
+            import shutil
+
+            shutil.rmtree(config.worker_spool_dir, ignore_errors=True)
+    return 0
+
+
+def _cmd_trace(args, storage: Storage) -> int:
+    """`pio trace <trace_id>` — fetch the stitched cross-process tree
+    of one fleet request from the router's merge endpoint
+    (GET /traces.json?trace_id=) and render it as a text tree or
+    Chrome trace-viewer JSON (docs/observability.md)."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from predictionio_tpu.obs.stitch import render_tree, to_chrome_trace
+
+    url = (f"http://{args.router}/traces.json?"
+           f"trace_id={urllib.parse.quote(args.trace_id)}")
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as r:
+            doc = json.load(r)
+    except urllib.error.HTTPError as e:
+        try:
+            doc = json.load(e)
+        except json.JSONDecodeError:
+            doc = {}
+        print(f"[ERROR] trace {args.trace_id} not found "
+              f"({doc.get('message', f'HTTP {e.code}')})")
+        return 1
+    except OSError as e:
+        print(f"[ERROR] router {args.router} unreachable: {e}")
+        return 1
+    tree = doc.get("trace")
+    if not doc.get("found") or tree is None:
+        print(f"[ERROR] trace {args.trace_id} not found")
+        return 1
+    if args.chrome:
+        payload = json.dumps(to_chrome_trace(tree), indent=2)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(payload)
+            print(f"[INFO] Chrome trace written to {args.out} "
+                  f"(open chrome://tracing or ui.perfetto.dev)")
+        else:
+            print(payload)
+    else:
+        print(render_tree(tree))
+        if doc.get("scrapeErrors"):
+            print(f"[WARN] {doc['scrapeErrors']} replica trace ring(s) "
+                  "unreachable; the tree may be missing segments")
     return 0
 
 
@@ -379,6 +458,12 @@ def build_parser() -> argparse.ArgumentParser:
     # defaults (the ServerConfig discipline — no re-hard-coding here)
     p.add_argument("--probe-interval-s", type=float, default=None,
                    dest="probe_interval_s")
+    p.add_argument("--probe-timeout-s", type=float, default=None,
+                   dest="probe_timeout_s",
+                   help="per-probe socket bound; size for the replica's "
+                        "p99 under load, NOT idle latency — a saturated "
+                        "CPython replica can sit >1s on /healthz "
+                        "(docs/fleet.md runbooks)")
     p.add_argument("--down-after", type=int, default=None, dest="down_after",
                    help="consecutive failed probes before mark-down")
     p.add_argument("--up-after", type=int, default=None, dest="up_after",
@@ -403,6 +488,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--access-log", action=argparse.BooleanOptionalAction,
                    default=None, dest="access_log",
                    help="structured JSON access logs")
+    p.add_argument("--tracing", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="root span per routed query (admission, pick, "
+                        "attempt/retry/hedge) with trace context "
+                        "forwarded to replicas for cross-process "
+                        "stitching; see `pio trace`")
+
+    p = sub.add_parser(
+        "trace",
+        help="fetch and render one stitched fleet trace from the "
+             "router (docs/observability.md)",
+    )
+    p.add_argument("trace_id", help="the X-PIO-Trace-Id of the request")
+    p.add_argument("--router", default="127.0.0.1:8100",
+                   metavar="HOST:PORT",
+                   help="router address serving /traces.json (default "
+                        "127.0.0.1:8100)")
+    p.add_argument("--chrome", action="store_true",
+                   help="emit Chrome trace-viewer JSON instead of the "
+                        "text tree (open in chrome://tracing or "
+                        "ui.perfetto.dev)")
+    p.add_argument("--out", default=None,
+                   help="write --chrome JSON to this file")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="HTTP timeout for the router fetch")
 
     p = sub.add_parser("app", help="app administration")
     app_sub = p.add_subparsers(dest="app_command", required=True)
@@ -464,15 +574,16 @@ def build_parser() -> argparse.ArgumentParser:
 COMPUTE_COMMANDS = frozenset({"train", "eval", "deploy", "run"})
 
 #: commands that never touch storage — they must work (CI lint hooks,
-#: version probes, the storage-free fleet router) even when
-#: PIO_STORAGE_* env is broken or absent
-STORAGE_FREE_COMMANDS = frozenset({"version", "lint", "router"})
+#: version probes, the storage-free fleet router and its trace viewer)
+#: even when PIO_STORAGE_* env is broken or absent
+STORAGE_FREE_COMMANDS = frozenset({"version", "lint", "router", "trace"})
 
 _COMMANDS = {
     "version": _cmd_version,
     "status": _cmd_status,
     "eventserver": _cmd_eventserver,
     "router": _cmd_router,
+    "trace": _cmd_trace,
     "app": _cmd_app,
     "accesskey": _cmd_accesskey,
     "lint": _cmd_lint,

@@ -27,7 +27,7 @@ import logging
 import threading
 from typing import Sequence
 
-from predictionio_tpu.fleet.transport import BackendTransport
+from predictionio_tpu.fleet.transport import BackendTransport, fan_out
 from predictionio_tpu.utils.resilience import (
     SYSTEM_CLOCK,
     CircuitBreaker,
@@ -253,20 +253,7 @@ class FleetMembership:
         not everyone else's — sequential probing made one partitioned
         backend stretch every pass by its timeout, delaying mark-down
         and mark-up of healthy-streak transitions fleet-wide."""
-        if len(self.backends) <= 1:
-            for backend in self.backends:
-                self._probe_and_record(backend)
-            return
-        threads = [
-            threading.Thread(target=self._probe_and_record,
-                             args=(backend,), daemon=True,
-                             name=f"pio-fleet-probe-{backend.id}")
-            for backend in self.backends
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        fan_out(self.backends, self._probe_and_record)
 
     def _run(self) -> None:
         while not self._stop.is_set():

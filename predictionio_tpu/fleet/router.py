@@ -54,6 +54,12 @@ from predictionio_tpu.fleet.membership import (
 from predictionio_tpu.fleet.stats import RouterStats
 from predictionio_tpu.fleet.transport import UpstreamResponse
 from predictionio_tpu.obs.histogram import LatencyHistogram
+from predictionio_tpu.obs.trace import (
+    PARENT_SPAN_HEADER,
+    TRACE_ID_HEADER,
+    Trace,
+    active_trace,
+)
 from predictionio_tpu.utils.resilience import (
     SYSTEM_CLOCK,
     Clock,
@@ -85,12 +91,21 @@ class UpstreamStatusError(TransientError):
 @dataclasses.dataclass
 class RouterResponse:
     """What the HTTP layer writes back: status, raw body bytes (passed
-    through, never re-encoded), content type, extra headers."""
+    through, never re-encoded), content type, extra headers — plus the
+    routing metadata the access log and traces report (which replica
+    answered, how many attempts it took, whether the hedge/retry
+    machinery fired)."""
 
     status: int
     body: bytes
     content_type: str = "application/json; charset=UTF-8"
     headers: dict[str, str] = dataclasses.field(default_factory=dict)
+    #: routing metadata (None/0/False on non-routed responses)
+    backend_id: str | None = None
+    group: str | None = None
+    attempts: int = 0
+    hedged: bool = False
+    retried: bool = False
 
     @classmethod
     def error(cls, status: int, message: str,
@@ -213,6 +228,21 @@ class RouterConfig:
     router_key: str | None = None
     #: structured access logs; None defers to PIO_ACCESS_LOG
     access_log: bool | None = None
+    #: per-request root spans on the forward path (admission, pick,
+    #: attempt/retry/hedge), with trace context forwarded to replicas
+    #: so the fleet trace stitches back together; None defers to the
+    #: PIO_TRACE env var (the ServerConfig discipline)
+    tracing: bool | None = None
+    #: socket bound for every scrape-time fan-out fetch — worker peers,
+    #: replica /metrics behind /fleet/metrics, /traces.json stitching.
+    #: Every cross-process fetch on these paths must be timed (the
+    #: untimed-blocking-io lint contract): a wedged peer costs one
+    #: timeout, never a hung scrape
+    scrape_timeout_s: float = _env_field("SCRAPE_TIMEOUT_S", 2.0, float)
+    #: directory where `--workers N` processes register their loopback
+    #: peer endpoints (fleet/workers.py) so a /metrics scrape landing
+    #: on one worker can report all of them; None = no worker peering
+    worker_spool_dir: str | None = None
     #: bind with SO_REUSEPORT so N router worker processes share one
     #: listen port (`pio router --workers N`): one CPython router tops
     #: out on its GIL long before the fleet does — workers scale the
@@ -325,10 +355,16 @@ class FleetRouter:
     # -- the route ----------------------------------------------------------
     def route(self, body: bytes, headers: Mapping[str, str],
               request_id: str) -> RouterResponse:
-        """Forward one ``POST /queries.json`` (module docstring)."""
+        """Forward one ``POST /queries.json`` (module docstring). The
+        ambient trace (bound by the HTTP handler when tracing is on)
+        gains admission/pick/attempt spans; with tracing off the
+        ``active_trace()`` read is the whole cost."""
+        trace = active_trace()
         if not self._admit():
             self.stats.bump("requests")
             self.stats.bump("sheds")
+            if trace is not None:
+                trace.tags["outcome"] = "shed"
             return RouterResponse.error(
                 503, "fleet saturated; retry shortly",
                 {"Retry-After": "1"})
@@ -343,7 +379,7 @@ class FleetRouter:
             group = self.canary.pick_group()
             self.stats.bump_request(group)
             return self._route_with_retry(group, body, headers,
-                                          request_id, deadline)
+                                          request_id, deadline, trace)
         finally:
             self._release()
 
@@ -376,16 +412,30 @@ class FleetRouter:
 
     def _route_with_retry(self, group: str, body: bytes,
                           headers: Mapping[str, str], request_id: str,
-                          deadline: float | None) -> RouterResponse:
+                          deadline: float | None,
+                          trace: Trace | None = None) -> RouterResponse:
         tried: set[str] = set()
         last_failure: BaseException | None = None
+        #: hedge firings survive a failed attempt here — the ``hedged``
+        #: flag _forward returns is lost when the attempt RAISES, and
+        #: deriving it from len(tried) conflated a failed hedge with a
+        #: retry in the access log's routing verdict
+        meta = {"hedges": 0}
+        retried = False
         for attempt in (0, 1):
             remaining = self._remaining(deadline)
             if remaining is not None and remaining <= 0:
                 self.stats.bump("expired")
-                return RouterResponse.error(
+                out = RouterResponse.error(
                     503, "request deadline exceeded before a replica "
                          "could answer", {"Retry-After": "1"})
+                # a deadline blown AFTER attempt 0 already exchanged
+                # with replicas (possibly a hedge pair) — the access
+                # log's routing verdict must count them, not say 0
+                out.attempts = len(tried)
+                out.retried = retried
+                out.hedged = meta["hedges"] > 0
+                return out
             backend, actual_group = self._pick(group, tried)
             if backend is None:
                 if last_failure is not None:
@@ -396,11 +446,19 @@ class FleetRouter:
                     {"Retry-After": f"{max(1, round(self.membership.probe_interval_s)):d}"})
             if attempt > 0:
                 self.stats.bump("retries")
+                retried = True
             try:
-                response = self._forward(backend, actual_group, tried,
-                                         body, headers, request_id,
-                                         deadline)
-                return self._passthrough(response)
+                response, served_id, hedged = self._forward(
+                    backend, actual_group, tried, body, headers,
+                    request_id, deadline, trace,
+                    label="retry" if attempt else "attempt", meta=meta)
+                out = self._passthrough(response)
+                out.backend_id = served_id
+                out.group = actual_group
+                out.attempts = attempt + 1 + meta["hedges"]
+                out.retried = retried
+                out.hedged = hedged or meta["hedges"] > 0
+                return out
             except StorageUnavailableError as exc:
                 self.stats.bump("upstream_errors")
                 last_failure = exc
@@ -411,10 +469,18 @@ class FleetRouter:
         # else a 502 naming the failure
         response = _embedded_response(last_failure)
         if response is not None:
-            return self._passthrough(response)
-        return RouterResponse.error(
-            502, f"all replicas failed: {last_failure}",
-            {"Retry-After": "1"})
+            out = self._passthrough(response)
+        else:
+            out = RouterResponse.error(
+                502, f"all replicas failed: {last_failure}",
+                {"Retry-After": "1"})
+        # every exchanged replica is in `tried` on this path (the
+        # except clause adds non-hedge failures, _forward adds both
+        # hedge-race ids), so its size IS the attempt count
+        out.attempts = max(1, len(tried))
+        out.retried = retried
+        out.hedged = meta["hedges"] > 0
+        return out
 
     def _passthrough(self, response: UpstreamResponse) -> RouterResponse:
         out = RouterResponse(
@@ -432,13 +498,30 @@ class FleetRouter:
 
     # -- forwarding (single + hedged) ---------------------------------------
     def _forward_headers(self, headers: Mapping[str, str],
-                         request_id: str,
-                         deadline: float | None) -> dict[str, str]:
+                         request_id: str, deadline: float | None,
+                         trace: Trace | None = None,
+                         parent_span: str = "") -> dict[str, str]:
         fwd = {"X-PIO-Request-Id": request_id}
         for name in _FORWARD_HEADERS:
             value = headers.get(name)
             if value:
                 fwd[name] = value
+        if trace is not None:
+            # cross-process stitching (obs/stitch.py): the replica's
+            # trace segment joins THIS trace, nested under the attempt
+            # span whose id rides the parent-span header
+            fwd[TRACE_ID_HEADER] = trace.trace_id
+            if parent_span:
+                fwd[PARENT_SPAN_HEADER] = parent_span
+        else:
+            # an untraced router still relays CLIENT-supplied context
+            # so an upstream tracer (another router tier, a test
+            # harness) keeps its continuity through this hop
+            for name in (TRACE_ID_HEADER.lower(),
+                         PARENT_SPAN_HEADER.lower()):
+                value = headers.get(name)
+                if value:
+                    fwd[name] = value
         if deadline is not None:
             # the REMAINING budget, floored at 1ms: the backend must
             # see the end-to-end deadline, not the client's original
@@ -448,11 +531,21 @@ class FleetRouter:
 
     def _exchange(self, backend: Backend, group: str,
                   body: bytes, headers: Mapping[str, str],
-                  request_id: str,
-                  deadline: float | None) -> UpstreamResponse:
+                  request_id: str, deadline: float | None,
+                  trace: Trace | None = None,
+                  label: str = "attempt") -> UpstreamResponse:
         """ONE attempt against ONE replica under its resilience policy.
         Raises StorageUnavailableError on transport failure, transient
-        status, or an open breaker; returns any other response."""
+        status, or an open breaker; returns any other response.
+
+        May run on a hedge pool thread, so the trace is passed
+        EXPLICITLY (no ambient contextvar there) and spans are appended
+        with the lock-free ``add_span`` contract: the attempt's span id
+        is reserved up front — it must ride the forward headers before
+        the exchange runs — and recorded once the exchange finishes,
+        so a hedge loser lands as its own sibling span and can never
+        corrupt the winner's subtree."""
+        parent_span = trace.reserve_span_id() if trace is not None else ""
 
         def attempt() -> UpstreamResponse:
             nonlocal attempted
@@ -463,7 +556,8 @@ class FleetRouter:
                 timeout = max(0.001, min(timeout, remaining))
             response = backend.transport.request(
                 "POST", "/queries.json",
-                headers=self._forward_headers(headers, request_id, deadline),
+                headers=self._forward_headers(headers, request_id,
+                                              deadline, trace, parent_span),
                 body=body, timeout=timeout)
             if is_transient_http_status(response.status):
                 # the shared retryability contract (utils/resilience):
@@ -493,7 +587,8 @@ class FleetRouter:
                         "path: %s", backend.id, cause)
             raise
         finally:
-            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            dt = t1 - t0
             backend.done()
             if attempted:
                 # a breaker short-circuit never reached the replica:
@@ -502,6 +597,13 @@ class FleetRouter:
                 # racing one half-open probe slot would spuriously
                 # abort a recovered canary) or the latency histograms
                 self.stats.observe_upstream(group, dt)
+                if trace is not None:
+                    # the attempt span, under its pre-reserved id (the
+                    # one the replica's segment names as its parent)
+                    trace.add_span(
+                        f"{label}[{backend.id}]"
+                        + ("" if ok else "!failed"),
+                        t0, t1, span_id=parent_span)
                 if ok and self.config.hedge:
                     # the hedge-delay histogram only matters when
                     # hedging can fire; disabled, its lock+bisect
@@ -512,29 +614,42 @@ class FleetRouter:
 
     def _forward(self, backend: Backend, group: str, tried: set[str],
                  body: bytes, headers: Mapping[str, str], request_id: str,
-                 deadline: float | None) -> UpstreamResponse:
-        """The primary exchange, optionally raced against one hedge."""
+                 deadline: float | None, trace: Trace | None = None,
+                 label: str = "attempt", meta: dict | None = None,
+                 ) -> tuple[UpstreamResponse, str, bool]:
+        """The primary exchange, optionally raced against one hedge.
+        Returns ``(response, served_backend_id, hedge_fired)`` — with a
+        hedge in flight the WINNER may be either replica, and the
+        access log / trace tags must name the one that actually
+        answered. ``meta["hedges"]`` is bumped when the hedge FIRES, so
+        the caller still knows about it when both attempts fail and
+        this raises instead of returning."""
         if not self.config.hedge:
-            return self._exchange(backend, group, body, headers,
-                                  request_id, deadline)
+            return (self._exchange(backend, group, body, headers,
+                                   request_id, deadline, trace, label),
+                    backend.id, False)
         remaining = self._remaining(deadline)
         alternates = self.membership.routable(
             group, exclude=tried | {backend.id})
         if not self.hedge_policy.should_hedge(len(alternates), remaining):
-            return self._exchange(backend, group, body, headers,
-                                  request_id, deadline)
+            return (self._exchange(backend, group, body, headers,
+                                   request_id, deadline, trace, label),
+                    backend.id, False)
         primary: Future = self._pool.submit(
             self._exchange, backend, group, body, headers, request_id,
-            deadline)
+            deadline, trace, label)
         done, _ = wait([primary], timeout=self.hedge_policy.delay_s())
         if done:
             tried.add(backend.id)
-            return primary.result()  # raises through to the retry loop
+            # raises through to the retry loop on failure
+            return primary.result(), backend.id, False
         hedge_backend = min(alternates, key=lambda b: b.inflight)
         self.stats.bump("hedges")
+        if meta is not None:
+            meta["hedges"] += 1
         hedge: Future = self._pool.submit(
             self._exchange, hedge_backend, group, body, headers,
-            request_id, deadline)
+            request_id, deadline, trace, "hedge")
         tried.add(backend.id)
         tried.add(hedge_backend.id)
         pending = {primary, hedge}
@@ -550,7 +665,9 @@ class FleetRouter:
                 if exc is None:
                     if fut is hedge:
                         self.stats.bump("hedge_wins")
-                    return fut.result()
+                    winner = (hedge_backend.id if fut is hedge
+                              else backend.id)
+                    return fut.result(), winner, True
                 failure = exc
         if failure is not None:
             raise failure

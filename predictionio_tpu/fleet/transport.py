@@ -33,12 +33,57 @@ replica trickling bytes cannot hold a router thread past the deadline.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import socket
+import threading
 import time
-from typing import Mapping
+from typing import Callable, Iterable, Mapping, TypeVar
 
 from predictionio_tpu.utils.resilience import TransientError  # noqa: F401  (re-export for callers)
+
+logger = logging.getLogger(__name__)
+
+_T = TypeVar("_T")
+_R = TypeVar("_R")
+
+
+def fan_out(items: Iterable[_T],
+            fn: Callable[[_T], _R]) -> list[_R | None]:
+    """Run ``fn`` over ``items`` CONCURRENTLY (one thread per item, the
+    probe-pass idiom from fleet/membership.py) and return results in
+    item order. Scrape-time fan-outs must pay the SLOWEST target's
+    timeout, not the sum — sequentially, three black-holed replicas
+    turn a "bounded" 2s-per-target scrape into 6s of wall clock and
+    blow the Prometheus scrape deadline. ``fn`` is expected to handle
+    its own per-target failures (degrade, don't raise); an escaped
+    exception is logged and yields ``None`` in that slot."""
+    items = list(items)
+
+    def run(item: _T) -> _R | None:
+        try:
+            return fn(item)
+        except Exception:  # noqa: BLE001 — one target must not kill the fan-out
+            logger.exception("fan-out target failed")
+            return None
+
+    if len(items) <= 1:
+        return [run(item) for item in items]
+    results: list[_R | None] = [None] * len(items)
+
+    def runner(idx: int, item: _T) -> None:
+        results[idx] = run(item)
+
+    threads = [
+        threading.Thread(target=runner, args=(i, item), daemon=True,
+                         name=f"pio-fan-out-{i}")
+        for i, item in enumerate(items)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
 
 #: response headers the router forwards / acts on; everything else an
 #: upstream sends is dropped at the parse (the router is not a general

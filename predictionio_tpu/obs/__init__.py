@@ -2,7 +2,7 @@
 registry, and Prometheus text export (docs/observability.md).
 
 Zero-dependency by design — the serving plane must not grow a client
-library for the privilege of being measured. Four layers:
+library for the privilege of being measured. Seven layers:
 
 - :mod:`~predictionio_tpu.obs.trace` — Dapper-style spans with ids,
   parent links, and contextvar propagation that survives the
@@ -13,7 +13,17 @@ library for the privilege of being measured. Four layers:
   server that adopts the existing ServingStats / IngestStats /
   resilience counters through scrape-time collectors;
 - :mod:`~predictionio_tpu.obs.exporter` — Prometheus text-format
-  rendering for ``GET /metrics``.
+  rendering for ``GET /metrics``;
+- :mod:`~predictionio_tpu.obs.aggregate` — exposition parsing and
+  cross-process merge rules (worker peering, ``/fleet/metrics``);
+- :mod:`~predictionio_tpu.obs.stitch` — cross-process trace stitching
+  plus text/Chrome-trace renderers (``pio trace``);
+- :mod:`~predictionio_tpu.obs.slo` — declarative SLOs evaluated into
+  multi-window burn-rate gauges and the fleet-pressure signal.
+
+The fan-out I/O that feeds aggregate/stitch lives in the FLEET tier
+(fleet/workers.py, api/router_server.py) — obs/ itself stays pure
+(scrapers pull; the plane never pushes — the lint invariant).
 
 The disabled path is near-free: one flag check and no allocation per
 request (``trace.start_trace`` is only called behind the server's
@@ -21,7 +31,18 @@ request (``trace.start_trace`` is only called behind the server's
 trace is active), so tracing defaults off in benches.
 """
 
-from predictionio_tpu.obs.exporter import render_prometheus
+from predictionio_tpu.obs.aggregate import (
+    merge_snapshots,
+    merge_sources,
+    parse_exposition,
+    relabel,
+    unescape_label_value,
+)
+from predictionio_tpu.obs.exporter import (
+    escape_label_value,
+    render_metrics,
+    render_prometheus,
+)
 from predictionio_tpu.obs.histogram import LatencyHistogram
 from predictionio_tpu.obs.registry import (
     HistogramFamily,
@@ -32,10 +53,20 @@ from predictionio_tpu.obs.registry import (
     server_info_collector,
     serving_collector,
 )
+from predictionio_tpu.obs.slo import (
+    SLOEngine,
+    SLOObjective,
+    fleet_pressure,
+    serving_pressure_collector,
+)
+from predictionio_tpu.obs.stitch import render_tree, stitch, to_chrome_trace
 from predictionio_tpu.obs.trace import (
+    PARENT_SPAN_HEADER,
+    TRACE_ID_HEADER,
     Trace,
     TraceLog,
     active_trace,
+    parse_trace_context,
     span,
     start_trace,
     tracing_default,
@@ -47,16 +78,33 @@ __all__ = [
     "LatencyHistogram",
     "Metric",
     "MetricRegistry",
+    "PARENT_SPAN_HEADER",
+    "SLOEngine",
+    "SLOObjective",
+    "TRACE_ID_HEADER",
     "Trace",
     "TraceLog",
     "active_trace",
+    "escape_label_value",
+    "fleet_pressure",
     "ingest_collector",
+    "merge_snapshots",
+    "merge_sources",
+    "parse_exposition",
+    "parse_trace_context",
+    "relabel",
+    "render_metrics",
     "render_prometheus",
+    "render_tree",
     "resilience_collector",
     "server_info_collector",
     "serving_collector",
+    "serving_pressure_collector",
     "span",
     "start_trace",
+    "stitch",
+    "to_chrome_trace",
     "tracing_default",
+    "unescape_label_value",
     "use_trace",
 ]

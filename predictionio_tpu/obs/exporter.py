@@ -15,9 +15,9 @@ renderer can't drift apart silently:
 
 from __future__ import annotations
 
-from typing import Mapping
+from typing import Iterable, Mapping
 
-from predictionio_tpu.obs.registry import MetricRegistry
+from predictionio_tpu.obs.registry import Metric, MetricRegistry
 
 #: the content type Prometheus scrapers expect for this format
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -27,10 +27,20 @@ def _escape_help(text: str) -> str:
     return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
-def _escape_label(value: str) -> str:
+def escape_label_value(value: str) -> str:
+    """Text-format 0.0.4 label-value escaping: backslash FIRST (or the
+    other escapes' backslashes would be doubled), then quote and
+    line-feed. The exact inverse lives in obs/aggregate.py
+    (``unescape_label_value``) and the pair is pinned by a round-trip
+    test with hostile values — replica addresses and SLO names become
+    label values on the fleet endpoints."""
     return (value.replace("\\", "\\\\")
             .replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+#: backward-compatible internal alias
+_escape_label = escape_label_value
 
 
 def _fmt_value(value: float) -> str:
@@ -53,16 +63,28 @@ def _fmt_labels(labels: Mapping[str, str]) -> str:
 def render_prometheus(registry: MetricRegistry) -> str:
     """Render every family in the registry, sorted by name so
     successive scrapes diff cleanly."""
+    return render_metrics(registry.collect())
+
+
+def render_metrics(metrics: Iterable[Metric]) -> str:
+    """Render an explicit family list — the registry-less path the
+    fleet aggregation endpoints use (merged worker/replica families
+    are plain :class:`Metric` lists, not a live registry)."""
     lines: list[str] = []
-    for metric in sorted(registry.collect(), key=lambda m: m.name):
+    for metric in sorted(metrics, key=lambda m: m.name):
         lines.append(f"# HELP {metric.name} {_escape_help(metric.help)}")
         lines.append(f"# TYPE {metric.name} {metric.kind}")
         if metric.kind == "histogram":
             for labels, snap in metric.histograms:
                 base = dict(labels)
                 # cumulative[-1] is the +Inf bucket; pairs below cover
-                # the finite bounds
+                # the finite bounds. A parsed +Inf-only snapshot
+                # (aggregate.parse_exposition) carries bounds=(inf,) —
+                # skip it or this renders a second, conflicting
+                # le="+Inf" line
                 for bound, cum in zip(snap.bounds, snap.cumulative):
+                    if bound == float("inf"):
+                        continue
                     lines.append(
                         f"{metric.name}_bucket"
                         f"{_fmt_labels({**base, 'le': repr(float(bound))})}"

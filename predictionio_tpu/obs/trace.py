@@ -28,12 +28,13 @@ from __future__ import annotations
 import contextlib
 import itertools
 import os
+import re
 import threading
 import time
 import uuid
 from collections import deque
 from contextvars import ContextVar
-from typing import Any, Iterator
+from typing import Any, Iterator, Mapping
 
 #: process-unique trace-id scheme: one random prefix per process plus a
 #: sequence — same uniqueness story as uuid4 for correlation purposes,
@@ -41,6 +42,47 @@ from typing import Any, Iterator
 #: itertools.count threads safely under the GIL (a single C call).
 _TRACE_ID_PREFIX = uuid.uuid4().hex[:16]
 _TRACE_ID_SEQ = itertools.count(1)
+
+#: span ids carry a per-SEGMENT prefix (fleet PR): a trace that crosses
+#: the router hop collects spans from several trace segments, and bare
+#: per-trace sequences ("s0", "s1") would collide between the router's
+#: segment and each replica's when the stitcher joins them — cycling
+#: the stitched parent links. The prefix is a per-process random part
+#: (unique across the fleet's processes w.h.p., no syscall per span)
+#: plus a per-process segment counter (unique across the many servers
+#: an e2e test runs in ONE process).
+_SPAN_ID_PREFIX = uuid.uuid4().hex[:6]
+_SPAN_SEG_SEQ = itertools.count(1)
+
+#: cross-process trace context headers (docs/observability.md): the
+#: router forwards the trace id plus the span id of ITS attempt span,
+#: so the replica's trace segment nests under the right attempt when
+#: the trees are stitched back together.
+TRACE_ID_HEADER = "X-PIO-Trace-Id"
+PARENT_SPAN_HEADER = "X-PIO-Parent-Span"
+
+#: inbound trace context is adopted only when it looks like ids this
+#: framework (or a well-behaved peer) mints — anything else (spaces,
+#: quotes, control bytes, unbounded length) is DROPPED and a fresh
+#: local trace is started instead: a hostile header must never inject
+#: into trace documents nor 500 the request.
+_TRACE_CTX_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
+
+
+def parse_trace_context(
+        headers: Mapping[str, str]) -> tuple[str | None, str | None]:
+    """``(trace_id, parent_span_id)`` from inbound headers, each None
+    when absent OR malformed/oversized (never raises — the caller
+    falls back to fresh local ids). ``headers`` may be an
+    ``email.Message`` (case-insensitive get) or a lowercased dict."""
+
+    def clean(name: str) -> str | None:
+        raw = headers.get(name) or headers.get(name.lower())
+        if raw and _TRACE_CTX_RE.match(raw):
+            return raw
+        return None
+
+    return clean(TRACE_ID_HEADER), clean(PARENT_SPAN_HEADER)
 
 
 def tracing_default() -> bool:
@@ -74,20 +116,34 @@ class Trace:
     race that cannot corrupt anything — measured as a real qps cost
     in the tracing-overhead bench phase."""
 
-    __slots__ = ("trace_id", "name", "request_id", "tags",
-                 "_t0", "_wall_start", "_spans", "_duration")
+    __slots__ = ("trace_id", "name", "request_id", "parent_span_id",
+                 "service", "tags", "_t0", "_wall_start", "_spans",
+                 "_span_seq", "_span_prefix", "_duration")
 
     def __init__(self, name: str, request_id: str | None = None,
-                 trace_id: str | None = None):
+                 trace_id: str | None = None,
+                 parent_span_id: str | None = None,
+                 service: str | None = None):
         self.trace_id = (trace_id
                          or f"{_TRACE_ID_PREFIX}{next(_TRACE_ID_SEQ):012x}")
         self.name = name
         self.request_id = request_id
+        #: the REMOTE span this whole segment nests under (the router's
+        #: attempt span id, forwarded via X-PIO-Parent-Span); None for
+        #: a root segment
+        self.parent_span_id = parent_span_id
+        #: which server recorded this segment ("router"/"engine"/...)
+        self.service = service
         self.tags: dict[str, Any] = {}
         self._t0 = time.perf_counter()
         self._wall_start = time.time()
         #: flat records: (name, parent_id, span_id, start_off_s, dur_s)
         self._spans: list[tuple[str, str, str, float, float]] = []
+        #: per-trace span-id sequence — ids must survive pre-allocation
+        #: (reserve_span_id) and concurrent hedge-thread appends, so a
+        #: counter, not len(self._spans) (GIL-atomic single C call)
+        self._span_seq = itertools.count()
+        self._span_prefix = f"{_SPAN_ID_PREFIX}{next(_SPAN_SEG_SEQ):x}"
         self._duration: float | None = None
 
     # -- span recording ------------------------------------------------------
@@ -95,18 +151,27 @@ class Trace:
         """Context manager timing one in-thread stage."""
         return _ActiveSpan(self, name, parent_id)
 
+    def reserve_span_id(self) -> str:
+        """A span id usable BEFORE its span is recorded — the router
+        must put its attempt span's id on the forward headers before
+        the attempt runs, then record the span with the reserved id
+        once the exchange finishes (``add_span(span_id=...)``)."""
+        return f"s{self._span_prefix}.{next(self._span_seq):x}"
+
     def add_span(self, name: str, start_perf: float, end_perf: float,
-                 parent_id: str = _ROOT_PARENT) -> str:
+                 parent_id: str = _ROOT_PARENT,
+                 span_id: str | None = None) -> str:
         """Record an interval measured elsewhere (e.g. the batcher's
         dispatcher thread timing queue-wait with its own clock reads).
         ``start_perf``/``end_perf`` are ``time.perf_counter`` values.
         Returns the new span id (usable as a parent link).
 
-        Span ids are a per-trace sequence, not uuids: they only need
-        to be unique WITHIN the trace (the trace_id provides global
-        uniqueness), and the hot path should not pay an os.urandom
-        read per span."""
-        span_id = f"s{len(self._spans):x}"
+        Span ids are a process prefix + per-trace sequence, not uuids:
+        the sequence keeps them unique within the trace, the prefix
+        across the processes a stitched fleet trace spans, and the hot
+        path never pays an os.urandom read per span."""
+        if span_id is None:
+            span_id = f"s{self._span_prefix}.{next(self._span_seq):x}"
         self._spans.append(
             (name, parent_id, span_id,
              start_perf - self._t0, max(0.0, end_perf - start_perf)))
@@ -150,6 +215,10 @@ class Trace:
         }
         if self.request_id:
             doc["requestId"] = self.request_id
+        if self.parent_span_id:
+            doc["parentSpanId"] = self.parent_span_id
+        if self.service:
+            doc["service"] = self.service
         if tags:
             doc["tags"] = tags
         return doc
@@ -193,10 +262,16 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
-def start_trace(name: str, request_id: str | None = None) -> Trace:
-    """A new root trace. Call sites gate this behind their tracing
-    flag — the flag check is the whole cost of the disabled path."""
-    return Trace(name, request_id=request_id)
+def start_trace(name: str, request_id: str | None = None,
+                trace_id: str | None = None,
+                parent_span_id: str | None = None,
+                service: str | None = None) -> Trace:
+    """A new root trace (or, with ``trace_id``/``parent_span_id`` from
+    :func:`parse_trace_context`, a CHILD SEGMENT of a cross-process
+    trace). Call sites gate this behind their tracing flag — the flag
+    check is the whole cost of the disabled path."""
+    return Trace(name, request_id=request_id, trace_id=trace_id,
+                 parent_span_id=parent_span_id, service=service)
 
 
 def active_trace() -> Trace | None:
@@ -248,6 +323,13 @@ class TraceLog:
     def snapshot(self) -> list[dict]:
         with self._lock:
             traces = list(reversed(self._ring))
+        return [t.to_dict() for t in traces]
+
+    def find(self, trace_id: str) -> list[dict]:
+        """Every recorded segment of one trace (a hedged request can
+        leave several segments with the same id in ONE ring)."""
+        with self._lock:
+            traces = [t for t in self._ring if t.trace_id == trace_id]
         return [t.to_dict() for t in traces]
 
     @property

@@ -343,6 +343,63 @@ class TestFeedbackLoop:
             server.stop()
             es.stop()
 
+    def test_feedback_post_carries_trace_context(self, storage):
+        """The engine→event feedback POST forwards the query's trace
+        context, so the event server's segment joins the query's
+        stitched tree (docs/observability.md: 'Replicas (and the event
+        server, for the feedback loop's engine→event POSTs) adopt
+        inbound context')."""
+        from predictionio_tpu.api.event_server import EventServer, EventServerConfig
+        from predictionio_tpu.storage.base import AccessKey, App
+
+        app_id = storage.get_meta_data_apps().insert(App(0, "fbtrace"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey("fbtkey", app_id, ()))
+        storage.get_events().init(app_id)
+        es = EventServer(storage, EventServerConfig(
+            ip="127.0.0.1", port=0, tracing=True))
+        es.start()
+
+        _train(storage, mult=2)
+        server = create_engine_server(
+            storage=storage,
+            config=ServerConfig(
+                ip="127.0.0.1", port=0, feedback=True, tracing=True,
+                event_server_ip="127.0.0.1", event_server_port=es.port,
+                access_key="fbtkey",
+            ),
+        )
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/queries.json",
+                data=json.dumps({"x": 3}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert resp.status == 200
+                trace_id = resp.headers["X-PIO-Trace-Id"]
+            assert trace_id
+
+            # the feedback POST is async: poll the event server's trace
+            # ring for a segment adopting the query's trace id
+            deadline = time.time() + 20
+            seg = None
+            while time.time() < deadline and seg is None:
+                _, doc = _get(f"http://127.0.0.1:{es.port}"
+                              "/traces.json?accessKey=fbtkey")
+                seg = next((t for t in doc["traces"]
+                            if t.get("traceId") == trace_id), None)
+                time.sleep(0.05)
+            assert seg, "no event-server segment adopted the trace id"
+            assert seg["service"] == "event"
+            # it nests under the engine's reserved feedback span
+            assert seg.get("parentSpanId", "").startswith("s")
+        finally:
+            server.stop()
+            es.stop()
+
 
 def test_wire_bare_tuple_coercion():
     """Bare-``tuple`` dataclass fields coerce JSON lists (frozen Query

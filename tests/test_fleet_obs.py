@@ -1,0 +1,829 @@
+"""Fleet observability (docs/observability.md, docs/fleet.md): cross-
+process trace stitching, worker/fleet metrics aggregation, and SLO
+burn-rate signals.
+
+The acceptance scenarios:
+
+- one request through router + 2 replicas with a FORCED cross-replica
+  retry yields a single stitched trace tree — router attempt spans
+  parent the replica segments, the queue-wait/device-dispatch split
+  visible under the winning attempt;
+- under 2 SO_REUSEPORT workers a ``/metrics`` scrape parses and
+  reports counter totals equal to the sum of per-worker traffic;
+- error rate driven past an SLO objective makes the fast-window
+  burn-rate gauge fire while the slow window lags (deterministic on
+  ManualClock; confirmed live over HTTP).
+
+Plus the satellite pins: label-value escaping round-trips through a
+REAL parser, malformed/oversized trace-context headers never 500,
+trace-id continuity across the router's retry, hedge losers cannot
+corrupt the winner's tree, ``PIO_ROUTER_PROBE_*`` env knobs, the
+enriched router access log, ``pio trace``, and the lint scope over the
+fan-out fetch paths.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import tempfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api.router_server import RouterServer
+from predictionio_tpu.fleet.router import RouterConfig
+from predictionio_tpu.fleet.workers import WorkerHub
+from predictionio_tpu.obs.aggregate import (
+    merge_snapshots,
+    merge_sources,
+    parse_exposition,
+    relabel,
+    unescape_label_value,
+)
+from predictionio_tpu.obs.exporter import (
+    escape_label_value,
+    render_metrics,
+)
+from predictionio_tpu.obs.histogram import LatencyHistogram
+from predictionio_tpu.obs.registry import Metric
+from predictionio_tpu.obs.slo import SLOEngine, SLOObjective, fleet_pressure
+from predictionio_tpu.obs.stitch import render_tree, stitch, to_chrome_trace
+from predictionio_tpu.obs.trace import Trace, parse_trace_context
+from predictionio_tpu.utils.resilience import ManualClock
+
+from tests.test_fleet_router import (
+    FaultProxy,
+    echo_server,
+    get_json,
+    post_query,
+    router_for,
+)
+from tests.test_observability import (
+    check_histogram_consistency,
+    parse_prometheus,
+)
+
+pytestmark = [pytest.mark.obs, pytest.mark.fleet]
+
+
+# ---------------------------------------------------------------------------
+# exposition round-trip + escaping (the satellite pin)
+# ---------------------------------------------------------------------------
+
+#: the values that broke naive escapers: replica addresses, SLO names,
+#: and hostile backslash/quote/newline compositions — `a\nb` with a
+#: LITERAL backslash-n is the classic sequential-replace corruption
+NASTY_LABELS = [
+    "127.0.0.1:8000",
+    "latency_500ms",
+    'va"l\nue',
+    "a\\nb",                      # literal backslash + n
+    "back\\slash\\\\double",
+    'mix\\"n\nmatch\\',
+]
+
+
+class TestEscapingRoundTrip:
+    @pytest.mark.parametrize("value", NASTY_LABELS)
+    def test_escape_unescape_inverse(self, value):
+        assert unescape_label_value(escape_label_value(value)) == value
+
+    def test_render_parse_round_trip_pins_label_values(self):
+        h = LatencyHistogram(bounds=(0.001, 1.0))
+        h.observe(0.5)
+        fams = [
+            Metric("pio_demo_total", "counter", "counter with \\ help",
+                   samples=[({"k": v}, float(i + 1))
+                            for i, v in enumerate(NASTY_LABELS)]),
+            Metric("pio_demo_seconds", "histogram", "hist",
+                   histograms=[({"replica": v}, h.snapshot())
+                               for v in NASTY_LABELS]),
+        ]
+        text = render_metrics(fams)
+        back = {m.name: m for m in parse_exposition(text)}
+        got = {labels["k"]: value
+               for labels, value in back["pio_demo_total"].samples}
+        assert got == {v: float(i + 1) for i, v in enumerate(NASTY_LABELS)}
+        hist_labels = {labels["replica"]
+                       for labels, _ in back["pio_demo_seconds"].histograms}
+        assert hist_labels == set(NASTY_LABELS)
+        for _, snap in back["pio_demo_seconds"].histograms:
+            assert snap.count == 1 and snap.cumulative[-1] == 1
+        # the independent in-test parser agrees (its unescape is a
+        # single pass too — sequential str.replace corrupted "a\\nb")
+        families = parse_prometheus(text)
+        keys = {dict(labels)["k"]
+                for (_, labels) in families["pio_demo_total"]["samples"]}
+        assert keys == set(NASTY_LABELS)
+
+
+class TestMerge:
+    def test_histogram_merge_same_and_union_ladders(self):
+        a = LatencyHistogram(bounds=(0.001, 0.1))
+        a.observe(0.05)
+        a.observe(5.0)
+        b = LatencyHistogram(bounds=(0.01,))
+        b.observe(0.005)
+        same = merge_snapshots([a.snapshot(), a.snapshot()])
+        assert same.count == 4 and same.cumulative == (0, 2, 4)
+        union = merge_snapshots([a.snapshot(), b.snapshot()])
+        assert union.bounds == (0.001, 0.01, 0.1)
+        assert union.count == 3 and union.cumulative == (0, 1, 2, 3)
+        assert union.sum == pytest.approx(5.055)
+
+    def test_inf_only_snapshot_merges_into_overflow(self):
+        # a scraped exposition with ONLY a +Inf bucket parses to
+        # bounds=(inf,) — its mass must land in the overflow, never in
+        # the union ladder (inf in the ladder rendered two conflicting
+        # le="+Inf" lines for the family)
+        (inf_only,) = parse_exposition(
+            "# HELP pio_x_seconds x\n"
+            "# TYPE pio_x_seconds histogram\n"
+            'pio_x_seconds_bucket{le="+Inf"} 7\n'
+            "pio_x_seconds_sum 3.5\n"
+            "pio_x_seconds_count 7\n")
+        (_, snap_inf), = inf_only.histograms
+        assert snap_inf.bounds == (float("inf"),)
+        a = LatencyHistogram(bounds=(0.001, 0.1))
+        a.observe(0.05)
+        merged = merge_snapshots([a.snapshot(), snap_inf])
+        assert merged.bounds == (0.001, 0.1)        # inf kept out
+        assert merged.cumulative == (0, 1, 8)
+        assert merged.count == 8
+        text = render_metrics([Metric(
+            "pio_x_seconds", "histogram", "x",
+            histograms=[({}, merged), ({"w": "b"}, snap_inf)])])
+        # exactly one +Inf line per label set, even for the unmerged
+        # inf-bounds snapshot re-exported as-is (relabel path)
+        assert text.count('le="+Inf"') == 2
+        assert 'le="inf"' not in text
+        (back,) = parse_exposition(text)
+        snaps = {tuple(labels.items()): s for labels, s in back.histograms}
+        assert snaps[()].cumulative == (0, 1, 8)
+        assert snaps[(("w", "b"),)].count == 7
+
+    def test_merge_sources_rules(self):
+        def fams(c, g):
+            return [
+                Metric("pio_c_total", "counter", "c", samples=[({}, c)]),
+                Metric("pio_g", "gauge", "g", samples=[({}, g)]),
+            ]
+
+        out = {m.name: m for m in merge_sources(
+            [("w1", fams(2.0, 1.0)), ("w2", fams(3.0, 7.0))])}
+        assert out["pio_c_total"].samples == [({}, 5.0)]
+        by_worker = {labels["worker"]: value
+                     for labels, value in out["pio_g"].samples}
+        assert by_worker == {"w1": 1.0, "w2": 7.0}
+
+    def test_kind_conflict_drops_family_not_scrape(self):
+        out = merge_sources([
+            ("w1", [Metric("pio_x", "gauge", "x", samples=[({}, 1.0)])]),
+            ("w2", [Metric("pio_x", "counter", "x", samples=[({}, 2.0)])]),
+        ])
+        assert out == []    # skewed family dropped, merge still returns
+
+    def test_relabel_does_not_overwrite(self):
+        m = Metric("pio_g", "gauge", "g",
+                   samples=[({"replica": "keep"}, 1.0)])
+        (out,) = relabel([m], {"replica": "new", "group": "stable"})
+        assert out.samples == [({"replica": "keep",
+                                 "group": "stable"}, 1.0)]
+
+
+# ---------------------------------------------------------------------------
+# stitcher units
+# ---------------------------------------------------------------------------
+
+def _segment(trace_id, name, service, start, spans,
+             parent_span_id=None, duration=5.0):
+    doc = {
+        "traceId": trace_id, "name": name, "service": service,
+        "startTime": start, "durationMs": duration,
+        "spans": spans,
+    }
+    if parent_span_id:
+        doc["parentSpanId"] = parent_span_id
+    return doc
+
+
+class TestStitch:
+    def test_two_segments_nest_under_attempt_span(self):
+        root = _segment("t1", "queries.json", "router", 100.0, [
+            {"name": "attempt[r1]", "spanId": "sA.0",
+             "startMs": 1.0, "durationMs": 3.0},
+        ])
+        child = _segment("t1", "queries.json", "engine", 100.0015, [
+            {"name": "predict", "spanId": "sB.0",
+             "startMs": 0.5, "durationMs": 1.0},
+        ], parent_span_id="sA.0")
+        tree = stitch([child, root])      # order must not matter
+        spans = {s["spanId"]: s for s in tree["spans"]}
+        seg_child = next(s for s in tree["spans"]
+                         if s.get("segment") and s["service"] == "engine")
+        assert seg_child["parentId"] == "sA.0"
+        # wall-clock alignment: child offsets shift by 1.5ms
+        assert seg_child["startMs"] == pytest.approx(1.5)
+        assert spans["sB.0"]["startMs"] == pytest.approx(2.0)
+        assert spans["sB.0"]["parentId"] == seg_child["spanId"]
+        text = render_tree(tree)
+        assert "attempt[r1]" in text and "predict" in text
+        chrome = to_chrome_trace(tree)
+        names = [e["name"] for e in chrome["traceEvents"]
+                 if e["ph"] == "X"]
+        assert "attempt[r1]" in names and "predict" in names
+
+    def test_orphan_segment_kept_and_flagged(self):
+        root = _segment("t2", "queries.json", "router", 100.0, [])
+        orphan = _segment("t2", "queries.json", "engine", 100.5, [],
+                          parent_span_id="s-never-collected")
+        tree = stitch([root, orphan])
+        seg = next(s for s in tree["spans"]
+                   if s.get("segment") and s["service"] == "engine")
+        assert seg["orphan"] is True
+        assert seg["parentId"] == "seg0"    # attached at the root
+        assert "(orphan)" in render_tree(tree)
+
+    def test_cyclic_input_renders_partially_not_forever(self):
+        evil = _segment("t3", "queries.json", "router", 100.0, [
+            {"name": "a", "spanId": "sX", "parentId": "sY",
+             "startMs": 0.0, "durationMs": 1.0},
+            {"name": "b", "spanId": "sY", "parentId": "sX",
+             "startMs": 0.0, "durationMs": 1.0},
+        ])
+        tree = stitch([evil])
+        render_tree(tree)                   # must terminate
+        to_chrome_trace(tree)
+
+    def test_empty_input(self):
+        assert stitch([]) is None
+
+
+# ---------------------------------------------------------------------------
+# trace-context propagation edges (the satellite pin)
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_malformed_and_oversized_headers_dropped(self):
+        assert parse_trace_context({}) == (None, None)
+        assert parse_trace_context(
+            {"x-pio-trace-id": "abc123", "x-pio-parent-span": "sA.7"}
+        ) == ("abc123", "sA.7")
+        bad = {
+            "x-pio-trace-id": 'evil"\ninjection',
+            "x-pio-parent-span": "s" * 500,     # oversized
+        }
+        assert parse_trace_context(bad) == (None, None)
+
+    def test_span_ids_unique_across_segments_in_one_process(self):
+        a, b = Trace("a"), Trace("b")
+        assert a.reserve_span_id() != b.reserve_span_id()
+
+    def test_reserved_id_recorded_and_parentable(self):
+        t = Trace("req")
+        sid = t.reserve_span_id()
+        got = t.add_span("attempt[x]", 1.0, 2.0, span_id=sid)
+        assert got == sid
+        child = t.add_span("inner", 1.2, 1.8, parent_id=sid)
+        doc = t.to_dict()
+        by_id = {s["spanId"]: s for s in doc["spans"]}
+        assert by_id[child]["parentId"] == sid
+
+
+# ---------------------------------------------------------------------------
+# SLO engine (deterministic on ManualClock)
+# ---------------------------------------------------------------------------
+
+class TestSLOEngine:
+    def _engine(self, **kw):
+        clock = ManualClock(start=10_000.0)
+        eng = SLOEngine(
+            [SLOObjective("availability", 0.99)],
+            windows=(("fast", 60.0), ("slow", 600.0)),
+            clock=clock, **kw)
+        return eng, clock
+
+    def test_fast_window_fires_while_slow_lags(self):
+        """THE chaos acceptance property, deterministically: 9 minutes
+        of good traffic then 1 minute of 100% errors — the fast window
+        burns at 1/budget while the slow window reports ~1/10 of it."""
+        eng, clock = self._engine()
+        for _ in range(540):
+            eng.record(True, 0.01)
+            clock.advance(1.0)
+        for _ in range(60):
+            eng.record(False, 0.01)
+            clock.advance(1.0)
+        rates = eng.burn_rates()
+        fast = rates[("availability", "fast")]
+        slow = rates[("availability", "slow")]
+        assert fast == pytest.approx(100.0, rel=0.05)   # 100% / 1% budget
+        assert slow == pytest.approx(10.0, rel=0.15)    # 60/600 of the window
+        assert slow < fast / 5
+
+    def test_idle_windows_burn_zero(self):
+        eng, _ = self._engine()
+        assert set(eng.burn_rates().values()) == {0.0}
+
+    def test_latency_objective_counts_slow_and_failed(self):
+        clock = ManualClock(start=500.0)
+        eng = SLOEngine(
+            [SLOObjective("lat", 0.9, kind="latency", threshold_ms=100.0)],
+            windows=(("fast", 60.0),), clock=clock)
+        eng.record(True, 0.01)      # good
+        eng.record(True, 0.5)       # too slow -> bad
+        eng.record(False, 0.01)     # failed -> bad
+        eng.record(True, 0.05)      # good
+        burn = eng.burn_rates()[("lat", "fast")]
+        assert burn == pytest.approx((2 / 4) / 0.1)
+
+    def test_ring_slots_recycle_without_leaking_stale_laps(self):
+        eng, clock = self._engine()
+        eng.record(False, 0.01)             # an error now...
+        clock.advance(700.0)                # ...far beyond every window
+        eng.record(True, 0.01)
+        rates = eng.burn_rates()
+        assert rates[("availability", "fast")] == 0.0
+        assert rates[("availability", "slow")] == 0.0
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            SLOObjective("x", 1.5)
+        with pytest.raises(ValueError):
+            SLOObjective("x", 0.9, kind="latency")   # no threshold
+
+    def test_fleet_pressure_attribution(self):
+        queue = LatencyHistogram(bounds=(0.001, 0.01, 0.1))
+        device = LatencyHistogram(bounds=(0.001, 0.01, 0.1))
+        assert fleet_pressure(queue.snapshot(), device.snapshot()) == 0.0
+        for _ in range(100):
+            queue.observe(0.08)     # queueing dominates
+            device.observe(0.008)
+        p = fleet_pressure(queue.snapshot(), device.snapshot())
+        assert p == pytest.approx(0.1 / 0.11, rel=0.01)
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: stitched tree through router + 2 replicas w/ retry
+# ---------------------------------------------------------------------------
+
+class TestStitchedTraceE2E:
+    def test_forced_cross_replica_retry_yields_one_stitched_tree(self):
+        s0 = echo_server("s0", tracing=True, batching=True, batch_max=8,
+                         batch_wait_ms=1.0)
+        s1 = echo_server("s1", tracing=True, batching=True, batch_max=8,
+                         batch_wait_ms=1.0)
+        proxy = FaultProxy(s0.port, error_rate=1.0)     # s0 always 500s
+        router = router_for([proxy.port, s1.port], tracing=True,
+                            breaker_threshold=100)
+        try:
+            status, body, headers = post_query(
+                router.port, {"x": 1},
+                headers={"X-PIO-Request-Id": "stitch-me"})
+            assert status == 200 and body["tag"] == "s1"
+            trace_id = headers["x-pio-trace-id"]
+
+            st, doc = get_json(router.port,
+                               f"/traces.json?trace_id={trace_id}")
+            assert st == 200 and doc["found"]
+            assert doc["segments"] == 2      # router + the winning replica
+            tree = doc["trace"]
+            assert tree["traceId"] == trace_id
+            assert tree["requestId"] == "stitch-me"
+            spans = tree["spans"]
+            by_id = {s["spanId"]: s for s in spans}
+            names = [s["name"] for s in spans]
+
+            # trace-id CONTINUITY across the retry: both the failed
+            # attempt and the retry are spans of the SAME tree
+            failed = next(s for s in spans
+                          if s["name"].startswith("attempt[")
+                          and s["name"].endswith("!failed"))
+            retry = next(s for s in spans
+                         if s["name"].startswith("retry["))
+            assert f"127.0.0.1:{s1.port}" in retry["name"]
+
+            # the replica segment parents under the WINNING attempt
+            seg = next(s for s in spans if s.get("segment")
+                       and s.get("service") == "engine")
+            assert seg["parentId"] == retry["spanId"]
+            assert seg["source"] == f"127.0.0.1:{s1.port}"
+
+            # queue-wait / device-dispatch split visible under the
+            # WINNING attempt: walking up from each leaf passes through
+            # the replica segment, then the retry span, to the root
+            qw = next(s for s in spans
+                      if s["name"] == "batcher.queue_wait")
+            dd = next(s for s in spans
+                      if s["name"] == "batcher.device_dispatch")
+            for leaf in (qw, dd):
+                chain = []
+                cursor = leaf
+                while cursor.get("parentId"):
+                    cursor = by_id[cursor["parentId"]]
+                    chain.append(cursor["spanId"])
+                assert retry["spanId"] in chain, (leaf["name"], chain)
+                assert cursor.get("segment") and \
+                    cursor.get("service") == "router"
+            assert qw["startMs"] < dd["startMs"]
+            assert failed["spanId"] not in (qw.get("parentId"),
+                                            dd.get("parentId"))
+
+            # renderers work on the real tree
+            text = render_tree(tree)
+            assert "retry[" in text and "batcher.queue_wait" in text
+            chrome = to_chrome_trace(tree)
+            assert any(e["ph"] == "X" for e in chrome["traceEvents"])
+        finally:
+            router.stop()
+            s0.stop()
+            s1.stop()
+
+    def test_unknown_trace_id_404s(self):
+        s0 = echo_server("s0")
+        router = router_for([s0.port], tracing=True)
+        try:
+            st, doc = get_json(router.port,
+                               "/traces.json?trace_id=nope123")
+            assert st == 404 and doc["found"] is False
+        finally:
+            router.stop()
+            s0.stop()
+
+    def test_malformed_inbound_context_never_500s(self):
+        """A hostile parent-span/trace-id header reaches both tiers and
+        the request still answers 200 under FRESH ids."""
+        s0 = echo_server("s0", tracing=True)
+        router = router_for([s0.port], tracing=True)
+        try:
+            # regex-failing (space + quote) and oversized values —
+            # newlines can't ride an HTTP header at all; the in-proc
+            # unit in TestTraceContext covers those
+            status, _, headers = post_query(
+                router.port, {"x": 1},
+                headers={"X-PIO-Trace-Id": 'evil "quoted" id',
+                         "X-PIO-Parent-Span": "s" * 4096})
+            assert status == 200
+            fresh = headers["x-pio-trace-id"]
+            assert fresh and " " not in fresh and '"' not in fresh
+        finally:
+            router.stop()
+            s0.stop()
+
+    def test_hedge_loser_cannot_corrupt_winner_tree(self):
+        slow = echo_server("slow", delay_s=0.4, tracing=True)
+        fast = echo_server("fast", tracing=True)
+        router = router_for([slow.port, fast.port], hedge=True,
+                            hedge_min_delay_ms=40.0, tracing=True)
+        try:
+            # drive until THIS request's hedge wins (count must move
+            # during the request, or the captured trace id may belong
+            # to an un-hedged one)
+            trace_id = None
+            for i in range(10):
+                before = router.router.stats.count("hedge_wins")
+                status, _, headers = post_query(router.port, {"i": i})
+                assert status == 200
+                if router.router.stats.count("hedge_wins") > before:
+                    trace_id = headers["x-pio-trace-id"]
+                    break
+            assert trace_id, "no hedge win in 10 requests"
+            time.sleep(0.6)     # let the loser finish and record spans
+            st, doc = get_json(router.port,
+                               f"/traces.json?trace_id={trace_id}")
+            assert st == 200
+            tree = doc["trace"]
+            spans = tree["spans"]
+            ids = [s["spanId"] for s in spans]
+            assert len(ids) == len(set(ids)), "duplicate span ids"
+            hedge_span = next(s for s in spans
+                              if s["name"].startswith("hedge["))
+            # every segment's parent resolves inside the tree (winner
+            # AND loser nest under their own attempt spans — the loser
+            # is a sibling subtree, not a corruption)
+            by_id = {s["spanId"]: s for s in spans}
+            for s in spans:
+                if s.get("parentId"):
+                    assert s["parentId"] in by_id, s
+            render_tree(tree)
+            assert hedge_span["durationMs"] >= 0
+        finally:
+            router.stop()
+            slow.stop()
+            fast.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: truthful /metrics under --workers 2
+# ---------------------------------------------------------------------------
+
+class TestWorkerAggregation:
+    def _worker_pair(self, backend_port):
+        spool = tempfile.mkdtemp(prefix="pio-test-workers-")
+
+        def mk(port):
+            return RouterServer(RouterConfig(
+                ip="127.0.0.1", port=port,
+                backends=(f"127.0.0.1:{backend_port}",),
+                reuse_port=True, worker_spool_dir=spool,
+                probe_interval_s=0.25))
+
+        w1 = mk(0)
+        w2 = mk(w1.port)
+        w1.start()
+        w2.start()
+        return w1, w2
+
+    def test_scrape_reports_sum_of_per_worker_traffic(self):
+        """THE acceptance criterion: drive traffic through the shared
+        SO_REUSEPORT port over many fresh connections (the kernel
+        spreads them), then ONE scrape — wherever it lands — must
+        report the total."""
+        s0 = echo_server("s0")
+        w1, w2 = self._worker_pair(s0.port)
+        port = w1.port
+        try:
+            n = 24
+            for i in range(n):
+                # fresh connection per request so the kernel's
+                # SO_REUSEPORT hash can spread them across workers
+                status, _, _ = post_query(port, {"i": i})
+                assert status == 200
+            per_worker = [
+                w.service.router.stats.count("requests") for w in (w1, w2)]
+            assert sum(per_worker) == n
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                families = parse_prometheus(r.read().decode())
+            total = families["pio_router_requests_total"]["samples"][
+                ("pio_router_requests_total", ())]
+            assert total == float(n), (total, per_worker)
+            workers = families["pio_router_workers"]["samples"][
+                ("pio_router_workers", ())]
+            assert workers == 2.0
+            # histograms merged bucket-wise and still consistent
+            check_histogram_consistency(families,
+                                        "pio_router_upstream_seconds")
+            # gauges labeled per worker
+            info = families["pio_server_info"]["samples"]
+            assert len(info) == 2
+            assert all(dict(labels).get("worker")
+                       for _, labels in info)
+        finally:
+            w1.stop()
+            w2.stop()
+            s0.stop()
+
+    def test_dead_worker_reaped_from_scrape(self):
+        s0 = echo_server("s0")
+        w1, w2 = self._worker_pair(s0.port)
+        try:
+            assert len(w1.service.worker_hub.peers()) == 1
+            w2.stop()   # removes its spool entry on close
+            families = parse_prometheus(w1.service.metrics_text())
+            workers = families["pio_router_workers"]["samples"][
+                ("pio_router_workers", ())]
+            assert workers == 1.0
+        finally:
+            w1.stop()
+            s0.stop()
+
+    def test_hub_unit_spool_lifecycle(self, tmp_path):
+        calls = {"n": 0}
+
+        def text():
+            calls["n"] += 1
+            return ("# HELP pio_u_total u\n# TYPE pio_u_total counter\n"
+                    "pio_u_total 2\n")
+
+        h1 = WorkerHub(str(tmp_path), text, lambda: [])
+        h2 = WorkerHub(str(tmp_path), text, lambda: [])
+        try:
+            assert {p["worker"] for p in h1.peers()} == {h2.worker_id}
+            bodies = h1.fetch_peer_bodies("/metrics")
+            assert len(bodies) == 1 and bodies[0][0] == h2.worker_id
+            fams = parse_exposition(bodies[0][1].decode())
+            assert fams[0].samples == [({}, 2.0)]
+            traces = h1.fetch_peer_bodies("/traces.json")
+            assert json.loads(traces[0][1]) == {"traces": []}
+        finally:
+            h2.close()
+            assert h1.peers() == []     # spool entry gone
+            h1.close()
+
+
+# ---------------------------------------------------------------------------
+# /fleet/metrics + the live SLO signal
+# ---------------------------------------------------------------------------
+
+class TestFleetMetrics:
+    def test_replica_labels_pressure_and_scrape_ok(self):
+        s0 = echo_server("s0", batching=True, batch_max=8,
+                         batch_wait_ms=1.0)
+        s1 = echo_server("s1", batching=True, batch_max=8,
+                         batch_wait_ms=1.0)
+        router = router_for([s0.port, s1.port])
+        try:
+            for i in range(6):
+                assert post_query(router.port, {"i": i})[0] == 200
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{router.port}/fleet/metrics",
+                    timeout=10) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                families = parse_prometheus(r.read().decode())
+            oks = families["pio_fleet_scrape_ok"]["samples"]
+            got = {dict(labels)["replica"]: value
+                   for (_, labels), value in oks.items()}
+            assert got == {f"127.0.0.1:{s0.port}": 1.0,
+                           f"127.0.0.1:{s1.port}": 1.0}
+            # every serving sample labeled by replica; histograms sane
+            check_histogram_consistency(
+                families, "pio_serving_queue_wait_seconds")
+            qs = families["pio_serving_queue_wait_seconds"]["samples"]
+            replicas = {dict(labels).get("replica")
+                        for (_, labels) in qs}
+            assert replicas == {f"127.0.0.1:{s0.port}",
+                                f"127.0.0.1:{s1.port}"}
+            assert ("pio_fleet_pressure", ()) in \
+                families["pio_fleet_pressure"]["samples"]
+        finally:
+            router.stop()
+            s0.stop()
+            s1.stop()
+
+    def test_dead_replica_reports_scrape_ok_zero(self):
+        s0 = echo_server("s0")
+        proxy = FaultProxy(s0.port)
+        router = router_for([proxy.port], scrape_timeout_s=1.0)
+        try:
+            proxy.kill()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{router.port}/fleet/metrics",
+                    timeout=10) as r:
+                families = parse_prometheus(r.read().decode())
+            oks = families["pio_fleet_scrape_ok"]["samples"]
+            assert list(oks.values()) == [0.0]
+        finally:
+            router.stop()
+            s0.stop()
+
+    def test_error_rate_past_objective_fires_fast_burn_gauge(self):
+        """The live half of the chaos criterion (the fast-vs-slow lag
+        is pinned deterministically in TestSLOEngine): 100% upstream
+        errors push the fast-window availability burn far above 1."""
+        s0 = echo_server("s0")
+        proxy = FaultProxy(s0.port, error_rate=1.0)
+        router = router_for([proxy.port], breaker_threshold=1000)
+        try:
+            for i in range(20):
+                status, _, _ = post_query(router.port, {"i": i})
+                # the probe loop may mark the erroring replica DOWN
+                # mid-loop: 500 (embedded upstream) becomes 503 (no
+                # backend) — both are availability-budget spend
+                assert status >= 500, status
+            families = parse_prometheus(
+                router.service.metrics_text())
+            burn = {
+                (dict(labels)["slo"], dict(labels)["window"]): value
+                for (_, labels), value in
+                families["pio_slo_burn_rate"]["samples"].items()}
+            assert burn[("availability", "fast")] > 10.0
+            assert burn[("availability", "slow")] <= \
+                burn[("availability", "fast")]
+            assert families["pio_slo_target"]["samples"][
+                ("pio_slo_target", (("slo", "availability"),))] \
+                == pytest.approx(0.999)
+        finally:
+            router.stop()
+            s0.stop()
+
+
+# ---------------------------------------------------------------------------
+# router access log enrichment + probe env knobs + CLI + lint scope
+# ---------------------------------------------------------------------------
+
+class TestRouterAccessLog:
+    def test_query_lines_carry_routing_verdict(self):
+        captured: list[logging.LogRecord] = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                captured.append(record)
+
+        handler = Capture(level=logging.INFO)
+        access = logging.getLogger("pio.access")
+        access.addHandler(handler)
+        s0 = echo_server("s0")
+        s1 = echo_server("s1")
+        proxy = FaultProxy(s0.port, error_rate=1.0)
+        router = router_for([proxy.port, s1.port], access_log=True,
+                            breaker_threshold=100)
+        try:
+            status, _, _ = post_query(
+                router.port, {"x": 1},
+                headers={"X-PIO-Request-Id": "log-fleet"})
+            assert status == 200
+        finally:
+            router.stop()
+            s0.stop()
+            s1.stop()
+            access.removeHandler(handler)
+        records = [json.loads(r.getMessage()) for r in captured]
+        entry = next(r for r in records
+                     if r.get("request_id") == "log-fleet")
+        assert entry["server"] == "router"
+        assert entry["replica"] == f"127.0.0.1:{s1.port}"
+        assert entry["attempts"] == 2
+        assert entry["retried"] is True
+        assert entry["hedged"] is False
+        assert entry["group"] == "stable"
+
+
+class TestProbeEnvKnobs:
+    def test_probe_timeout_and_interval_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_ROUTER_PROBE_TIMEOUT_S", "5.5")
+        monkeypatch.setenv("PIO_ROUTER_PROBE_INTERVAL_S", "2.5")
+        monkeypatch.setenv("PIO_ROUTER_SCRAPE_TIMEOUT_S", "3.5")
+        config = RouterConfig()
+        assert config.probe_timeout_s == 5.5
+        assert config.probe_interval_s == 2.5
+        assert config.scrape_timeout_s == 3.5
+        monkeypatch.setenv("PIO_ROUTER_PROBE_TIMEOUT_S", "bogus")
+        assert RouterConfig().probe_timeout_s == 1.0   # malformed -> default
+
+    def test_cli_probe_timeout_flag_reaches_membership(self):
+        from predictionio_tpu.cli.pio import build_parser
+
+        args = build_parser().parse_args(
+            ["router", "--backend", "127.0.0.1:1",
+             "--probe-timeout-s", "7.0"])
+        assert args.probe_timeout_s == 7.0
+
+
+class TestPioTraceCLI:
+    def _traced_fleet(self):
+        server = echo_server("s0", tracing=True)
+        router = router_for([server.port], tracing=True)
+        status, _, headers = post_query(router.port, {"x": 1})
+        assert status == 200
+        return server, router, headers["x-pio-trace-id"]
+
+    def test_text_tree(self, capsys):
+        from predictionio_tpu.cli.pio import main
+
+        server, router, trace_id = self._traced_fleet()
+        try:
+            rc = main(["trace", trace_id,
+                       "--router", f"127.0.0.1:{router.port}"])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert f"trace {trace_id}" in out
+            assert "attempt[" in out
+        finally:
+            router.stop()
+            server.stop()
+
+    def test_chrome_out_file(self, tmp_path, capsys):
+        from predictionio_tpu.cli.pio import main
+
+        server, router, trace_id = self._traced_fleet()
+        out_file = tmp_path / "trace.json"
+        try:
+            rc = main(["trace", trace_id,
+                       "--router", f"127.0.0.1:{router.port}",
+                       "--chrome", "--out", str(out_file)])
+            assert rc == 0
+            doc = json.loads(out_file.read_text())
+            assert doc["traceEvents"]
+        finally:
+            router.stop()
+            server.stop()
+
+    def test_not_found(self, capsys):
+        from predictionio_tpu.cli.pio import main
+
+        server, router, _ = self._traced_fleet()
+        try:
+            rc = main(["trace", "does-not-exist",
+                       "--router", f"127.0.0.1:{router.port}"])
+            assert rc == 1
+            assert "not found" in capsys.readouterr().out
+        finally:
+            router.stop()
+            server.stop()
+
+
+def test_fanout_paths_in_untimed_blocking_io_scope():
+    """Satellite contract: every cross-process fetch path is patrolled
+    by untimed-blocking-io, and the fleet transport's kw-only timeout
+    is policed where `request` means the transport exchange."""
+    from predictionio_tpu.analysis.config import default_config
+
+    policy = default_config().rules["untimed-blocking-io"]
+    for prefix in ("fleet/", "obs/", "cli/", "api/"):
+        assert prefix in policy.paths
+    assert policy.options["policed_calls"]["request"] is not None
+    assert "fleet/" in policy.options["call_paths"]["request"]

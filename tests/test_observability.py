@@ -53,6 +53,18 @@ _SAMPLE_RE = re.compile(
     r"(?:\{(?P<labels>[^}]*)\})?"
     r" (?P<value>[^ ]+)$")
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE_RE = re.compile(r"\\(.)")
+
+
+def _unescape(value: str) -> str:
+    """Single-pass label-value unescape (text-format 0.0.4). The old
+    sequential ``str.replace`` chain re-scanned bytes produced by
+    earlier passes, so a value holding a LITERAL backslash before 'n'
+    (``a\\nb``) came back with a real newline — pinned by the
+    round-trip test with hostile values in test_fleet_obs.py."""
+    return _UNESCAPE_RE.sub(
+        lambda m: {"n": "\n", '"': '"', "\\": "\\"}.get(
+            m.group(1), m.group(1)), value)
 
 
 def parse_prometheus(text: str) -> dict:
@@ -77,8 +89,7 @@ def parse_prometheus(text: str) -> dict:
         m = _SAMPLE_RE.match(line)
         assert m, f"unparseable exposition line: {line!r}"
         labels = tuple(sorted(
-            (k, v.replace('\\"', '"').replace("\\n", "\n")
-             .replace("\\\\", "\\"))
+            (k, _unescape(v))
             for k, v in _LABEL_RE.findall(m.group("labels") or "")))
         value = float(m.group("value")) if m.group("value") != "NaN" \
             else float("nan")
