@@ -739,6 +739,17 @@ def bench_serving_path():
     return bench_serving.bench_section()
 
 
+def bench_ann_retrieval(shrunk: bool = False):
+    """Brute vs ANN (IVF-flat MIPS + exact rescore) catalog-size sweep
+    — the PR 8 sublinear-retrieval trajectory. Standalone harness:
+    bench_serving.py --ann-only (committed artifacts:
+    BENCH_ann_rNN.json); under --skip-heavy it runs one small-but-
+    indexable catalog so the harness contract stays exercised."""
+    import bench_serving
+
+    return bench_serving.bench_ann_section(shrunk=shrunk)
+
+
 def bench_data_plane():
     """Columnar scan vs row iterator + transactional batch ingest — the
     PR 4 data-plane trajectory. Standalone harness: bench_ingest.py
@@ -1184,13 +1195,16 @@ def main() -> None:
         ("seqrec", bench_seqrec),
         ("ingest", bench_ingest),
         ("data_plane", bench_data_plane),
+        ("ann_retrieval",
+         lambda: bench_ann_retrieval(shrunk=args.skip_heavy)),
     ]
     failed = []
     if args.skip_heavy:
         # skipped sections' keys are absent, which IS an incomplete
         # artifact — the completeness marker must say so. data_plane
-        # stays: it is CPU+storage bound like ingest, no device needed
-        keep = ("quality", "ingest", "data_plane")
+        # stays: it is CPU+storage bound like ingest, no device needed;
+        # ann_retrieval runs SHRUNK (one small indexable catalog)
+        keep = ("quality", "ingest", "data_plane", "ann_retrieval")
         failed.extend(s[0] for s in sections if s[0] not in keep)
         sections = [s for s in sections if s[0] in keep]
     for section, fn in sections:

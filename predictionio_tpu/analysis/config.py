@@ -92,10 +92,13 @@ COMPUTE_PATHS = ("ops/", "models/", "e2/")
 #: data plane's scan/view consumers (data/ — PR 4): a host sync inside
 #: the train-read loop would serialize every batch, the
 #: observability plane (obs/ — PR 5), which runs INSIDE every request
-#: and must never block on the device, and the fleet router
-#: (fleet/ — PR 6), which sits on EVERY fleet query
+#: and must never block on the device, the fleet router
+#: (fleet/ — PR 6), which sits on EVERY fleet query, and the ANN
+#: retrieval kernels (ops/ann.py — PR 8), whose probe/rescore path
+#: answers every sublinear query (build/quality helpers are host-side
+#: by design and carry justified suppressions)
 HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/", "obs/",
-             "fleet/")
+             "fleet/", "ops/ann.py")
 
 
 def default_config() -> LintConfig:

@@ -82,6 +82,7 @@ from predictionio_tpu.obs.exporter import CONTENT_TYPE as PROMETHEUS_CONTENT_TYP
 from predictionio_tpu.obs.exporter import render_prometheus
 from predictionio_tpu.obs.registry import (
     HistogramFamily,
+    Metric,
     MetricRegistry,
     resilience_collector,
     server_info_collector,
@@ -115,6 +116,7 @@ from predictionio_tpu.workflow.deploy import (
     QueryDeadlineExceeded,
     ServerConfig,
     load_deployed_engine,
+    retrieval_targets,
 )
 
 logger = logging.getLogger(__name__)
@@ -307,6 +309,13 @@ class EngineService:
         self.registry.register(self.slo.collector())
         self.registry.register(
             serving_pressure_collector(self.serving_stats))
+        #: sublinear-retrieval observability (docs/serving-performance.md):
+        #: ANN-capable models report their dispatches into ServingStats
+        #: (pio_serving_ann_* on /metrics, annShortlistHistogram on
+        #: /stats.json); re-wired on every /reload since the swap brings
+        #: fresh model objects
+        self._wire_ann_observers()
+        self.registry.register(self._ann_mode_collector)
         #: deadline enforcement for the NON-batched path: the query runs
         #: on a pool thread so a blown budget returns 503 instead of
         #: holding the socket (threads spawn lazily; idle pool is free)
@@ -320,6 +329,30 @@ class EngineService:
         #: readers (handler threads on both sides).
         self._reload_lock = threading.Lock()
         self._reloads_in_flight = 0
+
+    # -- sublinear retrieval wiring (ops/ann) -------------------------------
+    def _wire_ann_observers(self) -> None:
+        # getattr: test doubles and minimal deployments may not carry a
+        # models list — they simply have no ANN-capable targets
+        for target in retrieval_targets(
+                getattr(self.deployed, "models", ())):
+            if hasattr(target, "set_ann_observer"):
+                target.set_ann_observer(self.serving_stats.record_ann)
+
+    def ann_enabled(self) -> bool:
+        """True when any deployed model answers queries through its ANN
+        index (retrieval mode applied AND an index present)."""
+        return any(getattr(t, "ann_enabled", False)
+                   for t in retrieval_targets(
+                       getattr(self.deployed, "models", ())))
+
+    def _ann_mode_collector(self) -> list:
+        return [Metric(
+            name="pio_serving_ann_enabled", kind="gauge",
+            help="1 when queries are served through the ANN MIPS index, "
+                 "0 for brute-force retrieval",
+            samples=[({}, 1.0 if self.ann_enabled() else 0.0)],
+        )]
 
     # -- auth (KeyAuthentication.withAccessKeyFromFile) ---------------------
     def _check_server_key(self, params: Mapping[str, str]) -> None:
@@ -503,6 +536,8 @@ class EngineService:
             "avgServingSec": d.avg_serving_sec,
             "lastServingSec": d.last_serving_sec,
             "clientDisconnects": self.client_disconnects(),
+            "annEnabled": self.ann_enabled(),
+            "retrieval": self.config.retrieval,
             "serving": self.serving_stats.snapshot(),
             "batching": (
                 {"enabled": True, **self.batcher.policy.snapshot()}
@@ -683,6 +718,9 @@ class EngineService:
             )
             old_id = self.deployed.instance.id
             self.deployed = new
+            # the swap brought fresh model objects: re-install the
+            # ServingStats ANN dispatch counter on each of them
+            self._wire_ann_observers()
             self._query_decoder = (
                 compile_wire_decoder(qc)
                 if (qc := new.query_class) is not None else None)

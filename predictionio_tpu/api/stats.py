@@ -48,6 +48,7 @@ class ServingStats:
         "dispatches", "batched_queries", "deduped", "expired",
         "cache_hits", "cache_misses", "cache_evictions",
         "cache_expirations", "cache_invalidations",
+        "ann_queries", "ann_rescored",
     )
 
     def __init__(self):
@@ -55,6 +56,9 @@ class ServingStats:
         self._counts = dict.fromkeys(self.COUNTER_FIELDS, 0)
         #: dispatched (post-dedup) batch size -> count
         self._batch_hist: Counter[int] = Counter()
+        #: ANN shortlist width (candidate columns rescored per query,
+        #: pad included — the static jit width) -> query count
+        self._ann_hist: Counter[int] = Counter()
         #: latency attribution (obs/histogram.py; each histogram owns
         #: its own lock): queue component vs device component of the
         #: batched serving path — the Clipper-style split GET /metrics
@@ -85,6 +89,20 @@ class ServingStats:
             self._counts["deduped"] += coalesced - dispatched
             self._batch_hist[dispatched] += 1
 
+    def record_ann(self, shortlist_width: int, queries: int = 1) -> None:
+        """One ANN retrieval dispatch: ``queries`` queries answered from
+        a ``shortlist_width``-candidate rescore each (the ALSModel
+        observer hook — models/als.set_ann_observer)."""
+        with self._lock:
+            self._counts["ann_queries"] += queries
+            self._counts["ann_rescored"] += shortlist_width * queries
+            self._ann_hist[shortlist_width] += queries
+
+    def ann_histogram(self) -> dict[int, int]:
+        """Shortlist width -> query count, read under the lock."""
+        with self._lock:
+            return dict(self._ann_hist)
+
     def count(self, field: str) -> int:
         with self._lock:
             return self._counts[field]
@@ -104,11 +122,14 @@ class ServingStats:
         with self._lock:
             counts = dict(self._counts)
             hist = {str(k): v for k, v in sorted(self._batch_hist.items())}
+            ann_hist = {str(k): v
+                        for k, v in sorted(self._ann_hist.items())}
         hits, misses = counts["cache_hits"], counts["cache_misses"]
         looked = hits + misses
         return {
             **{snake_to_camel(k): v for k, v in counts.items()},
             "batchSizeHistogram": hist,
+            "annShortlistHistogram": ann_hist,
             "cacheHitRatio": round(hits / looked, 4) if looked else None,
             "queueWait": self.queue_wait.snapshot().summary_ms(),
             "deviceDispatch": self.device_time.snapshot().summary_ms(),

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 from functools import partial as _partial
 from typing import Mapping, Sequence
@@ -25,11 +26,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.ops import ann as ann_ops
 from predictionio_tpu.ops import topk as topk_ops
 from predictionio_tpu.utils.bimap import BiMap, EntityIdIxMap
 
+logger = logging.getLogger(__name__)
+
 # serving-time pad length for seen-item lists: one compiled kernel shape
 _SEEN_PAD = 512
+
+#: model-directory subdir holding the ANN index checkpoint (its arrays
+#: ride the same checksummed utils/checkpoint envelope as the factors)
+_ANN_SUBDIR = "ann"
 
 
 @_partial(jax.jit, static_argnames=("k",))
@@ -48,6 +56,47 @@ def _serve_recommend(user_factors, item_f, packed, allow, k):
             ).astype(item_f.dtype)[None, :]
     uv = user_factors[uix[None]]                     # (1, K)
     vals, idxs = topk_ops.recommend_topk(uv, item_f, cols, mask, allow, k)
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(vals[0], jnp.int32), idxs[0]])
+
+
+@_partial(jax.jit, static_argnames=("k", "nprobe", "rescore"))
+def _serve_recommend_ann(user_factors, item_f, centroids, flat_items,
+                         flat_vecs, cell_offset, packed, allow, k, nprobe,
+                         rescore):
+    """ANN twin of :func:`_serve_recommend`: same packed single-upload
+    query buffer, same bitcast single-download result — the dispatch
+    inside is probe → shortlist gather → exact rescore (ops/ann)
+    instead of the full-catalog matmul."""
+    uix = packed[0]
+    cols = packed[1 : 1 + _SEEN_PAD][None, :]
+    mask = (packed[1 + _SEEN_PAD : 1 + 2 * _SEEN_PAD] > 0
+            ).astype(item_f.dtype)[None, :]
+    uv = user_factors[uix[None]]                     # (1, K)
+    vals, idxs = ann_ops.ann_topk(uv, item_f, centroids, flat_items,
+                                  flat_vecs, cell_offset, cols, mask, allow,
+                                  k, nprobe, rescore)
+    # k clamps to the shortlist width in-kernel; callers recompute the
+    # effective k from the (static) index geometry to slice the buffer
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(vals[0], jnp.int32), idxs[0]])
+
+
+@_partial(jax.jit, static_argnames=("k", "nprobe", "rescore"))
+def _serve_similar_ann(item_f, centroids, flat_items, flat_vecs,
+                       cell_offset, packed, allow, k, nprobe, rescore):
+    """ANN twin of :func:`_serve_similar`: cosine probe + exact cosine
+    rescore on the shortlist, query vector and self-exclusion both
+    derived in-kernel from the packed [n_real, query_ixs] buffer."""
+    n_real = packed[0]
+    ixs = packed[1 : 1 + _SEEN_PAD]
+    w = (jnp.arange(_SEEN_PAD) < n_real).astype(item_f.dtype)
+    gathered = item_f[ixs] * w[:, None]
+    qvec = (jnp.sum(gathered, axis=0) /
+            jnp.maximum(n_real.astype(item_f.dtype), 1.0))[None, :]
+    vals, idxs = ann_ops.ann_similar_topk(
+        qvec, item_f, centroids, flat_items, flat_vecs, cell_offset,
+        ixs[None, :], w[None, :], allow, k, nprobe, rescore)
     return jnp.concatenate(
         [jax.lax.bitcast_convert_type(vals[0], jnp.int32), idxs[0]])
 
@@ -85,10 +134,27 @@ class ALSModel:
     # never serialized
     _default_allow: object = dataclasses.field(default=None, repr=False,
                                                compare=False)
+    #: IVF-flat MIPS index over item_factors (ops/ann.AnnIndex), built
+    #: at persist time and serialized beside the factor checkpoint;
+    #: None = brute force only
+    ann_index: object | None = dataclasses.field(default=None, repr=False,
+                                                 compare=False)
+    #: serving retrieval mode ("brute" | "ann") + probe/rescore knobs —
+    #: set by configure_retrieval from ServerConfig, never serialized
+    #: as policy (the index is data; the mode is deployment config)
+    retrieval: str = dataclasses.field(default="brute", compare=False)
+    ann_nprobe: int = dataclasses.field(default=0, compare=False)
+    ann_rescore: int = dataclasses.field(default=0, compare=False)
+    #: optional callable(shortlist_width, queries) the serving layer
+    #: installs to count ANN dispatches (api/stats.ServingStats)
+    _ann_observer: object = dataclasses.field(default=None, repr=False,
+                                              compare=False)
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_default_allow"] = None
+        # the observer is serving wiring (holds the stats lock), not model
+        state["_ann_observer"] = None
         return state
 
     def _allow_or_default(self, allow):
@@ -98,6 +164,67 @@ class ALSModel:
             self._default_allow = jax.device_put(
                 jnp.ones((self.item_factors.shape[0],), dtype=jnp.float32))
         return self._default_allow
+
+    # ---- sublinear retrieval (ops/ann; docs/serving-performance.md) -----
+    def configure_retrieval(self, mode: str = "brute", nprobe: int = 0,
+                            rescore: int = 0, nlist: int = 0,
+                            observer=None) -> None:
+        """Apply the deployment's retrieval knobs (ServerConfig
+        ``retrieval`` / ``ann_nprobe`` / ``ann_rescore``). Requesting
+        ``ann`` on a model persisted without an index builds one here
+        (deploy-time fallback — train/persist is the intended build
+        point); a catalog too small to index degrades to brute with a
+        warning instead of failing the deploy."""
+        if mode == "ann" and self.ann_index is None:
+            built = ann_ops.build_index(np.asarray(self.item_factors),
+                                        nlist=nlist)
+            if built is None:
+                logger.warning(
+                    "retrieval=ann requested but the catalog has only %d "
+                    "items (< %d): serving brute force",
+                    self.item_factors.shape[0], ann_ops.MIN_INDEX_ITEMS)
+                mode = "brute"
+            else:
+                logger.info(
+                    "retrieval=ann: built IVF index at deploy time "
+                    "(nlist=%d, max cell=%d) — persist the model with a "
+                    "newer `pio train` to build it once at train time",
+                    built.nlist, built.max_cell)
+                self.ann_index = built
+        self.retrieval = mode
+        self.ann_nprobe = max(0, int(nprobe))
+        self.ann_rescore = max(0, int(rescore))
+        self._ann_observer = observer
+
+    def set_ann_observer(self, observer) -> None:
+        """Install the serving layer's ANN dispatch counter
+        (callable(shortlist_width, queries) — e.g.
+        ``ServingStats.record_ann``) without re-running retrieval
+        configuration."""
+        self._ann_observer = observer
+
+    @property
+    def ann_enabled(self) -> bool:
+        """True when queries are being answered through the ANN index
+        (mode configured AND an index exists) — the serving layer's
+        `/stats.json` / `/metrics` signal."""
+        return self._ann_active()
+
+    def _ann_active(self) -> bool:
+        return self.retrieval == "ann" and self.ann_index is not None
+
+    def _ann_args(self) -> tuple:
+        """(device arrays..., nprobe, rescore) for the jitted kernels —
+        nprobe clamped to the index so the static args are always
+        legal."""
+        index = self.ann_index
+        centroids, flat_items, flat_vecs, cell_offset = index.device_arrays()
+        return (centroids, flat_items, flat_vecs, cell_offset,
+                index.clamp_nprobe(self.ann_nprobe), self.ann_rescore)
+
+    def _record_ann(self, width: int, queries: int) -> None:
+        if self._ann_observer is not None:
+            self._ann_observer(width, queries)
 
     # ---- single-query serving ------------------------------------------
     def recommend(
@@ -135,6 +262,21 @@ class ALSModel:
         buf[0] = uix
         buf[1 : 1 + len(seen)] = seen
         buf[1 + _SEEN_PAD : 1 + _SEEN_PAD + len(seen)] = 1
+        if self._ann_active():
+            # sublinear path: probe the IVF cells, exact-rescore the
+            # shortlist (ops/ann) — same packed single-dispatch contract
+            centroids, flat_items, flat_vecs, cell_offset, nprobe, rescore = \
+                self._ann_args()
+            width = self.ann_index.shortlist_width(nprobe, rescore)
+            k_eff = min(k, width)
+            out = np.asarray(_serve_recommend_ann(
+                self.user_factors, self.item_factors, centroids,
+                flat_items, flat_vecs, cell_offset, jnp.asarray(buf),
+                allow_v, k, nprobe, rescore,
+            ))
+            self._record_ann(width, 1)
+            return self._gather_results(
+                out[:k_eff].view(np.float32), out[k_eff:], num)
         # one jitted dispatch, one upload, one download end-to-end; B=1
         # always takes the flat XLA kernel — the chunked-scan dispatch
         # engages only for batched prediction (batch_predict) at scale
@@ -164,6 +306,21 @@ class ALSModel:
             buf = np.zeros((1 + _SEEN_PAD,), dtype=np.int32)
             buf[0] = len(ixs)
             buf[1 : 1 + len(ixs)] = np.asarray(ixs, dtype=np.int32)
+            if self._ann_active():
+                # cosine probe + exact cosine rescore (ops/ann): the
+                # SAME index answers the similarproduct ranking
+                centroids, flat_items, flat_vecs, cell_offset, nprobe, \
+                    rescore = self._ann_args()
+                width = self.ann_index.shortlist_width(nprobe, rescore)
+                k_eff = min(k, width)
+                out = np.asarray(_serve_similar_ann(
+                    self.item_factors, centroids, flat_items, flat_vecs,
+                    cell_offset, jnp.asarray(buf), allow_v, k, nprobe,
+                    rescore,
+                ))
+                self._record_ann(width, 1)
+                return self._gather_results(
+                    out[:k_eff].view(np.float32), out[k_eff:], num)
             out = np.asarray(_serve_similar(
                 self.item_factors, jnp.asarray(buf), allow_v, k,
             ))
@@ -183,6 +340,35 @@ class ALSModel:
         )
         return self._gather_results(
             np.asarray(vals)[0], np.asarray(idxs)[0], num)
+
+    def batch_topk(self, uixs: np.ndarray, seen_cols, seen_mask, allow,
+                   k: int) -> tuple:
+        """Batched masked top-k over dense user indices — the
+        batch_predict hot path shared by the templates. Dispatches to
+        the configured retrieval: brute routes through the
+        flat/chunked-scan dispatcher (ops/topk.recommend_topk_fused),
+        ann through the IVF probe + exact-rescore kernel (ops/ann) —
+        one jitted dispatch either way. ``allow=None`` uses the
+        device-cached all-ones vector."""
+        uv = self.user_factors[jnp.asarray(np.asarray(uixs,
+                                                      dtype=np.int32))]
+        allow_v = self._allow_or_default(allow)
+        if self._ann_active():
+            centroids, flat_items, flat_vecs, cell_offset, nprobe, rescore = \
+                self._ann_args()
+            vals, idxs = ann_ops.ann_topk(
+                uv, self.item_factors, centroids, flat_items, flat_vecs,
+                cell_offset, jnp.asarray(seen_cols), jnp.asarray(seen_mask),
+                allow_v, k, nprobe, rescore)
+            self._record_ann(
+                self.ann_index.shortlist_width(nprobe, rescore),
+                int(uv.shape[0]))
+            return vals, idxs
+        return topk_ops.recommend_topk_fused(
+            uv, self.item_factors,
+            # NumPy stays NumPy on purpose: the dispatcher's host-side
+            # _trim_seen can only right-size concrete host arrays
+            seen_cols, seen_mask, allow_v, k)
 
     def predict_rating(self, user_id: str, item_id: str) -> float | None:
         uix = self.user_ids.get(user_id)
@@ -210,7 +396,15 @@ class ALSModel:
     def save(self, directory: str) -> None:
         """Factor tables via utils/checkpoint.save_sharded (orbax: sharded
         jax.Arrays write shard-locally, no gather-to-host — the SURVEY §7
-        sharded-persistence contract) + JSON id maps."""
+        sharded-persistence contract) + JSON id maps.
+
+        The ANN index is built HERE (the train/persist stage) when the
+        catalog is big enough to benefit — serving then loads a ready
+        index instead of paying k-means at deploy. Its arrays ride the
+        same checksummed checkpoint envelope as the factors, in the
+        ``ann/`` subdirectory; ``PIO_SERVING_ANN_NLIST`` overrides the
+        auto cell count at build time and ``PIO_SERVING_ANN_BUILD=0``
+        skips the build (brute-only fleets)."""
         from predictionio_tpu.utils.checkpoint import save_sharded
 
         os.makedirs(directory, exist_ok=True)
@@ -223,11 +417,29 @@ class ALSModel:
         legacy = os.path.join(directory, "factors.npz")
         if os.path.exists(legacy):
             os.remove(legacy)
+        # PIO_SERVING_ANN_BUILD=0 skips the persist-time index build
+        # (and its flat_vecs copy of the item table in the checkpoint)
+        # for fleets that only ever serve brute; deploy --retrieval ann
+        # can still build at load time
+        build = os.environ.get("PIO_SERVING_ANN_BUILD", "1").strip().lower()
+        if self.ann_index is None and build not in ("0", "false", "off"):
+            try:
+                nlist = int(os.environ.get("PIO_SERVING_ANN_NLIST", "0"))
+            except ValueError:
+                nlist = 0
+            self.ann_index = ann_ops.build_index(
+                np.asarray(self.item_factors), nlist=nlist)
+        if self.ann_index is not None:
+            save_sharded(os.path.join(directory, _ANN_SUBDIR),
+                         self.ann_index.to_arrays())
         meta = {
             "rank": self.rank,
             "user_ids": self.user_ids.id_to_ix.to_dict(),
             "item_ids": self.item_ids.id_to_ix.to_dict(),
             "seen": {str(k): np.asarray(v).tolist() for k, v in self.seen_by_user.items()},
+            **({"ann": {"nlist": self.ann_index.nlist,
+                        "n_items": self.ann_index.n_items}}
+               if self.ann_index is not None else {}),
         }
         with open(os.path.join(directory, "model.json"), "w") as f:
             json.dump(meta, f)
@@ -236,6 +448,8 @@ class ALSModel:
     def load(directory: str, shardings: dict | None = None) -> "ALSModel":
         """``shardings`` optionally maps "user"/"item" to target
         ``NamedSharding``s so factors restore straight onto a mesh."""
+        from predictionio_tpu.utils.checkpoint import load_sharded
+
         # an orbax dir without meta means a crash interrupted save() after
         # the checkpoint write — still newer than any legacy factors.npz
         has_new = os.path.exists(
@@ -253,11 +467,17 @@ class ALSModel:
                     for k, v in data.items()
                 }
         else:
-            from predictionio_tpu.utils.checkpoint import load_sharded
-
             data = load_sharded(directory, shardings=shardings)
         with open(os.path.join(directory, "model.json")) as f:
             meta = json.load(f)
+        ann_index = None
+        if "ann" in meta:
+            # the meta names an index: a missing/corrupt ann/ payload is
+            # CheckpointCorruptError (load_sharded), surfaced — never a
+            # silent fall-back to brute on a torn checkpoint
+            ann_index = ann_ops.AnnIndex.from_arrays(
+                load_sharded(os.path.join(directory, _ANN_SUBDIR)),
+                n_items=int(meta["ann"]["n_items"]))
         return ALSModel(
             rank=int(meta["rank"]),
             user_factors=jnp.asarray(data["user"]),
@@ -268,6 +488,7 @@ class ALSModel:
                 int(k): np.asarray(v, dtype=np.int32)
                 for k, v in meta["seen"].items()
             },
+            ann_index=ann_index,
         )
 
 

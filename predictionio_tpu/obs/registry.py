@@ -168,6 +168,16 @@ def serving_collector(stats: Any) -> Collector:
             help="Dispatched (post-dedup) batch sizes",
             histograms=[({}, counts_to_snapshot(stats.batch_histogram()))],
         ))
+        ann_hist = stats.ann_histogram()
+        if ann_hist:
+            # present only once ANN retrieval has served a query — a
+            # brute-force deployment's exposition stays unchanged
+            out.append(Metric(
+                name="pio_serving_ann_shortlist_size", kind="histogram",
+                help="ANN shortlist widths exact-rescored per query "
+                     "(candidate columns incl. pad; ops/ann)",
+                histograms=[({}, counts_to_snapshot(ann_hist))],
+            ))
         out.append(Metric(
             name="pio_serving_queue_wait_seconds", kind="histogram",
             help="Per-query wait from enqueue to device dispatch "

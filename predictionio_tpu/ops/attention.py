@@ -22,7 +22,8 @@ import math
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+
+from predictionio_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 _NEG = jnp.float32(-1e30)  # large-negative instead of -inf: keeps exp() NaN-free

@@ -23,8 +23,12 @@ NEG_INF = jnp.float32(-jnp.inf)
 
 @partial(jax.jit, static_argnames=("k",))
 def topk_scores(scores: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
-    """(values, indices) of the top-k per row."""
-    return jax.lax.top_k(scores, k)
+    """(values, indices) of the top-k per row. ``k`` beyond the
+    candidate count clamps (fewer columns back, never an XLA assert) —
+    the contract every serving top-k in this module shares: a tiny
+    catalog, or a shortlist smaller than the requested width, returns
+    what exists."""
+    return jax.lax.top_k(scores, min(k, scores.shape[-1]))
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -41,7 +45,8 @@ def recommend_topk(
     ``allow`` carries business rules (category whitelist, unavailable
     items — the ecommerce template's filters) as a precomputed 0/1
     vector; seen items are masked via scatter so padding slots (mask=0)
-    leave scores untouched.
+    leave scores untouched. ``k`` clamps to the catalog size
+    (``topk_scores`` contract).
     """
     scores = jnp.einsum("bk,ik->bi", user_vecs, item_f)          # MXU
     scores = jnp.where(allow > 0, scores, NEG_INF)
@@ -49,7 +54,7 @@ def recommend_topk(
     rows = jnp.broadcast_to(jnp.arange(b)[:, None], seen_cols.shape)
     hide = jnp.where(seen_mask > 0, NEG_INF, jnp.float32(jnp.inf))
     scores = scores.at[rows, seen_cols].min(hide)
-    return jax.lax.top_k(scores, k)
+    return jax.lax.top_k(scores, min(k, scores.shape[-1]))
 
 
 @partial(jax.jit, static_argnames=("k", "chunk"))
@@ -79,6 +84,7 @@ def recommend_topk_chunked(
     flat path stays better for small catalogs and B=1 serving."""
     B = user_vecs.shape[0]
     I = item_f.shape[0]
+    k = min(k, I)                   # the shared clamp-not-assert contract
     if I <= chunk:
         return recommend_topk(user_vecs, item_f, seen_cols, seen_mask,
                               allow, k)
@@ -275,8 +281,9 @@ def _sharded_topk_fn(mesh, k: int, shard_rows: int):
     """Cached jitted shard_map program — jit caches by function
     identity, so rebuilding the closure per call would retrace and
     recompile the eval hot path on every invocation."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from predictionio_tpu.utils.jax_compat import shard_map
 
     def local(uv, itf, sc, sm, al):
         start = jax.lax.axis_index("model") * shard_rows
@@ -301,12 +308,8 @@ def _sharded_topk_fn(mesh, k: int, shard_rows: int):
     )
     # the all-gather makes both outputs replicated over "model", which
     # the static replication checker cannot infer — disable it (the
-    # parameter was renamed check_rep -> check_vma across jax versions)
-    try:
-        fn = shard_map(local, mesh=mesh, check_vma=False, **specs)
-    except TypeError:
-        fn = shard_map(local, mesh=mesh, check_rep=False, **specs)
-    return jax.jit(fn)
+    # jax_compat shim normalizes the check_rep -> check_vma rename)
+    return jax.jit(shard_map(local, mesh=mesh, check_vma=False, **specs))
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -331,4 +334,4 @@ def similar_topk(
     rows = jnp.broadcast_to(jnp.arange(b)[:, None], exclude_cols.shape)
     hide = jnp.where(exclude_mask > 0, NEG_INF, jnp.float32(jnp.inf))
     scores = scores.at[rows, exclude_cols].min(hide)
-    return jax.lax.top_k(scores, k)
+    return jax.lax.top_k(scores, min(k, scores.shape[-1]))

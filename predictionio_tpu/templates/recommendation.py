@@ -334,8 +334,6 @@ class ALSAlgorithm(ShardedAlgorithm):
         analogue (ALSAlgorithm batchPredict path). Queries carrying
         white/black-list filters need a per-query eligibility vector, so
         they take the single-query path; the unfiltered rest batch."""
-        import jax.numpy as jnp
-
         if not queries:
             return []
         out = [(qi, self.predict(model, q)) for qi, q in queries
@@ -391,23 +389,16 @@ class ALSAlgorithm(ShardedAlgorithm):
                 s = model.seen_by_user.get(int(u), np.empty(0, dtype=np.int32))[:pad]
                 cols[j, : len(s)] = s
                 mask[j, : len(s)] = 1.0
-        allow = jnp.ones((model.item_factors.shape[0],), dtype=jnp.float32)
         n_items = model.item_factors.shape[0]
         # menu-ized STATIC top_k width (ops/topk.serving_k: client-
         # controlled num must not retrace; results trim per query below)
         k = topk_ops.serving_k(min(max_num, n_items), n_items)
-        # dispatcher picks flat vs chunked-scan (ops/topk docstring
-        # records the measurements)
-        vals, idxs = topk_ops.recommend_topk_fused(
-            model.user_factors[jnp.asarray(uixs)],
-            model.item_factors,
-            # NumPy on purpose: the dispatcher's host-side _trim_seen
-            # can only right-size concrete host arrays; jit moves them
-            cols,
-            mask,
-            allow,
-            k,
-        )
+        # the model dispatches by its configured retrieval: brute picks
+        # flat vs chunked-scan (ops/topk), ann probes the IVF index and
+        # exact-rescores the shortlist (ops/ann); seen arrays stay
+        # NumPy so the brute dispatcher's host-side _trim_seen can
+        # right-size them
+        vals, idxs = model.batch_topk(uixs, cols, mask, None, k)
         vals = np.asarray(vals)[:B]
         idxs = np.asarray(idxs)[:B]
         inv = model.item_ids.inverse

@@ -1,0 +1,21 @@
+"""Version-tolerant jax API shims.
+
+The repo targets current jax (top-level ``jax.shard_map`` with the
+``check_vma`` kwarg) but must keep importing on the 0.4.x line, where
+the function lives in ``jax.experimental.shard_map`` and the kwarg is
+named ``check_rep``. One shim here so kernel modules never carry their
+own version probes.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5
+    from jax import shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+__all__ = ["shard_map"]

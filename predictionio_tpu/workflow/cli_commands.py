@@ -222,6 +222,26 @@ def _configure_deploy(sub) -> None:
                         "JSON, invalidated on /reload")
     p.add_argument("--cache-max-entries", type=int, default=None)
     p.add_argument("--cache-ttl-s", type=float, default=None)
+    # sublinear retrieval (ops/ann; docs/serving-performance.md):
+    # None defers to the PIO_SERVING_ANN_* env-aware ServerConfig
+    # defaults, matching the other serving knobs
+    p.add_argument("--retrieval", choices=("brute", "ann"), default=None,
+                   help="'ann' probes the IVF-flat MIPS index persisted "
+                        "beside the model (built at deploy when missing) "
+                        "and exact-rescores the shortlist; 'brute' "
+                        "scores the full item table per query")
+    p.add_argument("--ann-nlist", type=int, default=None, dest="ann_nlist",
+                   help="IVF cell count for a deploy-time index build "
+                        "(0 = auto ~4*sqrt(catalog))")
+    p.add_argument("--ann-nprobe", type=int, default=None,
+                   dest="ann_nprobe",
+                   help="cells probed per query (0 = auto nlist/64, "
+                        "floored at 16); higher = better recall, more "
+                        "rescore work")
+    p.add_argument("--ann-rescore", type=int, default=None,
+                   dest="ann_rescore",
+                   help="cap on shortlist candidates exact-rescored per "
+                        "query (0 = all probed candidates)")
     # observability (docs/observability.md): None defers to the
     # PIO_TRACE / PIO_ACCESS_LOG env vars; the boolean pairs let the
     # CLI force either state over a fleet-wide env setting
@@ -264,6 +284,10 @@ def _cmd_deploy(args, storage) -> int:
             "cache_enabled": args.cache,
             "cache_max_entries": args.cache_max_entries,
             "cache_ttl_s": args.cache_ttl_s,
+            "retrieval": args.retrieval,
+            "ann_nlist": args.ann_nlist,
+            "ann_nprobe": args.ann_nprobe,
+            "ann_rescore": args.ann_rescore,
             "tracing": args.tracing,
             "access_log": args.access_log,
         }.items() if v is not None},

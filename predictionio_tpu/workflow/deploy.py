@@ -74,6 +74,15 @@ def _cast_policy(raw: str) -> str:
     return value
 
 
+def _cast_retrieval(raw: str) -> str:
+    # same degrade-don't-die contract as _cast_policy: a typo'd
+    # PIO_SERVING_RETRIEVAL serves brute force with a warning
+    value = raw.strip().lower()
+    if value not in ("brute", "ann"):
+        raise ValueError(value)
+    return value
+
+
 @dataclasses.dataclass(frozen=True)
 class ServerConfig:
     """Parity: ServerConfig (CreateServer.scala:74-103)."""
@@ -129,6 +138,24 @@ class ServerConfig:
     #: header; exhaustion maps to 503 + Retry-After, not a hung socket.
     #: 0 disables (legacy behavior: 300s batcher wait, no deadline).
     request_deadline_ms: float = _env_field("REQUEST_DEADLINE_MS", 0.0, float)
+    #: sublinear retrieval (ops/ann; docs/serving-performance.md):
+    #: "brute" scores the full item table per query, "ann" probes the
+    #: IVF-flat MIPS index persisted beside the model (built at deploy
+    #: when missing) and exact-rescores the shortlist — O(sqrt(catalog))
+    #: instead of O(catalog) per query, recall measured by the quality
+    #: harness. Applies to every model exposing ``configure_retrieval``
+    #: (the ALS family behind the recommendation / similarproduct /
+    #: ecommerce templates); other models ignore it.
+    retrieval: str = _env_field("RETRIEVAL", "brute", _cast_retrieval)
+    #: IVF cell count for a deploy-time index build (0 = auto
+    #: ~4*sqrt(n)); persisted indexes keep their build-time geometry
+    ann_nlist: int = _env_field("ANN_NLIST", 0, int)
+    #: cells probed per query (0 = auto nlist/64, floored at 16);
+    #: higher = better recall, more rescore work
+    ann_nprobe: int = _env_field("ANN_NPROBE", 0, int)
+    #: cap on shortlist candidates exact-rescored per query (0 = all
+    #: probed candidates)
+    ann_rescore: int = _env_field("ANN_RESCORE", 0, int)
     #: observability plane (docs/observability.md). ``tracing`` turns
     #: on per-request span collection for /queries.json (served back on
     #: GET /traces.json); None defers to the PIO_TRACE env var at
@@ -222,6 +249,29 @@ class DeployedEngine:
             self.last_serving_sec = dt
 
 
+def retrieval_targets(models: Sequence[Any]):
+    """The models a deployment's retrieval knobs apply to: anything
+    exposing ``configure_retrieval`` directly (ALSModel) or through an
+    ``als`` attribute (the similarproduct/ecommerce wrappers). One
+    resolver so the deploy wiring and the serving stats agree on the
+    target set."""
+    for model in models:
+        if hasattr(model, "configure_retrieval"):
+            yield model
+        elif hasattr(getattr(model, "als", None), "configure_retrieval"):
+            yield model.als
+
+
+def apply_retrieval_config(models: Sequence[Any],
+                           config: "ServerConfig") -> None:
+    """Push the ServerConfig retrieval knobs onto every capable model
+    (no-op for engines without an ANN-capable model)."""
+    for target in retrieval_targets(models):
+        target.configure_retrieval(
+            config.retrieval, nprobe=config.ann_nprobe,
+            rescore=config.ann_rescore, nlist=config.ann_nlist)
+
+
 def resolve_engine_instance(
     storage: Storage,
     config: ServerConfig,
@@ -280,6 +330,10 @@ def load_deployed_engine(
     _, _, algorithms, serving = engine.make_components(engine_params)
     models = engine.prepare_deploy(ctx, engine_params, persisted,
                                    algorithms=algorithms)
+    # retrieval mode is deployment config, not model data: applied on
+    # every load (including the /reload path, which swaps the whole
+    # DeployedEngine — the new model arrives with the same knobs)
+    apply_retrieval_config(models, config)
     logger.info(
         "deployed engine instance %s (%s; %d algorithm(s))",
         instance.id, instance.engine_factory, len(algorithms),
