@@ -201,6 +201,13 @@ def default_config() -> LintConfig:
                         "request": ["fleet/", "obs/",
                                     "api/router_server.py"],
                     },
+                    # supervision loops (fleet/supervisor.py,
+                    # fleet/controller.py and everything else in the
+                    # fleet tier): child-process wait()/poll() loops
+                    # must be clock-injectable, so a bare time.sleep
+                    # there is a finding — use clock.sleep or
+                    # Event.wait (PR 9; docs/static-analysis.md)
+                    "banned_sleep_paths": ["fleet/"],
                 },
             ),
             "lock-discipline": RuleConfig(paths=("",)),

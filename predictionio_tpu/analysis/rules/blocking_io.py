@@ -12,6 +12,15 @@ socket-level timeout bounds a single attempt. Default policed calls:
 The timeout may be any expression (config field, constant, deadline
 remainder) — it just has to be PASSED. ``timeout=None`` is flagged:
 that is the spelled-out version of the bug.
+
+The ``banned_sleep_paths`` option extends the rule to supervision
+loops (PR 9): within the listed paths a bare ``time.sleep`` is a
+finding — waits there must ride the injectable
+``utils.resilience.Clock`` (``clock.sleep``) or an ``Event.wait``
+timeout, or the supervisor/controller backoff and drain schedules
+cannot be driven deterministically under ``ManualClock`` and their
+child-process ``wait()``/``poll()`` loops become untestable wall-time
+spins.
 """
 
 from __future__ import annotations
@@ -42,9 +51,35 @@ class UntimedBlockingIORule(Rule):
         call_paths: dict[str, list[str]] = options.get("call_paths", {})
         from predictionio_tpu.analysis.config import path_matches
 
+        # bare time.sleep ban (module docstring): applies when the
+        # module falls under banned_sleep_paths; `from time import
+        # sleep` aliases are tracked so renaming cannot dodge the rule
+        banned_sleep = tuple(options.get("banned_sleep_paths", ()))
+        sleep_banned_here = bool(banned_sleep) and (
+            not module.relpath
+            or path_matches(module.relpath, banned_sleep))
+        sleep_aliases: set[str] = set()
+        if sleep_banned_here:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.ImportFrom) \
+                        and node.module == "time":
+                    for alias in node.names:
+                        if alias.name == "sleep":
+                            sleep_aliases.add(alias.asname or "sleep")
+
         findings: list[Finding] = []
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
+                continue
+            if sleep_banned_here and self._is_bare_sleep(node,
+                                                         sleep_aliases):
+                findings.append(Finding(
+                    self.rule_id, "", node.lineno,
+                    "bare time.sleep in a supervision path — waits "
+                    "here must use the injectable Clock "
+                    "(clock.sleep) or Event.wait so backoff/drain "
+                    "schedules stay deterministic under ManualClock",
+                    node.col_offset))
                 continue
             name = self.call_name(node)
             if name not in policed:
@@ -70,3 +105,10 @@ class UntimedBlockingIORule(Rule):
                     f"{name}(timeout=None) — explicitly unbounded; pass "
                     f"a finite timeout", node.col_offset))
         return findings
+
+    def _is_bare_sleep(self, node: ast.Call,
+                       sleep_aliases: set[str]) -> bool:
+        if self.dotted_name(node.func) == "time.sleep":
+            return True
+        return (isinstance(node.func, ast.Name)
+                and node.func.id in sleep_aliases)

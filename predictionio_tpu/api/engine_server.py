@@ -71,6 +71,7 @@ from predictionio_tpu.api.http_base import (
     ensure_access_log_handler,
     parse_deadline_budget,
     resolve_request_id,
+    retry_after_header,
 )
 from predictionio_tpu.api.stats import ServingStats, resilience_snapshot
 from predictionio_tpu.core.json_codec import (
@@ -329,6 +330,13 @@ class EngineService:
         #: readers (handler threads on both sides).
         self._reload_lock = threading.Lock()
         self._reloads_in_flight = 0
+        #: drain latch (POST /drain): while set, /readyz answers 503
+        #: "draining" so every router's membership loop stops routing
+        #: here — the fleet supervisor's drain-before-SIGTERM step
+        #: (fleet/supervisor.py, docs/fleet.md "Supervision"). Queries
+        #: already in flight still answer; the latch only refuses NEW
+        #: placement. Guarded by _reload_lock at writer and readers.
+        self._draining = False
 
     # -- sublinear retrieval wiring (ops/ann) -------------------------------
     def _wire_ann_observers(self) -> None:
@@ -413,8 +421,11 @@ class EngineService:
                     raise _Reject(
                         503,
                         f"reload failed ({e}); still serving instance {keep}",
-                        {"Retry-After": f"{retry_after_hint(e):.0f}"})
+                        {"Retry-After": retry_after_header(retry_after_hint(e))})
                 return (200, {"message": "Reloading"})
+            if method == "POST" and path == "/drain":
+                self._check_server_key(params)
+                return self.drain(body)
             if method == "POST" and path == "/stop":
                 self._check_server_key(params)
                 threading.Thread(target=self.on_stop, daemon=True).start()
@@ -427,7 +438,7 @@ class EngineService:
         except STORAGE_UNAVAILABLE_ERRORS as e:
             logger.warning("storage unavailable in %s %s: %s", method, path, e)
             return (503, {"message": f"storage unavailable: {e}"},
-                    {"Retry-After": f"{retry_after_hint(e):.0f}"})
+                    {"Retry-After": retry_after_header(retry_after_hint(e))})
         except Exception as e:
             logger.exception("unhandled error in %s %s", method, path)
             return (500, {"message": f"internal error: {e}"})
@@ -451,6 +462,21 @@ class EngineService:
         if status is not None and path == "/queries.json":
             self.slo.record(ok=status < 500, latency_s=dt)
 
+    def drain(self, body: Any = None) -> tuple:
+        """``POST /drain`` — flip this replica's readiness off so the
+        fleet drains it before a planned stop (the supervisor's
+        drain-before-SIGTERM step; docs/fleet.md "Supervision"):
+        ``/readyz`` answers 503 "draining" while the latch holds, every
+        router's membership loop stops routing here within its
+        ``down_after`` probes, and in-flight queries still answer.
+        ``{"action": "undrain"}`` clears the latch (an operator who
+        drained for a look and changed their mind)."""
+        undrain = isinstance(body, dict) and body.get("action") == "undrain"
+        with self._reload_lock:
+            self._draining = not undrain
+        logger.info("drain latch %s", "cleared" if undrain else "set")
+        return (200, {"status": "ready" if undrain else "draining"})
+
     def readyz(self) -> tuple:
         """Readiness: a deployed model AND reachable storage. 503 (with
         Retry-After) until both hold — load balancers drain, clients
@@ -458,13 +484,25 @@ class EngineService:
         replica."""
         with self._reload_lock:
             reloading = self._reloads_in_flight > 0
+            draining = self._draining
+        if draining:
+            # a planned drain (POST /drain): deliberately not-ready
+            # until the supervisor stops the process or an operator
+            # undrains — routers must NOT send new work here (deployed
+            # may be None: the missing-model state readyz handles below
+            # can be drained too)
+            return (503, {"status": "draining",
+                          "model": (self.deployed.instance.id
+                                    if self.deployed is not None
+                                    else "missing")},
+                    {"Retry-After": retry_after_header(1.0)})
         if reloading:
             # a replica mid-model-swap must drain from routers/load
             # balancers: not-ready (NOT ready-with-stale) until the
             # swap commits or fails back to last-known-good
             return (503, {"status": "reloading",
                           "model": self.deployed.instance.id},
-                    {"Retry-After": "1"})
+                    {"Retry-After": retry_after_header(1.0)})
         checks: dict[str, str] = {}
         ready = True
         if self.deployed is not None:
@@ -492,7 +530,7 @@ class EngineService:
         if ready:
             return (200, {"status": "ready", **checks})
         return (503, {"status": "unavailable", **checks},
-                {"Retry-After": "1"})
+                {"Retry-After": retry_after_header(1.0)})
 
     def status_doc(self) -> dict:
         """The GET / status page content (CreateServer.scala:442-469)."""
@@ -643,11 +681,11 @@ class EngineService:
             except QueryDeadlineExceeded as e:
                 # a blown deadline is overload/degradation, not an
                 # application error: 503 so the client retries later
-                raise _Reject(503, str(e), {"Retry-After": "1"})
+                raise _Reject(503, str(e), {"Retry-After": retry_after_header(1.0)})
             except STORAGE_UNAVAILABLE_ERRORS as e:
                 logger.warning("query failed on unavailable storage: %s", e)
                 raise _Reject(503, f"storage unavailable: {e}",
-                              {"Retry-After": f"{retry_after_hint(e):.0f}"})
+                              {"Retry-After": retry_after_header(retry_after_hint(e))})
             except Exception as e:
                 logger.exception("query failed")
                 raise _Reject(500, f"query failed: {e}")

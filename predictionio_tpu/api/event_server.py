@@ -53,6 +53,7 @@ from predictionio_tpu.api.http_base import (
     emit_access_log,
     ensure_access_log_handler,
     resolve_request_id,
+    retry_after_header,
 )
 from predictionio_tpu.api.plugins import EventInfo, EventServerPluginContext
 from predictionio_tpu.api.stats import IngestStats, StatsKeeper, resilience_snapshot
@@ -255,7 +256,7 @@ class EventService:
         if err is not None:
             return (503,
                     {"status": "unavailable", "storage": f"{err}"},
-                    {"Retry-After": f"{retry_after_hint(err):.0f}"})
+                    {"Retry-After": retry_after_header(retry_after_hint(err))})
         return 200, {"status": "ready", "storage": "ok"}
 
     def plugins_json(self) -> Response:
@@ -631,7 +632,7 @@ class EventService:
             logger.warning("storage unavailable handling %s %s: %s",
                            method, path, exc)
             return (503, {"message": f"storage unavailable: {exc}"},
-                    {"Retry-After": f"{retry_after_hint(exc):.0f}"})
+                    {"Retry-After": retry_after_header(retry_after_hint(exc))})
         except Exception as exc:  # Common.exceptionHandler parity
             logger.exception("internal error handling %s %s", method, path)
             return 500, {"message": str(exc)}

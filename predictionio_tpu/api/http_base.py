@@ -90,6 +90,37 @@ def resolve_request_id(headers: Mapping[str, str]) -> str:
     return f"{_REQUEST_ID_PREFIX}{next(_REQUEST_ID_SEQ):08x}"
 
 
+#: seeded jitter source for Retry-After hints — seeded so the draw
+#: sequence is reproducible per process (tests may also pass their own
+#: rng); the POINT is that two clients shed in the same instant get
+#: DIFFERENT hints
+_RETRY_AFTER_RNG = random.Random(0x9E3779B9)
+_RETRY_AFTER_JITTER = 0.25
+
+
+def retry_after_header(seconds: float,
+                       rng: random.Random | None = None) -> str:
+    """A ``Retry-After`` header value with ±25% jitter.
+
+    A fleet of clients that all shed (or all hit one dying backend) in
+    the same instant and obey a CONSTANT integer hint come back in
+    lockstep — a synchronized thundering herd landing exactly when the
+    server is weakest. Jittering the hint decorrelates them, the same
+    full-jitter reasoning as RetryPolicy's backoff
+    (utils/resilience.py). The value is emitted with decimal precision
+    — a CONSCIOUS RFC 9110 deviation (delta-seconds is an integer):
+    rounding ±25% of the dominant 1s hint to an integer erases the
+    jitter entirely, and this framework's own clients/tests parse
+    floats. Strict stacks (urllib3's ``Retry`` header parser rejects
+    non-integers) should derive their backoff client-side instead of
+    honoring the header verbatim; docs/operations-resilience.md
+    documents the contract."""
+    base = max(0.1, float(seconds))
+    draw = (rng or _RETRY_AFTER_RNG).uniform(1.0 - _RETRY_AFTER_JITTER,
+                                             1.0 + _RETRY_AFTER_JITTER)
+    return f"{base * draw:.2f}"
+
+
 def parse_deadline_budget(config_deadline_ms: float,
                           headers: Mapping[str, str]) -> float | None:
     """THE per-request deadline contract, shared by the engine server

@@ -58,6 +58,7 @@ from predictionio_tpu.api.http_base import (
     emit_access_log,
     ensure_access_log_handler,
     resolve_request_id,
+    retry_after_header,
 )
 from predictionio_tpu.fleet.canary import GuardrailConfig
 from predictionio_tpu.fleet.router import (
@@ -147,10 +148,129 @@ class RouterService:
                       traces_snapshot=self.trace_log.snapshot,
                       timeout_s=self.config.scrape_timeout_s)
             if self.config.worker_spool_dir else None)
+        #: shared admin state (fleet/workers.py): canary mutations and
+        #: guardrail abort verdicts published by ANY worker are applied
+        #: by every sibling's sync loop, and a respawned worker adopts
+        #: the latest document at startup instead of the launch-time
+        #: weight — admin no longer addresses ONE worker
+        self._admin_lock = threading.Lock()
+        self._admin_seq = 0
+        self._admin_stop = threading.Event()
+        self._admin_thread: threading.Thread | None = None
+        #: optional self-healing attachments (`pio router --supervise`):
+        #: the process supervisor and the scale controller register
+        #: their collectors and appear in the /fleet document
+        self.supervisor = None
+        self.controller = None
+        if self.worker_hub is not None:
+            self.router.on_canary_abort = self._publish_canary_abort
+            self._sync_admin_once()     # respawn adoption
+            self._admin_thread = threading.Thread(
+                target=self._admin_sync_loop,
+                name="pio-router-admin-sync", daemon=True)
+            self._admin_thread.start()
+
+    def attach_supervisor(self, supervisor) -> None:
+        from predictionio_tpu.fleet.supervisor import supervisor_collector
+
+        self.supervisor = supervisor
+        self.registry.register(supervisor_collector(supervisor))
+
+    def attach_controller(self, controller) -> None:
+        from predictionio_tpu.fleet.controller import controller_collector
+
+        self.controller = controller
+        self.registry.register(controller_collector(controller))
 
     def close(self) -> None:
+        self._admin_stop.set()
+        if self._admin_thread is not None:
+            self._admin_thread.join(timeout=5)
+            self._admin_thread = None
         if self.worker_hub is not None:
             self.worker_hub.close()
+
+    # -- shared admin state (fleet/workers.py) -------------------------------
+    def _admin_sync_loop(self) -> None:
+        # Event.wait doubles as interval sleep and prompt stop — the
+        # membership-loop idiom, never a bare time.sleep
+        while not self._admin_stop.wait(self.config.admin_sync_interval_s):
+            try:
+                self._sync_admin_once()
+            except Exception:  # noqa: BLE001 — a torn read is the next pass's problem
+                logger.exception("admin-state sync failed")
+
+    def _sync_admin_once(self) -> None:
+        hub = self.worker_hub
+        if hub is None:
+            return
+        doc = hub.read_admin()
+        if doc is None:
+            return
+        with self._admin_lock:
+            if doc["seq"] <= self._admin_seq:
+                return
+            self._admin_seq = doc["seq"]
+        self._apply_admin(doc)
+
+    def _apply_admin(self, doc: dict) -> None:
+        action = doc.get("action")
+        if action == "set_weight":
+            try:
+                weight = float(doc["weight"])
+            except (KeyError, TypeError, ValueError):
+                logger.warning("ignoring malformed admin doc: %r", doc)
+                return
+            guardrail = None
+            g = doc.get("guardrail")
+            if isinstance(g, dict):
+                try:
+                    guardrail = GuardrailConfig(
+                        min_requests=int(g["minRequests"]),
+                        max_error_rate=float(g["maxErrorRate"]),
+                        max_p99_ms=float(g["maxP99Ms"]),
+                        window=int(g["window"]))
+                except (KeyError, TypeError, ValueError):
+                    guardrail = None
+            self.router.canary.set_weight(weight, guardrail=guardrail)
+            logger.info("adopted shared canary weight %.1f%% (seq %d)",
+                        weight, doc["seq"])
+        elif action == "abort":
+            self.router.canary.abort(
+                str(doc.get("reason") or "sibling abort"))
+            logger.warning("adopted sibling canary abort (seq %d): %s",
+                           doc["seq"], doc.get("reason"))
+        else:
+            logger.warning("unknown admin action %r (seq %s)", action,
+                           doc.get("seq"))
+
+    def _publish_admin(self, doc: dict) -> None:
+        hub = self.worker_hub
+        if hub is None:
+            return
+        # publish AND advance _admin_seq under the one lock: the sync
+        # loop compares seq under the same lock, so it can never read
+        # the freshly-committed document in a gap before the seq
+        # advances and re-apply our own mutation (a re-applied
+        # set_weight would clear the guardrail window a second time)
+        with self._admin_lock:
+            try:
+                seq = hub.publish_admin(doc)
+            except OSError:
+                logger.exception("publishing admin state failed")
+                return
+            self._admin_seq = max(self._admin_seq, seq)
+
+    def _publish_canary_abort(self) -> None:
+        """FleetRouter.on_canary_abort hook: a guardrail verdict on
+        THIS worker latches every sibling too — one worker's window
+        seeing the breach first must not leave the others happily
+        routing canary traffic."""
+        snap = self.router.canary.snapshot()
+        self._publish_admin({
+            "action": "abort",
+            "reason": snap.get("abortReason") or "guardrail abort",
+        })
 
     # -- auth ---------------------------------------------------------------
     def _check_router_key(self, params: Mapping[str, str]) -> None:
@@ -234,13 +354,19 @@ class RouterService:
         return render_metrics(merged)
 
     def fleet_metrics_text(self) -> str:
+        return render_metrics(self.fleet_metrics_families())
+
+    def fleet_metrics_families(self) -> list[Metric]:
         """Scrape every replica's ``/metrics`` (bounded per replica by
         ``scrape_timeout_s``) and re-export with ``replica``/``group``
         labels, plus the fleet-wide ``pio_fleet_pressure`` gauge
         derived from the bucket-merged queue-wait/device-dispatch
         histograms. Scrapes bypass the data-path breakers on purpose: a
         failed scrape must not mark a replica down for traffic, it just
-        reports ``pio_fleet_scrape_ok 0``."""
+        reports ``pio_fleet_scrape_ok 0``. Returned as Metric families
+        so the scale controller reads the same contract WITHOUT a
+        render→reparse round-trip per tick (``GET /fleet/metrics``
+        renders them)."""
         scrape_ok = Metric(
             name="pio_fleet_scrape_ok", kind="gauge",
             help="1 when the replica answered the fan-out scrape")
@@ -263,11 +389,16 @@ class RouterService:
         sources: list[tuple[str, list]] = []
         queue_snaps: list = []
         device_snaps: list = []
+        # ONE membership snapshot for both the fan-out and the zip:
+        # `backends` is a per-call copy and the scale controller
+        # mutates the underlying list at runtime — a second read could
+        # be shorter/shifted and attribute scrape results to the wrong
+        # replica
+        backends = self.router.membership.backends
         # concurrent per replica (fan_out): the scrape pays the slowest
         # replica's timeout, not the sum over black-holed ones
-        scraped = fan_out(self.router.membership.backends, scrape)
-        for backend, result in zip(self.router.membership.backends,
-                                   scraped):
+        scraped = fan_out(backends, scrape)
+        for backend, result in zip(backends, scraped):
             if result is None:
                 continue
             labels, families = result
@@ -287,7 +418,7 @@ class RouterService:
             merged.append(pressure_metric(
                 merge_snapshots(queue_snaps),
                 merge_snapshots(device_snaps)))
-        return render_metrics(merged)
+        return merged
 
     def stitched_trace(self, trace_id: str) -> tuple:
         """``GET /traces.json?trace_id=`` — fan out to every replica's
@@ -316,9 +447,11 @@ class RouterService:
 
         scrape_errors = 0
         # concurrent per replica: the merge pays the slowest replica's
-        # timeout, not the sum (fleet/transport.fan_out)
-        rings = fan_out(self.router.membership.backends, fetch_ring)
-        for backend, docs in zip(self.router.membership.backends, rings):
+        # timeout, not the sum (fleet/transport.fan_out); one snapshot
+        # for fan-out AND zip — the backend list mutates at runtime
+        backends = self.router.membership.backends
+        rings = fan_out(backends, fetch_ring)
+        for backend, docs in zip(backends, rings):
             if docs is None:
                 scrape_errors += 1
                 continue
@@ -344,7 +477,8 @@ class RouterService:
         if routable > 0:
             return (200, {"status": "ready", "routableBackends": routable})
         return (503, {"status": "unavailable", "routableBackends": 0},
-                {"Retry-After": f"{max(1, round(self.router.membership.probe_interval_s)):d}"})
+                {"Retry-After": retry_after_header(
+                    max(1.0, self.router.membership.probe_interval_s))})
 
     def fleet_doc(self) -> dict:
         return {
@@ -361,6 +495,10 @@ class RouterService:
                 "downAfter": self.router.membership.down_after,
                 "upAfter": self.router.membership.up_after,
             },
+            **({"supervisor": self.supervisor.snapshot()}
+               if self.supervisor is not None else {}),
+            **({"scaleController": self.controller.snapshot()}
+               if self.controller is not None else {}),
         }
 
     def canary_admin(self, body: bytes) -> tuple:
@@ -375,6 +513,8 @@ class RouterService:
             raise _Reject(400, "the request body must be a JSON object")
         if doc.get("action") == "abort":
             self.router.canary.abort()
+            self._publish_admin({"action": "abort",
+                                 "reason": "operator abort"})
             return (200, self.router.canary.snapshot())
         if "weight" not in doc:
             raise _Reject(400, 'expected {"weight": <0..100>} or '
@@ -401,6 +541,15 @@ class RouterService:
             except (TypeError, ValueError) as exc:
                 raise _Reject(400, f"invalid guardrail: {exc}")
         self.router.canary.set_weight(weight, guardrail=guardrail)
+        admin_doc: dict = {"action": "set_weight", "weight": weight}
+        if guardrail is not None:
+            admin_doc["guardrail"] = {
+                "minRequests": guardrail.min_requests,
+                "maxErrorRate": guardrail.max_error_rate,
+                "maxP99Ms": guardrail.max_p99_ms,
+                "window": guardrail.window,
+            }
+        self._publish_admin(admin_doc)
         logger.info("canary weight set to %.1f%%", weight)
         return (200, self.router.canary.snapshot())
 

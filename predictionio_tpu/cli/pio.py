@@ -77,25 +77,79 @@ def _router_worker(config) -> None:
         server.stop()
 
 
+def _scaling_requested(args) -> bool:
+    return any(v is not None for v in (
+        args.min_replicas, args.max_replicas, args.scale_interval_s,
+        args.scale_pressure_up, args.scale_burn_up,
+        args.scale_up_sustain_s, args.scale_down_sustain_s,
+        args.scale_cooldown_s)) or args.scale_dry_run
+
+
 def _cmd_router(args, storage: Storage) -> int:
     """`pio router` — the fleet tier (docs/fleet.md): a thin router
     fronting N engine-server replicas with health-driven membership,
     weighted canary rollout, hedged retries, and bounded admission.
-    Storage-free: the router talks HTTP to its replicas, never to the
-    event/metadata stores."""
+    With ``--supervise`` the router also OWNS its children: worker
+    siblings and ``--replica-cmd`` replicas are respawned on death with
+    damped backoff (crash loops latch instead of spinning), SIGTERM
+    drains the whole fleet, and the scale controller
+    (``--min-replicas``/``--max-replicas``/``--scale-*``) adds/removes
+    replicas against the autoscaling signals. Storage-free: the router
+    talks HTTP to its replicas, never to the event/metadata stores."""
     import dataclasses
+    import itertools
+    import shlex
+    import subprocess
 
     from predictionio_tpu.api.router_server import RouterServer
     from predictionio_tpu.fleet.router import RouterConfig
 
-    if not args.backend:
-        print("[ERROR] at least one --backend host:port is required.")
+    supervise = args.supervise
+    scaling = _scaling_requested(args)
+    replica_cmd = args.replica_cmd
+    if (replica_cmd is not None or scaling) and not supervise:
+        print("[ERROR] --replica-cmd and --min/--max-replicas/--scale-* "
+              "require --supervise (the supervisor owns the replicas "
+              "the controller scales).")
+        return 1
+
+    # template replicas (docs/fleet.md "Supervision"): {port} in the
+    # command is substituted per replica; ports allocate sequentially
+    # from --replica-port-base for initial AND scale-up spawns
+    replica_specs = []
+    next_replica_spec = None
+    if replica_cmd is not None:
+        from predictionio_tpu.fleet.supervisor import REPLICA, SpawnSpec
+
+        port_counter = itertools.count(args.replica_port_base)
+
+        def next_replica_spec(_index=None):
+            port = next(port_counter)
+            argv = [a.format(port=port)
+                    for a in shlex.split(replica_cmd)]
+            return SpawnSpec(
+                id=f"replica:{port}",
+                spawn=lambda: subprocess.Popen(argv),
+                role=REPLICA,
+                address=f"127.0.0.1:{port}")
+
+        min_replicas = args.min_replicas if args.min_replicas is not None \
+            else 1
+        initial = args.replicas if args.replicas is not None \
+            else max(1, min_replicas)
+        replica_specs = [next_replica_spec() for _ in range(initial)]
+
+    backends = tuple(args.backend or ()) + tuple(
+        s.address for s in replica_specs)
+    if not backends:
+        print("[ERROR] at least one --backend host:port (or --supervise "
+              "--replica-cmd) is required.")
         return 1
     workers = max(1, args.workers or 1)
     config = RouterConfig(
         ip=args.ip,
         port=args.port,
-        backends=tuple(args.backend),
+        backends=backends,
         canary_backends=tuple(args.canary_backend or ()),
         router_key=args.router_key,
         access_log=args.access_log,
@@ -113,6 +167,7 @@ def _cmd_router(args, storage: Storage) -> int:
         }.items() if v is not None},
     )
     worker_procs = []
+    worker_specs = []
     if workers > 1:
         import multiprocessing
         import socket as _socket
@@ -129,25 +184,125 @@ def _cmd_router(args, storage: Storage) -> int:
         # worker peering spool (fleet/workers.py): each worker
         # registers its loopback peer endpoint here, so a /metrics
         # scrape landing on ONE SO_REUSEPORT worker reports ALL of
-        # them (docs/fleet.md)
+        # them — and the shared canary/admin state document rides the
+        # same spool (docs/fleet.md)
         config = dataclasses.replace(
             config,
             worker_spool_dir=tempfile.mkdtemp(prefix="pio-router-workers-"))
-        for _ in range(workers - 1):
-            proc = multiprocessing.Process(
-                target=_router_worker, args=(config,), daemon=True)
-            proc.start()
-            worker_procs.append(proc)
+        if supervise:
+            from predictionio_tpu.fleet.supervisor import (
+                WORKER,
+                ProcessHandle,
+                SpawnSpec,
+            )
+
+            def worker_spawn():
+                return ProcessHandle(multiprocessing.Process(
+                    target=_router_worker, args=(config,), daemon=True))
+
+            worker_specs = [
+                SpawnSpec(id=f"worker:{i}", spawn=worker_spawn,
+                          role=WORKER)
+                for i in range(1, workers)
+            ]
+        else:
+            for _ in range(workers - 1):
+                proc = multiprocessing.Process(
+                    target=_router_worker, args=(config,), daemon=True)
+                proc.start()
+                worker_procs.append(proc)
+
+    supervisor = None
+    controller = None
+    if supervise:
+        from predictionio_tpu.fleet.supervisor import (
+            FleetSupervisor,
+            SupervisorConfig,
+        )
+
+        supervisor = FleetSupervisor(
+            replica_specs + worker_specs,
+            SupervisorConfig(**({"drain_key": args.replica_key}
+                                if args.replica_key else {})))
+        supervisor.start()
     server = RouterServer(config)
+    if supervisor is not None:
+        server.service.attach_supervisor(supervisor)
+        for spec in replica_specs:
+            # template replicas are still booting (importing jax):
+            # join them DOWN so the probe loop gates traffic onto them
+            # when they actually serve — the same invariant the
+            # scale-up actuator establishes for identical cold spawns
+            backend = server.router.membership.by_id(spec.address)
+            if backend is not None:
+                backend.mark_down("starting")
+    if supervise and (scaling or replica_cmd is not None):
+        from predictionio_tpu.fleet.controller import (
+            MembershipCountActuator,
+            ScaleController,
+            ScalePolicy,
+            SupervisedFleetActuator,
+            fleet_signals_reader,
+        )
+
+        # actuation must be REQUESTED: --replica-cmd alone runs the
+        # controller in dry-run (verdicts exported, nothing spawned) —
+        # the documented rollout posture. Passing any --scale-* or
+        # --min/--max-replicas flag without --scale-dry-run arms it.
+        dry_run = bool(args.scale_dry_run) or not scaling
+        if dry_run and not args.scale_dry_run:
+            print("[INFO] scale controller in DRY-RUN (no --scale-* "
+                  "flags given): verdicts exported only; add "
+                  "--min/--max-replicas or --scale-* to arm actuation "
+                  "(docs/fleet.md rollout runbook).")
+        if next_replica_spec is not None:
+            actuator = SupervisedFleetActuator(
+                supervisor, server.router.membership,
+                make_spec=next_replica_spec,
+                breaker_threshold=config.breaker_threshold,
+                breaker_reset_s=config.breaker_reset_s)
+            for spec in replica_specs:
+                actuator.adopt(spec.id)
+        else:
+            print("[WARN] scale flags without --replica-cmd: the "
+                  "controller has nothing to actuate — forcing "
+                  "--scale-dry-run (decisions exported only).")
+            actuator = MembershipCountActuator(server.router.membership)
+            dry_run = True
+        policy = ScalePolicy(
+            dry_run=dry_run,
+            **{k: v for k, v in {
+                "min_replicas": args.min_replicas,
+                "max_replicas": args.max_replicas,
+                "interval_s": args.scale_interval_s,
+                "pressure_up": args.scale_pressure_up,
+                "burn_up": args.scale_burn_up,
+                "up_sustain_s": args.scale_up_sustain_s,
+                "down_sustain_s": args.scale_down_sustain_s,
+                "cooldown_s": args.scale_cooldown_s,
+            }.items() if v is not None})
+        controller = ScaleController(
+            policy, fleet_signals_reader(server.service), actuator)
+        controller.start()
+        server.service.attach_controller(controller)
     print(f"[INFO] Fleet Router listening on {args.ip}:{server.port} "
           f"({len(config.backends)} stable / "
           f"{len(config.canary_backends)} canary backend(s), "
-          f"{workers} worker(s))")
-    if worker_procs:
+          f"{workers} worker(s)"
+          + (", supervised" if supervise else "")
+          + (", scale controller "
+             + ("dry-run" if controller is not None
+                and controller.policy.dry_run else "active")
+             if controller is not None else "")
+          + ")")
+    if worker_procs or supervisor is not None:
         # SIGTERM's default action kills the parent without running
         # finally/atexit, orphaning the SO_REUSEPORT workers on the
         # shared port (they keep serving with a stale spool). Route it
-        # through KeyboardInterrupt so the reap below always runs.
+        # through KeyboardInterrupt so the finally always runs — under
+        # --supervise that means a graceful FULL-FLEET drain (replicas
+        # drained via /readyz before SIGTERM, then workers), fixing
+        # the old "stop from the shell stops one worker" quirk.
         import signal
 
         def _on_sigterm(signum, frame):
@@ -159,6 +314,10 @@ def _cmd_router(args, storage: Storage) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if controller is not None:
+            controller.stop()
+        if supervisor is not None:
+            supervisor.shutdown()
         server.stop()
         for proc in worker_procs:
             proc.terminate()
@@ -494,6 +653,63 @@ def build_parser() -> argparse.ArgumentParser:
                         "attempt/retry/hedge) with trace context "
                         "forwarded to replicas for cross-process "
                         "stitching; see `pio trace`")
+    # self-healing (docs/fleet.md "Supervision" / "Autoscaling"):
+    # PIO_FLEET_* env tunes the supervisor backoff/crash-loop and the
+    # scale policy defaults; None here falls through to those
+    p.add_argument("--supervise", action="store_true",
+                   help="own the worker siblings (and --replica-cmd "
+                        "replicas): respawn on death with damped "
+                        "backoff, latch crash loops, drain the whole "
+                        "fleet on SIGTERM")
+    p.add_argument("--replica-cmd", default=None, dest="replica_cmd",
+                   metavar="CMD",
+                   help="shell-style command template spawning one "
+                        "engine-server replica; {port} is substituted "
+                        "(e.g. 'pio deploy --port {port}'); requires "
+                        "--supervise")
+    p.add_argument("--replica-key", default=None, dest="replica_key",
+                   help="accessKey the supervisor sends on POST /drain "
+                        "when the --replica-cmd replicas run with a "
+                        "server key (PIO_FLEET_DRAIN_KEY)")
+    p.add_argument("--replica-port-base", type=int, default=8200,
+                   dest="replica_port_base",
+                   help="first replica port for --replica-cmd spawns "
+                        "(sequential from here, scale-ups included)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="initial --replica-cmd replica count (default: "
+                        "max(1, --min-replicas))")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   dest="min_replicas",
+                   help="scale controller floor (PIO_FLEET_MIN_REPLICAS)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   dest="max_replicas",
+                   help="scale controller ceiling (PIO_FLEET_MAX_REPLICAS)")
+    p.add_argument("--scale-dry-run", action="store_true",
+                   dest="scale_dry_run",
+                   help="evaluate the scale policy but only EXPORT "
+                        "verdicts (pio_fleet_desired_replicas vs "
+                        "actual + decision counters) — the rollout "
+                        "posture; see docs/fleet.md")
+    p.add_argument("--scale-interval-s", type=float, default=None,
+                   dest="scale_interval_s")
+    p.add_argument("--scale-pressure-up", type=float, default=None,
+                   dest="scale_pressure_up",
+                   help="scale up when pio_fleet_pressure sustains "
+                        "at/above this (PIO_FLEET_PRESSURE_UP)")
+    p.add_argument("--scale-burn-up", type=float, default=None,
+                   dest="scale_burn_up",
+                   help="scale up when the fast-window SLO burn rate "
+                        "reaches this (PIO_FLEET_BURN_UP)")
+    p.add_argument("--scale-up-sustain-s", type=float, default=None,
+                   dest="scale_up_sustain_s")
+    p.add_argument("--scale-down-sustain-s", type=float, default=None,
+                   dest="scale_down_sustain_s",
+                   help="quiet cooldown before a scale-in "
+                        "(PIO_FLEET_DOWN_SUSTAIN_S)")
+    p.add_argument("--scale-cooldown-s", type=float, default=None,
+                   dest="scale_cooldown_s",
+                   help="minimum gap between scale actions "
+                        "(PIO_FLEET_COOLDOWN_S)")
 
     p = sub.add_parser(
         "trace",

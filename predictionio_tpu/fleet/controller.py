@@ -1,0 +1,404 @@
+"""The scale controller: the actor behind the autoscaling signals
+(docs/fleet.md "Autoscaling").
+
+PR 7 shipped the contract — ``pio_fleet_pressure`` and
+``pio_slo_burn_rate{slo,window}`` on ``GET /fleet/metrics`` — and
+documented the policy a controller should run. This module IS that
+controller: a background loop that polls the router's own merged fleet
+metrics and applies a hysteresis policy,
+
+- **scale up** on SUSTAINED pressure above ``pressure_up`` (latency is
+  queueing, not model time) or a fast-window SLO burn above
+  ``burn_up`` (the incident is happening now),
+- **scale down** only after a COOLDOWN of sustained quiet (pressure
+  below ``pressure_down`` with both burn windows under 1.0),
+- clamped to ``[min_replicas, max_replicas]``, with a global
+  ``cooldown_s`` between actions so one hot scrape cannot ratchet the
+  fleet,
+- **dry-run first**: with ``dry_run`` the controller only EXPORTS its
+  verdicts (``pio_fleet_desired_replicas`` vs actual, decision
+  counters) so operators can watch it against production traffic
+  before trusting it with actuation.
+
+Everything is deterministic on the injectable Clock: ``tick()`` is the
+loop body AND the test hook, and the decision table
+(tests/test_fleet_supervisor.py) drives it with scripted signals on a
+``ManualClock``. Actuation goes through a small interface so the
+supervised-fleet actuator (spawn a replica via the supervisor, join it
+to membership; detach + drain on the way down) and test doubles are
+interchangeable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+from typing import Callable
+
+from predictionio_tpu.fleet.membership import Backend, BackendSpec
+from predictionio_tpu.fleet.supervisor import (
+    CRASH_LOOPED,
+    FleetSupervisor,
+    SpawnSpec,
+    _env_field,
+)
+from predictionio_tpu.obs.registry import Metric
+from predictionio_tpu.utils.resilience import SYSTEM_CLOCK, Clock
+
+logger = logging.getLogger(__name__)
+
+UP, DOWN, HOLD, ERROR = "up", "down", "hold", "error"
+
+#: decision counter keys (cooldown_hold = a verdict suppressed by the
+#: global action cooldown; actuation_failed = the actuator said no)
+DECISIONS = (UP, DOWN, HOLD, ERROR, "cooldown_hold", "actuation_failed")
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleSignals:
+    """One poll of the autoscaling contract. ``pressure`` is None when
+    the fleet scrape produced no pressure gauge (no traffic yet, or
+    every replica scrape failed) — the controller treats that as
+    neither hot nor quiet."""
+
+    pressure: float | None
+    fast_burn: float = 0.0
+    slow_burn: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalePolicy:
+    """Controller knobs, ``PIO_FLEET_*`` env-overridable at
+    construction (docs/fleet.md "Autoscaling" has the table)."""
+
+    min_replicas: int = _env_field("MIN_REPLICAS", 1, int)
+    max_replicas: int = _env_field("MAX_REPLICAS", 4, int)
+    #: scale-up triggers: queue-bound pressure, or the classic 5m-fast
+    #: burn threshold for a 99.9% objective (14.4 = the page line)
+    pressure_up: float = _env_field("PRESSURE_UP", 0.5, float)
+    burn_up: float = _env_field("BURN_UP", 14.4, float)
+    #: scale-down trigger: pressure at or below this AND both burn
+    #: windows under 1.0 (budget spend at sustainable rate)
+    pressure_down: float = _env_field("PRESSURE_DOWN", 0.1, float)
+    #: how long a trigger must hold before it becomes a verdict
+    up_sustain_s: float = _env_field("UP_SUSTAIN_S", 15.0, float)
+    down_sustain_s: float = _env_field("DOWN_SUSTAIN_S", 120.0, float)
+    #: minimum gap between ACTIONS (and dry-run verdicts): one hot
+    #: scrape must not ratchet the fleet replica-by-replica
+    cooldown_s: float = _env_field("COOLDOWN_S", 60.0, float)
+    #: poll cadence of the background loop
+    interval_s: float = _env_field("SCALE_INTERVAL_S", 5.0, float)
+    #: export decisions without actuating (the rollout posture)
+    dry_run: bool = False
+
+
+class ScaleController:
+    """Hysteresis policy loop over ``read_signals`` + an actuator
+    (module docstring)."""
+
+    def __init__(self, policy: ScalePolicy,
+                 read_signals: Callable[[], ScaleSignals],
+                 actuator, clock: Clock = SYSTEM_CLOCK):
+        self.policy = policy
+        self.read_signals = read_signals
+        self.actuator = actuator
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._counts = dict.fromkeys(DECISIONS, 0)
+        self._hot_since: float | None = None
+        self._quiet_since: float | None = None
+        self._last_action_at: float | None = None
+        self._desired: int | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- the decision engine --------------------------------------------------
+    def tick(self) -> str:
+        """One evaluation — the loop body and the deterministic test
+        hook. Returns the decision taken."""
+        p = self.policy
+        now = self.clock.monotonic()
+        try:
+            signals = self.read_signals()
+        except Exception as exc:  # noqa: BLE001 — a failed scrape is a held tick
+            logger.warning("scale signals unreadable: %s", exc)
+            return self._count(ERROR)
+        current = self.actuator.current()
+        hot = ((signals.pressure is not None
+                and signals.pressure >= p.pressure_up)
+               or signals.fast_burn >= p.burn_up)
+        quiet = (signals.pressure is not None
+                 and signals.pressure <= p.pressure_down
+                 and signals.fast_burn < 1.0 and signals.slow_burn < 1.0)
+        if hot:
+            if self._hot_since is None:     # not `or`: t=0 is a real time
+                self._hot_since = now
+            self._quiet_since = None
+        elif quiet:
+            if self._quiet_since is None:
+                self._quiet_since = now
+            self._hot_since = None
+        else:
+            # neither hot nor quiet resets BOTH sustain windows — the
+            # hysteresis that keeps a flapping signal from scaling
+            self._hot_since = self._quiet_since = None
+        delta = 0
+        if hot and now - self._hot_since >= p.up_sustain_s:
+            delta = 1
+        elif quiet and now - self._quiet_since >= p.down_sustain_s:
+            delta = -1
+        desired = min(p.max_replicas, max(p.min_replicas, current + delta))
+        if desired == current:
+            self._set_desired(desired)
+            return self._count(HOLD)
+        if self._last_action_at is not None \
+                and now - self._last_action_at < p.cooldown_s:
+            self._set_desired(current)
+            return self._count("cooldown_hold")
+        # a verdict: record it, restart the sustain windows, and (when
+        # not dry-running) actuate one step
+        self._set_desired(desired)
+        self._last_action_at = now
+        self._hot_since = self._quiet_since = None
+        decision = UP if desired > current else DOWN
+        if p.dry_run:
+            logger.info("scale %s verdict (dry-run): desired %d vs "
+                        "actual %d", decision, desired, current)
+            return self._count(decision)
+        acted = (self.actuator.add_replica() if decision == UP
+                 else self.actuator.remove_replica())
+        if not acted:
+            self._count("actuation_failed")
+            logger.warning("scale %s actuation failed (desired %d, "
+                           "actual %d)", decision, desired, current)
+        return self._count(decision)
+
+    def _count(self, decision: str) -> str:
+        with self._lock:
+            self._counts[decision] += 1
+        return decision
+
+    def _set_desired(self, desired: int) -> None:
+        with self._lock:
+            self._desired = desired
+
+    # -- reporting ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = dict(self._counts)
+            desired = self._desired
+        return {
+            "dryRun": self.policy.dry_run,
+            "minReplicas": self.policy.min_replicas,
+            "maxReplicas": self.policy.max_replicas,
+            "desiredReplicas": desired,
+            "actualReplicas": self.actuator.current(),
+            "decisions": counts,
+        }
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="pio-fleet-scaler", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(self.policy.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def controller_collector(controller: ScaleController):
+    """Registry adapter: desired vs actual replica gauges + decision
+    counters — the whole dry-run trust story is these families."""
+
+    def collect() -> list[Metric]:
+        snap = controller.snapshot()
+        out = [
+            Metric(name="pio_fleet_desired_replicas", kind="gauge",
+                   help="Replica count the scale controller wants "
+                        "(compare with pio_fleet_actual_replicas; in "
+                        "--scale-dry-run only this moves)",
+                   samples=[({}, float(snap["desiredReplicas"]
+                                       if snap["desiredReplicas"]
+                                       is not None
+                                       else snap["actualReplicas"]))]),
+            Metric(name="pio_fleet_actual_replicas", kind="gauge",
+                   help="Replicas the actuator currently owns",
+                   samples=[({}, float(snap["actualReplicas"]))]),
+            Metric(name="pio_fleet_scale_dry_run", kind="gauge",
+                   help="1 while the controller only exports verdicts",
+                   samples=[({}, 1.0 if snap["dryRun"] else 0.0)]),
+        ]
+        decisions = Metric(
+            name="pio_fleet_scale_decisions_total", kind="counter",
+            help="Scale controller verdicts by outcome")
+        for decision, n in sorted(snap["decisions"].items()):
+            decisions.samples.append(({"decision": decision}, float(n)))
+        out.append(decisions)
+        return out
+
+    return collect
+
+
+# ---------------------------------------------------------------------------
+# signal reader + the supervised-fleet actuator
+# ---------------------------------------------------------------------------
+
+def fleet_signals_reader(service) -> Callable[[], ScaleSignals]:
+    """Read the autoscaling contract off the router's OWN merged fleet
+    metrics — the controller consumes exactly what an external operator
+    would scrape from ``GET /fleet/metrics`` (docs/fleet.md), so
+    trusting the dry-run gauges means trusting the real inputs. The
+    Metric families are consumed BEFORE text rendering
+    (``fleet_metrics_families``): same scrape, same merge, without a
+    render→reparse round-trip stealing serving CPU every tick. Burn
+    rates come from the router's SLO engine (what clients experienced:
+    sheds spend budget)."""
+
+    def read() -> ScaleSignals:
+        pressure: float | None = None
+        for family in service.fleet_metrics_families():
+            if family.name == "pio_fleet_pressure" and family.samples:
+                pressure = family.samples[0][1]
+        burns = service.slo.burn_rates()
+        fast = max((rate for (_, window), rate in burns.items()
+                    if window == "fast"), default=0.0)
+        slow = max((rate for (_, window), rate in burns.items()
+                    if window == "slow"), default=0.0)
+        return ScaleSignals(pressure=pressure, fast_burn=fast,
+                            slow_burn=slow)
+
+    return read
+
+
+class MembershipCountActuator:
+    """Dry-run stand-in when no replica command is configured: the
+    controller can still evaluate and export verdicts against the real
+    membership count, but actuation always refuses (nothing owns the
+    replicas)."""
+
+    def __init__(self, membership, group: str = "stable"):
+        self.membership = membership
+        self.group = group
+
+    def current(self) -> int:
+        return sum(1 for b in self.membership.backends
+                   if b.group == self.group)
+
+    def add_replica(self) -> bool:
+        return False
+
+    def remove_replica(self) -> bool:
+        return False
+
+
+class SupervisedFleetActuator:
+    """Actuation against a supervisor-owned replica set.
+
+    Scale-up: ``make_spec(index)`` yields a fresh :class:`SpawnSpec`
+    (the CLI's ``--replica-cmd`` template), the supervisor spawns it,
+    and its backend joins membership marked DOWN — the probe loop marks
+    it up once it actually serves, so the router never races a replica
+    that is still importing jax. Scale-down: newest-first victim,
+    DETACHED from membership before the supervisor's drain-then-SIGTERM
+    sequence, so no new traffic can land after the verdict."""
+
+    def __init__(self, supervisor: FleetSupervisor, membership,
+                 make_spec: Callable[[int], SpawnSpec],
+                 breaker_threshold: int = 3, breaker_reset_s: float = 5.0,
+                 clock: Clock = SYSTEM_CLOCK):
+        self.supervisor = supervisor
+        self.membership = membership
+        self.make_spec = make_spec
+        self.breaker_threshold = breaker_threshold
+        self.breaker_reset_s = breaker_reset_s
+        self.clock = clock
+        self._lock = threading.Lock()
+        #: spec ids this actuator owns, spawn order (LIFO victims)
+        self._owned: list[str] = []
+        self._index = itertools.count(1)
+
+    def adopt(self, spec_id: str) -> None:
+        """Register a replica spawned at launch time (the CLI's initial
+        ``--replicas``) as scale-managed."""
+        with self._lock:
+            self._owned.append(spec_id)
+
+    def current(self) -> int:
+        """Owned replicas that still count as capacity — a crash-looped
+        child is NOT capacity (scaling up past a latched spec is
+        exactly what an operator wants while triaging it)."""
+        with self._lock:
+            owned = set(self._owned)
+        return sum(1 for doc in self.supervisor.children()
+                   if doc["id"] in owned and doc["state"] != CRASH_LOOPED)
+
+    def add_replica(self) -> bool:
+        with self._lock:
+            owned = set(self._owned)
+        if any(doc["id"] in owned and doc["state"] == CRASH_LOOPED
+               for doc in self.supervisor.children()):
+            # a latched child means the replica SPEC is broken: another
+            # spawn of the same command would latch too, and since
+            # latched children don't count as capacity the min-replica
+            # clamp would demand a fresh (identically broken) spawn
+            # every cooldown forever — leaking children and DOWN
+            # backends. Refuse until an operator clears the crash loop;
+            # desired>actual + actuation_failed climbing is the alarm.
+            logger.warning(
+                "scale-up refused: a crash-looped replica is latched "
+                "(pio_fleet_crash_loop=1) — triage it before the "
+                "controller spawns more of the same spec "
+                "(docs/fleet.md crash-loop runbook)")
+            return False
+        spec = self.make_spec(next(self._index))
+        if spec.address is None:
+            logger.warning("replica spec %s has no address; cannot "
+                           "join membership", spec.id)
+            return False
+        try:
+            self.supervisor.add(spec)
+        except Exception:
+            logger.exception("scale-up spawn of %s failed", spec.id)
+            return False
+        backend = Backend(BackendSpec.parse(spec.address, spec.group),
+                          breaker_threshold=self.breaker_threshold,
+                          breaker_reset_s=self.breaker_reset_s,
+                          clock=self.clock)
+        # join DOWN: the membership probe loop marks it up when the
+        # child actually answers /healthz + /readyz
+        backend.mark_down("starting")
+        self.membership.add(backend)
+        with self._lock:
+            self._owned.append(spec.id)
+        logger.info("scale-up: replica %s spawning at %s", spec.id,
+                    spec.address)
+        return True
+
+    def remove_replica(self) -> bool:
+        with self._lock:
+            if not self._owned:
+                return False
+            spec_id = self._owned.pop()
+        address = next((doc.get("address")
+                        for doc in self.supervisor.children()
+                        if doc["id"] == spec_id), None)
+        if address is not None:
+            # detach FIRST: this router stops routing there before the
+            # drain begins (other routers notice via /readyz)
+            self.membership.remove(address)
+        self.supervisor.remove(spec_id, drain=True)
+        logger.info("scale-down: replica %s drained and stopped", spec_id)
+        return True

@@ -14,16 +14,30 @@ resilience.Clock`) and the loop can be driven synchronously
 (:meth:`FleetMembership.probe_once`) so hysteresis transitions are
 deterministic in tests without wall-time sleeps.
 
+**Probe-starvation guard** (the 1s-probe-under-GIL-saturation pitfall,
+measured in BENCH_router_r01 and written up in the docs/fleet.md
+"Healthy fleet marked down under load" runbook): a probe that TIMES OUT
+against a replica whose data path is demonstrably fine — breaker
+closed, a successful forwarded exchange within the grace window — is
+probe starvation, not replica death. The guard counts it
+(``pio_router_probe_starved_total``), logs a pointed warning, and does
+NOT advance the failure streak, so a saturated-but-serving fleet never
+talks itself into a mark-down spiral. Hard probe failures (refused,
+reset, non-200) and timeouts without recent data-path proof still
+count against the streak exactly as before.
+
 Concurrency: per-:class:`Backend` mutable state (probe streaks, state,
-in-flight count) sits under the backend's own lock; the membership
-object itself is immutable after construction apart from the loop
-thread handle. Handler threads read state through the locked accessors.
+in-flight count) sits under the backend's own lock; the backend LIST is
+lock-guarded too — the scale controller adds and removes replicas at
+runtime (fleet/controller.py), so every view takes a snapshot copy.
+Handler threads read state through the locked accessors.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import logging
+import socket
 import threading
 from typing import Sequence
 
@@ -88,6 +102,7 @@ class Backend:
                 clock=clock),
             clock=clock,
         )
+        self._clock = clock
         self._lock = threading.Lock()
         self._state = UP
         self._ok_streak = 0
@@ -95,6 +110,8 @@ class Backend:
         self._last_error: str | None = None
         self._inflight = 0
         self._transitions = 0
+        self._last_data_ok: float | None = None
+        self._probe_starved = 0
 
     # -- membership state (locked at writers and readers) -------------------
     @property
@@ -132,6 +149,28 @@ class Backend:
     def done(self) -> None:
         with self._lock:
             self._inflight -= 1
+
+    # -- probe-starvation guard (module docstring) ---------------------------
+    def record_data_ok(self) -> None:
+        """A forwarded exchange succeeded — the data-path proof the
+        starvation guard checks before trusting a probe timeout."""
+        with self._lock:
+            self._last_data_ok = self._clock.monotonic()
+
+    def data_ok_within(self, grace_s: float) -> bool:
+        with self._lock:
+            last = self._last_data_ok
+        return (last is not None
+                and self._clock.monotonic() - last <= grace_s)
+
+    def record_probe_starved(self) -> None:
+        with self._lock:
+            self._probe_starved += 1
+
+    @property
+    def probe_starved(self) -> int:
+        with self._lock:
+            return self._probe_starved
 
     def record_probe(self, ok: bool, error: str | None,
                      down_after: int, up_after: int) -> str | None:
@@ -179,6 +218,7 @@ class Backend:
                 "okStreak": self._ok_streak,
                 "failStreak": self._fail_streak,
                 "transitions": self._transitions,
+                "probeStarved": self._probe_starved,
                 **({"lastError": self._last_error}
                    if self._last_error else {}),
             }
@@ -198,16 +238,27 @@ class FleetMembership:
                  probe_interval_s: float = 1.0,
                  probe_timeout_s: float = 1.0,
                  down_after: int = 2,
-                 up_after: int = 2):
-        self.backends = list(backends)
+                 up_after: int = 2,
+                 starvation_grace_s: float = 10.0):
+        self._backends = list(backends)
+        self._backends_lock = threading.Lock()
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
         self.down_after = max(1, down_after)
         self.up_after = max(1, up_after)
+        #: how recent a data-path success must be for a probe TIMEOUT
+        #: to count as starvation rather than death (module docstring)
+        self.starvation_grace_s = starvation_grace_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     # -- views --------------------------------------------------------------
+    @property
+    def backends(self) -> list[Backend]:
+        """Snapshot copy — the list mutates at runtime (scale events)."""
+        with self._backends_lock:
+            return list(self._backends)
+
     def routable(self, group: str | None = None,
                  exclude: frozenset[str] | tuple = ()) -> list[Backend]:
         return [
@@ -223,28 +274,83 @@ class FleetMembership:
     def snapshot(self) -> list[dict]:
         return [b.snapshot() for b in self.backends]
 
+    def probe_starved_total(self) -> int:
+        return sum(b.probe_starved for b in self.backends)
+
+    # -- runtime scale events (fleet/controller.py) --------------------------
+    def add(self, backend: Backend) -> None:
+        """Join a replica at runtime — the probe loop picks it up on
+        its next pass; join it DOWN (``backend.mark_down``) when the
+        process behind it is still starting."""
+        with self._backends_lock:
+            if any(b.id == backend.id for b in self._backends):
+                raise ValueError(f"backend {backend.id!r} already joined")
+            self._backends.append(backend)
+        logger.info("fleet backend %s joined membership", backend.id)
+
+    def remove(self, backend_id: str) -> Backend | None:
+        """Detach a replica: it stops being routable/probed NOW. The
+        caller owns the drain story (the supervisor drains via
+        /readyz before SIGTERM — fleet/supervisor.py)."""
+        with self._backends_lock:
+            backend = next((b for b in self._backends
+                            if b.id == backend_id), None)
+            if backend is not None:
+                self._backends.remove(backend)
+        if backend is not None:
+            backend.close()
+            logger.info("fleet backend %s left membership", backend_id)
+        return backend
+
     # -- probing ------------------------------------------------------------
-    def probe_backend(self, backend: Backend) -> tuple[bool, str | None]:
+    def probe_backend(self, backend: Backend) \
+            -> tuple[bool, str | None, bool]:
         """One health probe: ``/healthz`` then ``/readyz``, both must
-        answer 200 inside ``probe_timeout_s`` each."""
+        answer 200 inside ``probe_timeout_s`` each. Returns
+        ``(ok, error, timed_out)`` — the timeout flag feeds the
+        starvation guard, which must distinguish "slow to answer" from
+        "refused/reset/unready" (only the former is starvation)."""
         for path in ("/healthz", "/readyz"):
             try:
                 response = backend.transport.request(
                     "GET", path, timeout=self.probe_timeout_s)
+            except (TimeoutError, socket.timeout) as exc:
+                return False, f"{path}: {exc}", True
             except Exception as exc:  # transport/protocol failures
-                return False, f"{path}: {exc}"
+                return False, f"{path}: {exc}", False
             if response.status != 200:
-                return False, f"{path}: HTTP {response.status}"
-        return True, None
+                return False, f"{path}: HTTP {response.status}", False
+        return True, None, False
 
     def _probe_and_record(self, backend: Backend) -> None:
-        ok, error = self.probe_backend(backend)
+        ok, error, timed_out = self.probe_backend(backend)
+        if not ok and timed_out and self._starved(backend):
+            # probe starvation, not replica death (module docstring):
+            # the data path is succeeding, so the timeout says the
+            # PROBE lost a scheduling race, and marking the replica
+            # down would concentrate load on the survivors — the
+            # mark-down spiral the runbook describes
+            backend.record_probe_starved()
+            logger.warning(
+                "fleet backend %s probe timed out while its data path "
+                "is healthy (breaker closed, success within %.0fs) — "
+                "counting pio_router_probe_starved_total, NOT marking "
+                "down. Size PIO_ROUTER_PROBE_TIMEOUT_S for the "
+                "replica's p99 under load (docs/fleet.md, \"Healthy "
+                "fleet marked down under load\")",
+                backend.id, self.starvation_grace_s)
+            return
         transition = backend.record_probe(
             ok, error, self.down_after, self.up_after)
         if transition is not None:
             log = logger.warning if transition == DOWN else logger.info
             log("fleet backend %s marked %s%s", backend.id, transition,
                 f" ({error})" if error else "")
+
+    def _starved(self, backend: Backend) -> bool:
+        breaker = backend.resilience.breaker
+        return ((breaker is None or breaker.state == "closed")
+                and backend.data_ok_within(self.starvation_grace_s))
 
     def probe_once(self) -> None:
         """One synchronous probe pass over every backend — the loop

@@ -41,9 +41,12 @@ import logging
 import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
-from typing import Mapping
+from typing import Callable, Mapping
 
-from predictionio_tpu.api.http_base import parse_deadline_budget
+from predictionio_tpu.api.http_base import (
+    parse_deadline_budget,
+    retry_after_header,
+)
 from predictionio_tpu.fleet.canary import CanaryController, GuardrailConfig
 from predictionio_tpu.fleet.membership import (
     CANARY,
@@ -162,25 +165,13 @@ class HedgePolicy:
         return True
 
 
-def _env_default(key: str, default, cast):
-    import os
-
-    raw = os.environ.get(f"PIO_ROUTER_{key}")
-    if raw is None:
-        return default
-    try:
-        return cast(raw)
-    except (TypeError, ValueError):
-        logger.warning("ignoring malformed PIO_ROUTER_%s=%r (using %r)",
-                       key, raw, default)
-        return default
-
-
 def _env_field(key: str, default, cast):
     """``PIO_ROUTER_<KEY>`` env-overridable frozen-dataclass default,
-    read at construction time (the ServerConfig discipline)."""
-    return dataclasses.field(
-        default_factory=lambda: _env_default(key, default, cast))
+    read at construction time (the ServerConfig discipline; shared
+    implementation in utils/envcfg.py)."""
+    from predictionio_tpu.utils.envcfg import env_field
+
+    return env_field("PIO_ROUTER_", key, default, cast)
 
 
 def _cast_bool(raw: str) -> bool:
@@ -243,6 +234,12 @@ class RouterConfig:
     #: peer endpoints (fleet/workers.py) so a /metrics scrape landing
     #: on one worker can report all of them; None = no worker peering
     worker_spool_dir: str | None = None
+    #: cadence of the shared-admin-state sync loop under `--workers N`
+    #: (fleet/workers.py admin spool): canary weight mutations and
+    #: guardrail abort verdicts published by ANY worker are applied by
+    #: every sibling within about this many seconds
+    admin_sync_interval_s: float = _env_field("ADMIN_SYNC_INTERVAL_S",
+                                              0.5, float)
     #: bind with SO_REUSEPORT so N router worker processes share one
     #: listen port (`pio router --workers N`): one CPython router tops
     #: out on its GIL long before the fleet does — workers scale the
@@ -308,6 +305,11 @@ class FleetRouter:
             max_delay_ms=config.hedge_max_delay_ms)
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        #: fired (post-lock, best-effort) when the guardrail auto-abort
+        #: latches — the HTTP layer publishes the verdict to the worker
+        #: admin spool so every `--workers` sibling aborts too instead
+        #: of each latching its own verdict (fleet/workers.py)
+        self.on_canary_abort: "Callable[[], None] | None" = None
         #: rotation tiebreak for the least-loaded pick: under light or
         #: perfectly balanced load every replica's in-flight count is
         #: zero and a bare min() would pin all traffic to the first
@@ -367,7 +369,7 @@ class FleetRouter:
                 trace.tags["outcome"] = "shed"
             return RouterResponse.error(
                 503, "fleet saturated; retry shortly",
-                {"Retry-After": "1"})
+                {"Retry-After": retry_after_header(1.0)})
         try:
             try:
                 budget = self._deadline_budget(headers)
@@ -428,7 +430,8 @@ class FleetRouter:
                 self.stats.bump("expired")
                 out = RouterResponse.error(
                     503, "request deadline exceeded before a replica "
-                         "could answer", {"Retry-After": "1"})
+                         "could answer",
+                    {"Retry-After": retry_after_header(1.0)})
                 # a deadline blown AFTER attempt 0 already exchanged
                 # with replicas (possibly a hedge pair) — the access
                 # log's routing verdict must count them, not say 0
@@ -443,7 +446,8 @@ class FleetRouter:
                 self.stats.bump("no_backend")
                 return RouterResponse.error(
                     503, "no healthy replica available",
-                    {"Retry-After": f"{max(1, round(self.membership.probe_interval_s)):d}"})
+                    {"Retry-After": retry_after_header(
+                        max(1.0, self.membership.probe_interval_s))})
             if attempt > 0:
                 self.stats.bump("retries")
                 retried = True
@@ -473,7 +477,7 @@ class FleetRouter:
         else:
             out = RouterResponse.error(
                 502, f"all replicas failed: {last_failure}",
-                {"Retry-After": "1"})
+                {"Retry-After": retry_after_header(1.0)})
         # every exchanged replica is in `tried` on this path (the
         # except clause adds non-hedge failures, _forward adds both
         # hedge-race ids), so its size IS the attempt count
@@ -597,6 +601,11 @@ class FleetRouter:
                 # racing one half-open probe slot would spuriously
                 # abort a recovered canary) or the latency histograms
                 self.stats.observe_upstream(group, dt)
+                if ok:
+                    # data-path proof for the membership starvation
+                    # guard: a probe timeout against a replica that
+                    # just answered is starvation, not death
+                    backend.record_data_ok()
                 if trace is not None:
                     # the attempt span, under its pre-reserved id (the
                     # one the replica's segment names as its parent)
@@ -611,6 +620,12 @@ class FleetRouter:
                     self.hedge_policy.observe(dt)
                 if self.canary.record(group, ok, dt):
                     self.stats.bump("canary_aborts")
+                    if self.on_canary_abort is not None:
+                        try:
+                            self.on_canary_abort()
+                        except Exception:  # noqa: BLE001 — the abort itself already latched
+                            logger.exception(
+                                "canary abort propagation failed")
 
     def _forward(self, backend: Backend, group: str, tried: set[str],
                  body: bytes, headers: Mapping[str, str], request_id: str,

@@ -100,13 +100,23 @@ def router_collector(stats: RouterStats, membership: Any,
         inflight = Metric(
             name="pio_router_backend_inflight", kind="gauge",
             help="Requests currently forwarded to this backend")
+        starved = Metric(
+            name="pio_router_probe_starved_total", kind="counter",
+            help="Probe timeouts ignored because the backend's data "
+                 "path was demonstrably healthy (breaker closed, "
+                 "recent forwarded success) — the 1s-probe-under-"
+                 "saturation pitfall; see docs/fleet.md \"Healthy "
+                 "fleet marked down under load\"")
         for doc in membership.snapshot():
             labels = {"backend": doc["id"], "group": doc["group"]}
             state.samples.append(
                 (labels, 1.0 if doc["state"] == "up" else 0.0))
             inflight.samples.append((labels, float(doc["inflight"])))
+            starved.samples.append(
+                (labels, float(doc.get("probeStarved", 0))))
         out.append(state)
         out.append(inflight)
+        out.append(starved)
         cs = canary.snapshot()
         out.append(Metric(
             name="pio_router_canary_weight_pct", kind="gauge",

@@ -25,6 +25,19 @@ registry — a 1/N lie. The hub gives every worker:
 The scraped worker merges peers' parsed families with its own through
 ``obs/aggregate.merge_sources`` (counters summed, histograms merged
 bucket-wise, gauges labeled ``worker="<pid>"``).
+
+**Shared admin state** rides the same spool: canary weight mutations
+and guardrail abort verdicts are published as a monotonically-sequenced
+``admin.state`` document (atomic ``os.replace``, exactly like the
+worker entries) that every sibling's sync loop applies — so a
+``POST /fleet/canary`` landing on ONE ``SO_REUSEPORT`` worker reaches
+ALL of them, and a respawned worker re-applies the latest document at
+startup instead of booting with the launch-time weight. Concurrent
+publishers race last-writer-wins on the ``os.replace``; admin
+mutations are rare, human-speed events and the sequence number makes
+the winner unambiguous to every reader. (Named ``admin.state``, not
+``*.json``, so the peer-discovery listing never confuses it for a
+worker entry.)
 """
 
 from __future__ import annotations
@@ -49,6 +62,9 @@ _HUB_SEQ = itertools.count(1)
 
 #: per-peer fetch bound — scrapes degrade, they never hang
 DEFAULT_PEER_TIMEOUT_S = 2.0
+
+#: the shared admin-state document inside the spool (module docstring)
+ADMIN_STATE_FILE = "admin.state"
 
 
 class _PeerHandler(BaseHTTPRequestHandler):
@@ -181,9 +197,53 @@ class WorkerHub:
         return [body for body in fan_out(self.peers(), fetch)
                 if body is not None]
 
+    # -- shared admin state (module docstring) --------------------------------
+    def read_admin(self) -> dict | None:
+        """The latest admin document, or None (never published / torn
+        write in progress — the next sync pass reads the committed
+        one)."""
+        path = os.path.join(self.spool_dir, ADMIN_STATE_FILE)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            return None
+        if not isinstance(doc, dict) or not isinstance(
+                doc.get("seq"), int):
+            return None
+        return doc
+
+    def publish_admin(self, doc: dict) -> int:
+        """Publish one admin mutation for every sibling to apply:
+        assigns ``seq`` = latest + 1, stamps the publishing worker, and
+        commits with an atomic ``os.replace`` (peers never see a torn
+        document). Returns the assigned sequence number."""
+        current = self.read_admin()
+        seq = (current["seq"] if current else 0) + 1
+        payload = {**doc, "seq": seq, "publishedBy": self.worker_id}
+        path = os.path.join(self.spool_dir, ADMIN_STATE_FILE)
+        tmp = f"{path}.{self.worker_id}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        logger.info("published admin state seq=%d: %s", seq,
+                    doc.get("action"))
+        return seq
+
     def close(self) -> None:
         try:
             os.unlink(self._spool_path)
+        except OSError:
+            pass
+        try:
+            # the admin document only matters while siblings remain;
+            # removing it here would race a survivor's sync loop, so it
+            # rides along until the spool dir itself goes (rmdir below
+            # succeeds only for the LAST worker out, which first clears
+            # the admin file)
+            if not any(e.endswith(".json")
+                       for e in os.listdir(self.spool_dir)):
+                os.unlink(os.path.join(self.spool_dir, ADMIN_STATE_FILE))
         except OSError:
             pass
         try:

@@ -1,0 +1,43 @@
+"""Env-overridable frozen-dataclass defaults — ONE implementation.
+
+Three config planes grew the same helper independently
+(``PIO_SERVING_*`` in workflow/deploy.py, ``PIO_ROUTER_*`` in
+fleet/router.py, ``PIO_FLEET_*`` in fleet/supervisor.py), each a copy
+of: read ``<PREFIX><KEY>`` at CONSTRUCTION time (the ServerConfig
+discipline — no import-time env freeze), cast it, and degrade a
+malformed value to the coded default with a warning instead of killing
+the server at config time. They now all delegate here; only the
+prefix differs per plane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+
+def env_default(prefix: str, key: str, default: Any,
+                cast: Callable[[str], Any]) -> Any:
+    """``<prefix><key>`` from the environment, cast; the coded default
+    on absence or a malformed value (warned, never fatal)."""
+    raw = os.environ.get(f"{prefix}{key}")
+    if raw is None:
+        return default
+    try:
+        return cast(raw)
+    except (TypeError, ValueError):
+        logger.warning("ignoring malformed %s%s=%r (using %r)",
+                       prefix, key, raw, default)
+        return default
+
+
+def env_field(prefix: str, key: str, default: Any,
+              cast: Callable[[str], Any]):
+    """A frozen-dataclass field whose default reads
+    ``<prefix><key>`` at construction time."""
+    return dataclasses.field(
+        default_factory=lambda: env_default(prefix, key, default, cast))

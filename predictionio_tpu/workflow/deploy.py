@@ -44,20 +44,11 @@ def _env_field(key: str, default: Any, cast: Callable[[str], Any]):
     — the serving-plane analogue of the ``PIO_RESILIENCE_*`` fallbacks
     (utils/resilience._prop), so a deployment tunes the batcher/cache
     without a code change. A malformed value falls back to the coded
-    default rather than killing the server at config time."""
+    default rather than killing the server at config time (shared
+    implementation in utils/envcfg.py)."""
+    from predictionio_tpu.utils.envcfg import env_field
 
-    def factory() -> Any:
-        raw = os.environ.get(f"PIO_SERVING_{key}")
-        if raw is None:
-            return default
-        try:
-            return cast(raw)
-        except (TypeError, ValueError):
-            logger.warning("ignoring malformed PIO_SERVING_%s=%r "
-                           "(using %r)", key, raw, default)
-            return default
-
-    return dataclasses.field(default_factory=factory)
+    return env_field("PIO_SERVING_", key, default, cast)
 
 
 def _cast_bool(raw: str) -> bool:

@@ -1,0 +1,15 @@
+"""BAD: supervision-loop waits on the wall clock — bare time.sleep
+(dotted and alias-imported) makes backoff/drain schedules untestable
+and un-drivable under ManualClock."""
+
+import time
+from time import sleep as zzz
+
+
+def respawn_wait(delay):
+    time.sleep(delay)               # the supervision-loop bug
+
+
+def drain_poll(ready, poll_s):
+    while not ready():
+        zzz(poll_s)                 # aliased import does not dodge it

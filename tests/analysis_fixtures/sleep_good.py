@@ -1,0 +1,14 @@
+"""GOOD: supervision waits ride the injectable Clock or an Event
+timeout — deterministic under ManualClock; an unrelated object's
+``sleep`` method is not time.sleep."""
+
+import threading
+
+
+def respawn_wait(clock, delay):
+    clock.sleep(delay)              # the injectable way
+
+
+def loop(stop: threading.Event, interval):
+    while not stop.wait(interval):  # Event.wait doubles as the sleep
+        pass

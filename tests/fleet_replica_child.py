@@ -1,0 +1,105 @@
+"""Stdlib-only echo replica for the fleet supervisor chaos suite.
+
+A real child PROCESS the supervisor can kill -9 and respawn, serving
+the engine-server surface the fleet tier talks to — /queries.json
+(echoes tag + pid so tests see WHICH incarnation answered), /healthz,
+/readyz with the POST /drain latch (the supervisor's
+drain-before-SIGTERM step), and a minimal Prometheus /metrics — with
+HTTP/1.1 keep-alive + Content-Length framing (the router transport's
+minimal parser requires it). Deliberately free of predictionio_tpu
+imports: a replica must boot in ~100ms so respawn windows in the chaos
+test stay tight; the REAL engine server's /drain contract is pinned
+separately in tests/test_fleet_supervisor.py.
+
+Usage: python tests/fleet_replica_child.py --port N --tag r0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _State:
+    def __init__(self, tag: str):
+        self.tag = tag
+        self.draining = False
+        self.requests = 0
+        self.lock = threading.Lock()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: _State
+
+    def _respond(self, status: int, payload: bytes,
+                 ctype: str = "application/json; charset=UTF-8") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self) -> None:  # noqa: N802
+        s = self.state
+        if self.path == "/healthz":
+            self._respond(200, b'{"status": "ok"}')
+        elif self.path == "/readyz":
+            with s.lock:
+                draining = s.draining
+            if draining:
+                self._respond(503, b'{"status": "draining"}')
+            else:
+                self._respond(200, b'{"status": "ready"}')
+        elif self.path == "/metrics":
+            with s.lock:
+                n = s.requests
+            text = ("# HELP pio_child_requests_total queries served\n"
+                    "# TYPE pio_child_requests_total counter\n"
+                    f"pio_child_requests_total {n}\n")
+            self._respond(200, text.encode(),
+                          "text/plain; version=0.0.4; charset=utf-8")
+        else:
+            self._respond(404, b'{"message": "not found"}')
+
+    def do_POST(self) -> None:  # noqa: N802
+        s = self.state
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        if self.path == "/queries.json":
+            with s.lock:
+                s.requests += 1
+            try:
+                echo = json.loads(body) if body else None
+            except json.JSONDecodeError:
+                self._respond(400, b'{"message": "bad json"}')
+                return
+            self._respond(200, json.dumps(
+                {"tag": s.tag, "pid": os.getpid(), "echo": echo}).encode())
+        elif self.path == "/drain":
+            with s.lock:
+                s.draining = True
+            self._respond(200, b'{"status": "draining"}')
+        else:
+            self._respond(404, b'{"message": "not found"}')
+
+    def log_message(self, *args) -> None:
+        pass
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--tag", default="replica")
+    args = parser.parse_args()
+    state = _State(args.tag)
+    handler = type("BoundHandler", (_Handler,), {"state": state})
+    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
+    httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

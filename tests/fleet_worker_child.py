@@ -1,0 +1,50 @@
+"""A real router-worker child PROCESS for the supervisor chaos suite:
+one full RouterServer on the shared SO_REUSEPORT port with spool
+peering, launched as a subprocess (not a fork) so the supervisor can
+kill -9 it and respawn a clean incarnation — exactly the `pio router
+--supervise --workers N` sibling lifecycle.
+
+Usage: python tests/fleet_worker_child.py --port N --spool DIR \
+           --backend 127.0.0.1:8200 [--backend ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# launched as `python tests/fleet_worker_child.py`: sys.path[0] is
+# tests/, so the in-repo package needs the repo root added explicitly
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--spool", required=True)
+    parser.add_argument("--backend", action="append", required=True)
+    parser.add_argument("--probe-interval-s", type=float, default=0.25)
+    parser.add_argument("--admin-sync-interval-s", type=float, default=0.1)
+    args = parser.parse_args()
+
+    from predictionio_tpu.api.router_server import RouterServer
+    from predictionio_tpu.fleet.router import RouterConfig
+
+    server = RouterServer(RouterConfig(
+        ip="127.0.0.1", port=args.port,
+        backends=tuple(args.backend),
+        reuse_port=True,
+        worker_spool_dir=args.spool,
+        probe_interval_s=args.probe_interval_s,
+        admin_sync_interval_s=args.admin_sync_interval_s,
+    ))
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

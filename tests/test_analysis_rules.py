@@ -156,6 +156,26 @@ class TestUntimedBlockingIORule:
         # spellings of BOTH urlopen and create_connection
         assert run_rule("untimed-blocking-io", "io_good.py") == []
 
+    SLEEP_OPTS = {"banned_sleep_paths": [""]}
+
+    def test_banned_sleep_fixture_fires(self):
+        findings = run_rule("untimed-blocking-io", "sleep_bad.py",
+                            options=self.SLEEP_OPTS)
+        assert len(findings) == 2       # dotted AND aliased spellings
+        assert all("bare time.sleep" in f.message for f in findings)
+        assert all("ManualClock" in f.message for f in findings)
+
+    def test_banned_sleep_good_fixture_clean(self):
+        # clock.sleep and Event.wait are the sanctioned waits
+        assert run_rule("untimed-blocking-io", "sleep_good.py",
+                        options=self.SLEEP_OPTS) == []
+
+    def test_banned_sleep_is_path_scoped(self):
+        # outside the configured paths the ban does not apply
+        assert run_rule("untimed-blocking-io", "sleep_bad.py",
+                        options={"banned_sleep_paths":
+                                 ["somewhere-else/"]}) == []
+
 
 class TestLockDisciplineRule:
     def test_bad_fixture_fires(self):

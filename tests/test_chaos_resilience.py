@@ -477,7 +477,9 @@ class TestServingDegradation:
             with pytest.raises(urllib.error.HTTPError) as e:
                 urllib.request.urlopen(f"{base}/reload", timeout=10)
             assert e.value.code == 503
-            assert e.value.headers.get("Retry-After") == "2"
+            # the backend's 2s hint, ±25% seeded jitter (PR 9: constant
+            # hints re-synchronize a fleet of retrying clients)
+            assert 1.5 <= float(e.value.headers.get("Retry-After")) <= 2.5
             assert "still serving" in json.loads(e.value.read())["message"]
 
             # the old model keeps serving
@@ -562,7 +564,8 @@ class TestServingDegradation:
             result = service.handle("POST", "/queries.json", {}, {}, {"x": 1})
             assert result[0] == 503
             assert "deadline" in result[1]["message"]
-            assert result[2]["Retry-After"] == "1"
+            # 1s hint ±25% jitter (PR 9)
+            assert 0.74 <= float(result[2]["Retry-After"]) <= 1.26
 
             # a client header may only tighten, and bad values are 400
             for bad in ("not-a-number", "nan", "inf", "0", "-100"):

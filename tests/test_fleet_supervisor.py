@@ -1,0 +1,1132 @@
+"""Self-healing fleet suite (docs/fleet.md "Supervision" /
+"Autoscaling"): the process supervisor, the scale controller, and the
+shared admin state across ``--workers`` siblings.
+
+The acceptance scenarios:
+
+- under live load through the router, ``kill -9`` one replica AND one
+  worker sibling → the supervisor restores both within a bounded
+  window, with ZERO 5xx from the replica death (the PR 6 guarantee
+  preserved) and the restored worker folded back into the merged
+  ``/metrics``;
+- a crash-looping replica spec reaches the give-up latch WITHOUT
+  hot-spinning (spawn count == threshold exactly), visible as
+  ``pio_fleet_crash_loop 1``;
+- scale controller e2e on ``ManualClock``: sustained pressure adds a
+  replica that joins membership and serves traffic; sustained idle
+  removes one only after the cooldown and DRAINS it via ``/readyz``
+  before SIGTERM; dry-run changes nothing but exports
+  ``pio_fleet_desired_replicas`` and decision counters;
+- canary ``set_weight`` through one worker is observed by every
+  sibling and survives a worker respawn (the admin spool).
+
+Plus the satellite pins: the supervisor backoff schedule follows
+RetryPolicy's full-jitter semantics on ``ManualClock``, drain-before-
+kill ordering, the controller decision table (pressure/burn →
+verdicts, cooldown and clamp edges), the membership probe-starvation
+guard, jittered ``Retry-After`` hints, and the engine server's
+``POST /drain`` latch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from predictionio_tpu.api.router_server import RouterServer
+from predictionio_tpu.api.http_base import retry_after_header
+from predictionio_tpu.fleet.canary import CanaryController
+from predictionio_tpu.fleet.controller import (
+    ScaleController,
+    ScalePolicy,
+    ScaleSignals,
+    SupervisedFleetActuator,
+    controller_collector,
+    fleet_signals_reader,
+)
+from predictionio_tpu.fleet.membership import (
+    Backend,
+    BackendSpec,
+    FleetMembership,
+)
+from predictionio_tpu.fleet.router import RouterConfig
+from predictionio_tpu.fleet.stats import RouterStats, router_collector
+from predictionio_tpu.fleet.supervisor import (
+    WORKER,
+    FleetSupervisor,
+    SpawnSpec,
+    SupervisorConfig,
+    supervisor_collector,
+)
+from predictionio_tpu.fleet.transport import UpstreamResponse
+from predictionio_tpu.obs.exporter import render_metrics
+from predictionio_tpu.utils.resilience import ManualClock
+from predictionio_tpu.workflow.deploy import ServerConfig
+
+from tests.test_fleet_router import (
+    EchoDeployed,
+    echo_server,
+    get_json,
+    post_query,
+    router_for,
+)
+from tests.test_observability import parse_prometheus
+
+pytestmark = pytest.mark.fleet
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPLICA_CHILD = os.path.join(HERE, "fleet_replica_child.py")
+WORKER_CHILD = os.path.join(HERE, "fleet_worker_child.py")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_until(pred, timeout: float = 15.0, interval: float = 0.05,
+               message: str = "condition"):
+    deadline = time.time() + timeout
+    last: Exception | None = None
+    while time.time() < deadline:
+        try:
+            if pred():
+                return
+        except Exception as exc:  # noqa: BLE001 — condition not ready yet
+            last = exc
+        time.sleep(interval)
+    pytest.fail(f"timed out waiting for {message}"
+                + (f" (last error: {last})" if last else ""))
+
+
+def replica_spec(port: int, tag: str) -> SpawnSpec:
+    return SpawnSpec(
+        id=f"replica:{port}",
+        spawn=lambda: subprocess.Popen(
+            [sys.executable, REPLICA_CHILD,
+             "--port", str(port), "--tag", tag]),
+        address=f"127.0.0.1:{port}")
+
+
+def direct_post(port: int, payload: dict, timeout: float = 5.0) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class FakeProcess:
+    """Popen-shaped handle for deterministic supervisor units."""
+
+    _pids = iter(range(40000, 50000))
+
+    def __init__(self, stubborn: bool = False):
+        self.pid = next(self._pids)
+        self._code: int | None = None
+        #: a stubborn child ignores SIGTERM (dies only on SIGKILL) —
+        #: the kill-fallback path
+        self.stubborn = stubborn
+        self.calls: list[str] = []
+
+    def poll(self):
+        return self._code
+
+    def die(self, code: int = 1) -> None:
+        self._code = code
+
+    def terminate(self) -> None:
+        self.calls.append("terminate")
+        if not self.stubborn:
+            self._code = -15
+
+    def kill(self) -> None:
+        self.calls.append("kill")
+        self._code = -9
+
+    def wait(self, timeout=None):
+        return self._code
+
+
+# ---------------------------------------------------------------------------
+# supervisor determinism on ManualClock (the satellite pin)
+# ---------------------------------------------------------------------------
+
+class TestSupervisorBackoffSchedule:
+    def test_backoff_follows_retry_policy_full_jitter(self):
+        """The respawn schedule IS RetryPolicy's: same seed, same
+        draws, same delays — and a child is never respawned before its
+        jittered delay elapses."""
+        clock = ManualClock()
+        cfg = SupervisorConfig(
+            unhealthy_after=0, backoff_base_s=0.5, backoff_max_s=30.0,
+            backoff_multiplier=2.0, crash_loop_threshold=10,
+            crash_loop_window_s=1000.0)
+        procs: list[FakeProcess] = []
+
+        def spawn():
+            p = FakeProcess()
+            procs.append(p)
+            return p
+
+        sup = FleetSupervisor([SpawnSpec(id="r", spawn=spawn)], cfg,
+                              clock=clock, rng=random.Random(7))
+        sup.start(loop=False)
+        assert len(procs) == 1
+        expected_rng = random.Random(7)
+        policy = cfg.backoff_policy()
+        for i in range(4):
+            procs[-1].die(1)
+            sup.poll_once()                     # death -> backoff
+            delay = policy.backoff(i, expected_rng)
+            assert delay <= 30.0
+            clock.advance(delay * 0.9)
+            sup.poll_once()                     # not due yet
+            assert len(procs) == i + 1, "respawned before its backoff"
+            clock.advance(delay * 0.1 + 1e-9)
+            sup.poll_once()                     # due now
+            assert len(procs) == i + 2
+        assert sup.snapshot()["respawns"] == 4
+
+    def test_stability_resets_the_backoff_index(self):
+        """A child that ran stably past the crash-loop window restarts
+        from the BASE delay, not from wherever its death history left
+        off (deaths age out of the window)."""
+        clock = ManualClock()
+        cfg = SupervisorConfig(
+            unhealthy_after=0, backoff_base_s=1.0, backoff_max_s=64.0,
+            backoff_multiplier=2.0, crash_loop_threshold=5,
+            crash_loop_window_s=60.0)
+        procs: list[FakeProcess] = []
+
+        def spawn():
+            p = FakeProcess()
+            procs.append(p)
+            return p
+
+        sup = FleetSupervisor([SpawnSpec(id="r", spawn=spawn)], cfg,
+                              clock=clock, rng=random.Random(11))
+        sup.start(loop=False)
+        for _ in range(3):                      # three quick deaths
+            procs[-1].die(1)
+            sup.poll_once()
+            clock.advance(70.0)                 # past the window
+            sup.poll_once()
+        # all deaths aged out: the next death is index 0 again, whose
+        # delay is bounded by the base cap (uniform(0, base))
+        procs[-1].die(1)
+        sup.poll_once()
+        clock.advance(cfg.backoff_base_s + 1e-9)
+        sup.poll_once()
+        assert len(procs) == 5                  # respawned within base cap
+
+
+class TestCrashLoopLatch:
+    def test_latch_after_threshold_deaths_without_hot_spin(self):
+        clock = ManualClock()
+        cfg = SupervisorConfig(
+            unhealthy_after=0, crash_loop_threshold=3,
+            crash_loop_window_s=60.0, backoff_base_s=0.5,
+            backoff_max_s=8.0)
+        spawns: list[FakeProcess] = []
+
+        def spawn():
+            p = FakeProcess()
+            p.die(13)                           # exits immediately
+            spawns.append(p)
+            return p
+
+        sup = FleetSupervisor([SpawnSpec(id="bad", spawn=spawn)], cfg,
+                              clock=clock, rng=random.Random(3))
+        sup.start(loop=False)
+        for _ in range(20):
+            sup.poll_once()
+            clock.advance(10.0)
+        assert sup.crash_looped()
+        # give-up means EXACTLY threshold spawn attempts, then silence
+        assert len(spawns) == 3
+        assert "give_up" in sup.child_events("bad")
+        text = render_metrics(supervisor_collector(sup)())
+        assert "pio_fleet_crash_loop 1" in text
+        assert 'pio_fleet_child_up{child="bad",role="replica"} 0' in text
+        for _ in range(5):                      # latched: stays quiet
+            sup.poll_once()
+            clock.advance(100.0)
+        assert len(spawns) == 3
+
+    def test_scale_up_refused_while_a_replica_is_crash_looped(self):
+        """A latched child means the replica SPEC is broken — the
+        actuator must refuse to spawn more of it, or the min-replica
+        clamp would demand a fresh identically-broken spawn every
+        cooldown forever (children and DOWN backends leaking)."""
+        clock = ManualClock()
+
+        def spawn():
+            p = FakeProcess()
+            p.die(1)
+            return p
+
+        sup = FleetSupervisor(
+            [SpawnSpec(id="bad", spawn=spawn,
+                       address="127.0.0.1:1")],
+            SupervisorConfig(unhealthy_after=0, crash_loop_threshold=2,
+                             crash_loop_window_s=60.0),
+            clock=clock, rng=random.Random(5))
+        sup.start(loop=False)
+        for _ in range(6):
+            sup.poll_once()
+            clock.advance(5.0)
+        assert sup.crash_looped()
+        membership = FleetMembership([])
+        actuator = SupervisedFleetActuator(
+            sup, membership, make_spec=lambda i=None: replica_spec(
+                free_port(), "never-spawned"))
+        actuator.adopt("bad")
+        assert actuator.current() == 0       # latched != capacity
+        assert actuator.add_replica() is False
+        assert sup.snapshot()["children"], "latched child retained"
+        assert membership.backends == []     # nothing joined
+
+    def test_give_up_hook_fires_once(self):
+        clock = ManualClock()
+        gave_up: list[str] = []
+
+        def spawn():
+            p = FakeProcess()
+            p.die(1)
+            return p
+
+        sup = FleetSupervisor(
+            [SpawnSpec(id="bad", spawn=spawn)],
+            SupervisorConfig(unhealthy_after=0, crash_loop_threshold=2,
+                             crash_loop_window_s=60.0),
+            clock=clock, rng=random.Random(5),
+            on_give_up=lambda spec: gave_up.append(spec.id))
+        sup.start(loop=False)
+        for _ in range(10):
+            sup.poll_once()
+            clock.advance(5.0)
+        assert gave_up == ["bad"]
+
+
+class _DrainRecorder:
+    """Mini replica surface recording the drain conversation order."""
+
+    def __init__(self):
+        self.log: list[str] = []
+        self.drained = False
+        recorder = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _respond(self, status, payload: bytes):
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(length)
+                if self.path == "/drain":
+                    recorder.log.append("drain")
+                    recorder.drained = True
+                    self._respond(200, b'{"status": "draining"}')
+                else:
+                    self._respond(404, b"{}")
+
+            def do_GET(self):
+                if self.path == "/readyz":
+                    recorder.log.append("readyz")
+                    if recorder.drained:
+                        self._respond(503, b'{"status": "draining"}')
+                    else:
+                        self._respond(200, b'{"status": "ready"}')
+                else:
+                    self._respond(200, b'{"status": "ok"}')
+
+            def log_message(self, *args):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestDrainBeforeKillOrdering:
+    def _supervisor(self, spec, **cfg_overrides):
+        cfg = SupervisorConfig(
+            unhealthy_after=0, drain_poll_s=0.05, drain_settle_s=0.5,
+            drain_timeout_s=2.0, term_grace_s=1.0, **cfg_overrides)
+        return FleetSupervisor([spec], cfg, clock=ManualClock())
+
+    def test_replica_removal_drains_then_terminates(self):
+        recorder = _DrainRecorder()
+        proc = FakeProcess()
+        sup = self._supervisor(SpawnSpec(
+            id="r", spawn=lambda: proc,
+            address=f"127.0.0.1:{recorder.port}"))
+        try:
+            sup.start(loop=False)
+            assert sup.remove("r") is True
+            events = sup.child_events("r")
+            assert events == ["spawn", "drain", "terminate"]
+            # the replica heard the drain BEFORE any readiness poll,
+            # and the process only got SIGTERM after both
+            assert recorder.log[0] == "drain"
+            assert "readyz" in recorder.log
+            assert proc.calls == ["terminate"]
+            assert proc.poll() is not None
+        finally:
+            recorder.close()
+
+    def test_stubborn_child_gets_sigkill_after_grace(self):
+        recorder = _DrainRecorder()
+        proc = FakeProcess(stubborn=True)
+        sup = self._supervisor(SpawnSpec(
+            id="r", spawn=lambda: proc,
+            address=f"127.0.0.1:{recorder.port}"))
+        try:
+            sup.start(loop=False)
+            sup.remove("r")
+            assert sup.child_events("r") == \
+                ["spawn", "drain", "terminate", "kill"]
+            assert proc.calls == ["terminate", "kill"]
+        finally:
+            recorder.close()
+
+    def test_worker_removal_skips_the_drain(self):
+        # workers share the public SO_REUSEPORT port: there is nothing
+        # addressable to drain, SIGTERM is the whole protocol
+        proc = FakeProcess()
+        sup = self._supervisor(SpawnSpec(id="w", spawn=lambda: proc,
+                                         role=WORKER))
+        sup.start(loop=False)
+        sup.remove("w")
+        assert sup.child_events("w") == ["spawn", "terminate"]
+
+
+# ---------------------------------------------------------------------------
+# the controller decision table (ManualClock; the satellite pin)
+# ---------------------------------------------------------------------------
+
+class RecordingActuator:
+    def __init__(self, current: int = 2):
+        self.n = current
+        self.calls: list[str] = []
+
+    def current(self) -> int:
+        return self.n
+
+    def add_replica(self) -> bool:
+        self.calls.append("up")
+        self.n += 1
+        return True
+
+    def remove_replica(self) -> bool:
+        self.calls.append("down")
+        self.n -= 1
+        return True
+
+
+def make_controller(clock, actuator, signals, **policy_overrides):
+    defaults = dict(min_replicas=1, max_replicas=3, pressure_up=0.5,
+                    burn_up=14.4, pressure_down=0.1, up_sustain_s=10.0,
+                    down_sustain_s=30.0, cooldown_s=20.0, interval_s=1.0,
+                    dry_run=False)
+    defaults.update(policy_overrides)
+    return ScaleController(ScalePolicy(**defaults),
+                           lambda: signals["v"], actuator, clock=clock)
+
+
+class TestScaleControllerDecisionTable:
+    def test_pressure_must_sustain_before_scale_up(self):
+        clock = ManualClock()
+        act = RecordingActuator(2)
+        signals = {"v": ScaleSignals(pressure=0.9)}
+        ctrl = make_controller(clock, act, signals)
+        assert ctrl.tick() == "hold"            # hot, not sustained
+        clock.advance(5.0)
+        signals["v"] = ScaleSignals(pressure=0.3)   # neutral resets
+        assert ctrl.tick() == "hold"
+        clock.advance(20.0)
+        signals["v"] = ScaleSignals(pressure=0.9)
+        assert ctrl.tick() == "hold"            # sustain restarts
+        clock.advance(10.0)
+        assert ctrl.tick() == "up"
+        assert act.calls == ["up"] and act.n == 3
+
+    def test_fast_burn_triggers_scale_up_even_at_low_pressure(self):
+        clock = ManualClock()
+        act = RecordingActuator(1)
+        signals = {"v": ScaleSignals(pressure=0.05, fast_burn=20.0)}
+        ctrl = make_controller(clock, act, signals)
+        assert ctrl.tick() == "hold"
+        clock.advance(10.0)
+        assert ctrl.tick() == "up"
+
+    def test_cooldown_blocks_back_to_back_actions(self):
+        clock = ManualClock()
+        act = RecordingActuator(1)
+        signals = {"v": ScaleSignals(pressure=0.9)}
+        ctrl = make_controller(clock, act, signals, cooldown_s=25.0)
+        ctrl.tick()
+        clock.advance(10.0)
+        assert ctrl.tick() == "up"              # first verdict (t=10)
+        clock.advance(10.0)                     # hot again...
+        assert ctrl.tick() == "hold"            # ...but sustain restarted
+        clock.advance(10.0)                     # sustained again (t=30),
+        assert ctrl.tick() == "cooldown_hold"   # 20s since action < 25s
+        clock.advance(5.0)                      # cooldown served (t=35)
+        assert ctrl.tick() == "up"
+        assert act.n == 3
+
+    def test_scale_down_needs_sustained_quiet_and_clamps_at_min(self):
+        clock = ManualClock()
+        act = RecordingActuator(2)
+        signals = {"v": ScaleSignals(pressure=0.02)}
+        ctrl = make_controller(clock, act, signals, cooldown_s=0.0)
+        assert ctrl.tick() == "hold"            # quiet, not sustained
+        clock.advance(30.0)
+        assert ctrl.tick() == "down"
+        assert act.n == 1
+        clock.advance(0.1)
+        assert ctrl.tick() == "hold"            # sustain restarted
+        clock.advance(30.0)
+        assert ctrl.tick() == "hold"            # clamped at min_replicas
+        assert act.n == 1
+
+    def test_burn_above_one_vetoes_scale_down(self):
+        clock = ManualClock()
+        act = RecordingActuator(2)
+        signals = {"v": ScaleSignals(pressure=0.02, slow_burn=2.0)}
+        ctrl = make_controller(clock, act, signals, cooldown_s=0.0)
+        for _ in range(5):
+            assert ctrl.tick() == "hold"        # quiet pressure, hot budget
+            clock.advance(30.0)
+        assert act.calls == []
+
+    def test_clamps_at_max_replicas(self):
+        clock = ManualClock()
+        act = RecordingActuator(3)
+        signals = {"v": ScaleSignals(pressure=0.9)}
+        ctrl = make_controller(clock, act, signals, max_replicas=3,
+                               cooldown_s=0.0)
+        ctrl.tick()
+        clock.advance(10.0)
+        assert ctrl.tick() == "hold"            # desired clamps to current
+        assert act.calls == []
+
+    def test_unreadable_signals_hold_and_count(self):
+        clock = ManualClock()
+        act = RecordingActuator(2)
+
+        def explode():
+            raise ConnectionRefusedError("scrape down")
+
+        ctrl = ScaleController(ScalePolicy(dry_run=False), explode, act,
+                               clock=clock)
+        assert ctrl.tick() == "error"
+        assert ctrl.snapshot()["decisions"]["error"] == 1
+        assert act.calls == []
+
+    def test_dry_run_exports_but_never_actuates(self):
+        clock = ManualClock()
+        act = RecordingActuator(1)
+        signals = {"v": ScaleSignals(pressure=0.9)}
+        ctrl = make_controller(clock, act, signals, dry_run=True,
+                               cooldown_s=0.0)
+        ctrl.tick()
+        clock.advance(10.0)
+        assert ctrl.tick() == "up"
+        assert act.calls == []                  # nothing actuated
+        snap = ctrl.snapshot()
+        assert snap["desiredReplicas"] == 2
+        assert snap["actualReplicas"] == 1
+        text = render_metrics(controller_collector(ctrl)())
+        assert "pio_fleet_desired_replicas 2" in text
+        assert "pio_fleet_actual_replicas 1" in text
+        assert "pio_fleet_scale_dry_run 1" in text
+        assert 'pio_fleet_scale_decisions_total{decision="up"} 1' in text
+
+
+class TestFleetSignalsReader:
+    def test_reader_parses_the_routers_own_fleet_metrics(self):
+        server = echo_server("s0", batching=True, batch_max=4,
+                             batch_wait_ms=1.0)
+        router = router_for([server.port])
+        try:
+            for i in range(4):
+                assert post_query(router.port, {"i": i})[0] == 200
+            signals = fleet_signals_reader(router.service)()
+            assert signals.pressure is None or 0.0 <= signals.pressure <= 1.0
+            assert signals.fast_burn >= 0.0
+            assert signals.slow_burn >= 0.0
+        finally:
+            router.stop()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# probe-starvation guard (the satellite pin)
+# ---------------------------------------------------------------------------
+
+class _StubTransport:
+    def __init__(self):
+        self.mode = "timeout"
+
+    def request(self, method, path, headers=None, body=None, *, timeout):
+        if self.mode == "timeout":
+            raise socket.timeout("probe starved under load")
+        if self.mode == "refused":
+            raise ConnectionRefusedError("nothing listening")
+        return UpstreamResponse(200, b"{}", {})
+
+    def close(self):
+        pass
+
+
+class TestProbeStarvationGuard:
+    def _fixture(self):
+        clock = ManualClock()
+        backend = Backend(BackendSpec.parse("127.0.0.1:9", "stable"),
+                          clock=clock)
+        backend.transport = _StubTransport()
+        membership = FleetMembership([backend], down_after=2,
+                                     starvation_grace_s=10.0)
+        return clock, backend, membership
+
+    def test_timeout_with_healthy_data_path_never_marks_down(self):
+        clock, backend, membership = self._fixture()
+        backend.record_data_ok()
+        for _ in range(6):
+            membership._probe_and_record(backend)
+        assert backend.state == "up"
+        assert backend.probe_starved == 6
+        # the counter reaches /metrics with backend labels
+        metrics = router_collector(RouterStats(), membership,
+                                   CanaryController())()
+        starved = next(m for m in metrics
+                       if m.name == "pio_router_probe_starved_total")
+        assert starved.samples == [
+            ({"backend": "127.0.0.1:9", "group": "stable"}, 6.0)]
+
+    def test_guard_expires_with_the_data_path_proof(self):
+        clock, backend, membership = self._fixture()
+        backend.record_data_ok()
+        clock.advance(11.0)                     # proof aged out
+        membership._probe_and_record(backend)
+        membership._probe_and_record(backend)
+        assert backend.state == "down"          # down_after=2
+        assert backend.probe_starved == 0
+
+    def test_guard_requires_closed_breaker(self):
+        clock, backend, membership = self._fixture()
+        backend.record_data_ok()
+        for _ in range(3):                      # default threshold=3
+            backend.resilience.breaker.record_failure()
+        assert backend.resilience.breaker.state == "open"
+        membership._probe_and_record(backend)
+        membership._probe_and_record(backend)
+        assert backend.state == "down"
+
+    def test_hard_failures_are_never_starvation(self):
+        clock, backend, membership = self._fixture()
+        backend.record_data_ok()
+        backend.transport.mode = "refused"
+        membership._probe_and_record(backend)
+        membership._probe_and_record(backend)
+        assert backend.state == "down"
+        assert backend.probe_starved == 0
+
+
+# ---------------------------------------------------------------------------
+# jittered Retry-After + the engine drain latch (satellite pins)
+# ---------------------------------------------------------------------------
+
+class TestJitteredRetryAfter:
+    def test_hints_jitter_within_25_pct_and_decorrelate(self):
+        values = [float(retry_after_header(1.0)) for _ in range(50)]
+        assert all(0.74 <= v <= 1.26 for v in values)
+        assert len(set(values)) > 5             # not a constant
+
+    def test_seeded_rng_is_reproducible_and_scales_with_the_hint(self):
+        a = retry_after_header(4.0, random.Random(5))
+        b = retry_after_header(4.0, random.Random(5))
+        assert a == b
+        assert 3.0 <= float(a) <= 5.0
+
+    def test_router_shed_hint_is_jittered(self):
+        slow = echo_server("slow", delay_s=0.4)
+        router = router_for([slow.port], max_inflight=1)
+        try:
+            hints = []
+            lock = threading.Lock()
+
+            def client(i):
+                status, _, headers = post_query(router.port, {"i": i})
+                if status == 503:
+                    with lock:
+                        hints.append(headers.get("retry-after"))
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=20)
+            assert hints, "nothing shed"
+            assert all(0.74 <= float(h) <= 1.26 for h in hints)
+        finally:
+            router.stop()
+            slow.stop()
+
+
+class TestEngineDrainEndpoint:
+    def test_drain_flips_readyz_until_undrain(self):
+        from predictionio_tpu.api.engine_server import EngineService
+
+        service = EngineService(EchoDeployed("d0"), config=ServerConfig())
+        assert service.readyz()[0] == 200
+        status, doc = service.handle("POST", "/drain", {}, {}, None)[:2]
+        assert (status, doc["status"]) == (200, "draining")
+        status, doc, headers = service.readyz()
+        assert (status, doc["status"]) == (503, "draining")
+        assert 0.74 <= float(headers["Retry-After"]) <= 1.26
+        status, doc = service.handle(
+            "POST", "/drain", {}, {}, {"action": "undrain"})[:2]
+        assert (status, doc["status"]) == (200, "ready")
+        assert service.readyz()[0] == 200
+
+    def test_drain_requires_the_server_key(self):
+        from predictionio_tpu.api.engine_server import EngineService
+
+        service = EngineService(EchoDeployed("d1"),
+                                config=ServerConfig(server_key="sek"))
+        assert service.handle("POST", "/drain", {}, {}, None)[0] == 401
+        assert service.handle("POST", "/drain",
+                              {"accessKey": "sek"}, {}, None)[0] == 200
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptance: kill -9 a replica AND a worker sibling
+# ---------------------------------------------------------------------------
+
+class TestChaosSelfHealing:
+    def test_kill9_replica_and_worker_sibling_both_restored_zero_5xx(self):
+        p1, p2 = free_port(), free_port()
+        spool = tempfile.mkdtemp(prefix="pio-test-sup-")
+        parent = RouterServer(RouterConfig(
+            ip="127.0.0.1", port=0,
+            backends=(f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"),
+            reuse_port=True, worker_spool_dir=spool,
+            probe_interval_s=0.25, admin_sync_interval_s=0.2))
+
+        def worker_spawn():
+            return subprocess.Popen(
+                [sys.executable, WORKER_CHILD,
+                 "--port", str(parent.port), "--spool", spool,
+                 "--backend", f"127.0.0.1:{p1}",
+                 "--backend", f"127.0.0.1:{p2}"])
+
+        sup = FleetSupervisor(
+            [replica_spec(p1, "r1"), replica_spec(p2, "r2"),
+             SpawnSpec(id="worker:1", spawn=worker_spawn, role=WORKER)],
+            SupervisorConfig(
+                poll_interval_s=0.1, probe_timeout_s=1.0,
+                unhealthy_after=0, backoff_base_s=0.2, backoff_max_s=1.0,
+                crash_loop_threshold=5, crash_loop_window_s=30.0,
+                drain_timeout_s=2.0, drain_settle_s=0.1,
+                term_grace_s=3.0))
+        sup.start()
+        parent.start()
+        try:
+            # gate the load on the fleet being GENUINELY up: backends
+            # start optimistically UP before the children even listen,
+            # so /readyz alone passes during the boot race and the
+            # first second of load would count boot-time 502s against
+            # the replica-death guarantee
+            def fleet_settled():
+                for port in (p1, p2):
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/healthz",
+                            timeout=2) as r:
+                        if r.status != 200:
+                            return False
+                _, doc = get_json(parent.port, "/fleet")
+                return all(b["state"] == "up" for b in doc["backends"])
+            wait_until(fleet_settled, message="fleet settled")
+            wait_until(lambda: sup.child_pid("worker:1") is not None,
+                       message="worker sibling spawned")
+            # the gate above samples ONE router per read, but
+            # SO_REUSEPORT spreads connections across parent AND the
+            # worker sibling — require a streak of successes over
+            # fresh connections so BOTH routers' membership views have
+            # finished their boot race before the counted load starts
+            streak = 0
+            deadline = time.time() + 15.0
+            while streak < 10 and time.time() < deadline:
+                status, _, _ = post_query(parent.port, {"warm": streak})
+                streak = streak + 1 if status == 200 else 0
+            assert streak >= 10, "fleet never settled across workers"
+
+            statuses: list[tuple[int, dict]] = []
+            transport_errors: list[str] = []
+            lock = threading.Lock()
+            stop_load = threading.Event()
+
+            def client(cid: int) -> None:
+                i = 0
+                while not stop_load.is_set():
+                    try:
+                        status, body, _ = post_query(
+                            parent.port, {"cid": cid, "i": i}, timeout=10)
+                        with lock:
+                            statuses.append((status, body))
+                    except OSError as exc:
+                        # a killed WORKER rips its live connections out
+                        # from under clients — a transport error, not a
+                        # served 5xx; the replica-death guarantee is
+                        # about HTTP statuses
+                        with lock:
+                            transport_errors.append(repr(exc))
+                    i += 1
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(4)]
+            for t in threads:
+                t.start()
+
+            time.sleep(0.4)                       # load flowing
+            replica_pid = sup.child_pid(f"replica:{p1}")
+            os.kill(replica_pid, signal.SIGKILL)  # replica death
+            time.sleep(1.5)                       # load over the corpse
+            worker_pid = sup.child_pid("worker:1")
+            os.kill(worker_pid, signal.SIGKILL)   # worker sibling death
+            time.sleep(1.0)
+            stop_load.set()
+            for t in threads:
+                t.join(timeout=20)
+
+            assert len(statuses) > 50
+            fives = [(s, b) for s, b in statuses if s >= 500]
+            assert fives == [], (
+                f"{len(fives)} 5xx of {len(statuses)}: {fives[:5]}")
+
+            # the replica is restored: a NEW pid serving on the SAME
+            # port, marked back up in membership
+            wait_until(
+                lambda: sup.child_pid(f"replica:{p1}") not in
+                (None, replica_pid),
+                message="replica respawned")
+            wait_until(lambda: direct_post(p1, {"ping": 1})["tag"] == "r1",
+                       message="restored replica serving")
+            def replica_up():
+                _, doc = get_json(parent.port, "/fleet")
+                state = {b["id"]: b["state"] for b in doc["backends"]}
+                return state[f"127.0.0.1:{p1}"] == "up"
+            wait_until(replica_up, message="membership marked back up")
+
+            # the worker sibling is restored and folded back into the
+            # merged /metrics (spool reap + re-register)
+            wait_until(
+                lambda: sup.child_pid("worker:1") not in
+                (None, worker_pid),
+                message="worker respawned")
+
+            def merged_workers_back():
+                families = parse_prometheus(parent.service.metrics_text())
+                return families["pio_router_workers"]["samples"][
+                    ("pio_router_workers", ())] == 2.0
+            wait_until(merged_workers_back,
+                       message="restored worker in merged /metrics")
+
+            assert sup.snapshot()["respawns"] >= 2
+            assert not sup.crash_looped()
+        finally:
+            sup.shutdown()
+            parent.stop()
+            import shutil
+            shutil.rmtree(spool, ignore_errors=True)
+
+    def test_crash_looping_spec_latches_live_without_hot_spin(self):
+        """A spec whose child exits immediately reaches the give-up
+        latch (pio_fleet_crash_loop 1) after exactly `threshold` spawn
+        attempts — damped by real backoff, never a spawn storm."""
+        spawn_count = {"n": 0}
+
+        def crashing_spawn():
+            spawn_count["n"] += 1
+            return subprocess.Popen(
+                [sys.executable, "-c", "import sys; sys.exit(3)"])
+
+        sup = FleetSupervisor(
+            [SpawnSpec(id="crash", spawn=crashing_spawn)],
+            SupervisorConfig(
+                poll_interval_s=0.05, unhealthy_after=0,
+                backoff_base_s=0.05, backoff_max_s=0.2,
+                crash_loop_threshold=3, crash_loop_window_s=30.0))
+        sup.start()
+        try:
+            wait_until(sup.crash_looped, timeout=10.0,
+                       message="crash-loop latch")
+            time.sleep(0.3)                     # latched: no more spawns
+            assert spawn_count["n"] == 3
+            text = render_metrics(supervisor_collector(sup)())
+            assert "pio_fleet_crash_loop 1" in text
+            doc = sup.snapshot()
+            assert doc["crashLooped"] is True
+            child = doc["children"][0]
+            assert child["state"] == "crash_looped"
+            assert child["lastExit"] == 3
+        finally:
+            sup.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scale controller e2e: real children, ManualClock decisions
+# ---------------------------------------------------------------------------
+
+class TestScaleControllerE2E:
+    def test_scale_up_serves_then_scale_down_drains_via_readyz(self):
+        clock = ManualClock()
+        ports = [free_port(), free_port()]
+        port_iter = iter(ports)
+
+        def make_spec(_index=None):
+            port = next(port_iter)
+            return replica_spec(port, f"r{port}")
+
+        sup = FleetSupervisor([], SupervisorConfig(
+            unhealthy_after=0, drain_poll_s=0.05, drain_settle_s=0.1,
+            drain_timeout_s=2.0, term_grace_s=5.0), clock=clock)
+        spec1 = make_spec()
+        sup.add(spec1)                           # the baseline replica
+        router = router_for([ports[0]], probe_interval_s=0.2, up_after=1)
+        actuator = SupervisedFleetActuator(
+            sup, router.router.membership, make_spec)
+        actuator.adopt(spec1.id)
+        signals = {"v": ScaleSignals(pressure=0.9)}
+        ctrl = make_controller(clock, actuator, signals, max_replicas=2,
+                               up_sustain_s=10.0, down_sustain_s=30.0,
+                               cooldown_s=0.0)
+        try:
+            wait_until(lambda: get_json(router.port, "/readyz")[0] == 200,
+                       message="baseline replica routable")
+            assert actuator.current() == 1
+
+            # sustained pressure -> a replica is ADDED, joins
+            # membership, and serves traffic
+            assert ctrl.tick() == "hold"
+            clock.advance(10.0)
+            assert ctrl.tick() == "up"
+            assert actuator.current() == 2
+            new_id = f"127.0.0.1:{ports[1]}"
+            assert new_id in [b.id
+                              for b in router.router.membership.backends]
+
+            tags = set()
+
+            def both_tags_served():
+                status, body, _ = post_query(router.port,
+                                             {"q": len(tags)})
+                assert status == 200
+                tags.add(body["tag"])
+                return len(tags) == 2
+            wait_until(both_tags_served,
+                       message="scaled-up replica serving traffic")
+
+            # sustained idle -> removed ONLY after the cooldown, and
+            # drained via /readyz before SIGTERM
+            signals["v"] = ScaleSignals(pressure=0.0)
+            assert ctrl.tick() == "hold"
+            clock.advance(29.0)
+            assert ctrl.tick() == "hold"         # cooldown not served yet
+            clock.advance(1.0)
+            assert ctrl.tick() == "down"
+            events = sup.child_events(f"replica:{ports[1]}")
+            assert "drain" in events and "terminate" in events
+            assert events.index("drain") < events.index("terminate")
+            assert new_id not in [
+                b.id for b in router.router.membership.backends]
+            assert actuator.current() == 1
+            assert ctrl.snapshot()["desiredReplicas"] == 1
+
+            def victim_gone():
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{ports[1]}/healthz", timeout=1)
+                    return False
+                except OSError:
+                    return True
+            wait_until(victim_gone, message="drained replica stopped")
+
+            # the survivor still serves
+            status, body, _ = post_query(router.port, {"after": 1})
+            assert status == 200 and body["tag"] == f"r{ports[0]}"
+        finally:
+            ctrl.stop()
+            sup.shutdown()
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# shared admin state across --workers siblings
+# ---------------------------------------------------------------------------
+
+def admin_post(port: int, payload: dict) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/fleet/canary",
+        data=json.dumps(payload).encode(), method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestSharedAdminState:
+    def _worker_pair(self, backend_ports, canary_ports=(), spool=None,
+                     **cfg):
+        spool = spool or tempfile.mkdtemp(prefix="pio-test-admin-")
+
+        def mk(port):
+            return RouterServer(RouterConfig(
+                ip="127.0.0.1", port=port,
+                backends=tuple(f"127.0.0.1:{p}" for p in backend_ports),
+                canary_backends=tuple(f"127.0.0.1:{p}"
+                                      for p in canary_ports),
+                reuse_port=True, worker_spool_dir=spool,
+                probe_interval_s=0.25, admin_sync_interval_s=0.1,
+                **cfg))
+
+        w1 = mk(0)
+        w2 = mk(w1.port)
+        w1.start()
+        w2.start()
+        return w1, w2, spool
+
+    def test_set_weight_reaches_all_siblings_and_survives_respawn(self):
+        s0 = echo_server("s0")
+        c0 = echo_server("c0")
+        w1, w2, spool = self._worker_pair([s0.port], [c0.port])
+        w3 = None
+        try:
+            status, doc = admin_post(w1.port, {"weight": 25})
+            assert status == 200
+
+            def both_adopted():
+                return all(
+                    w.service.router.canary.weight_pct == 25.0
+                    for w in (w1, w2))
+            wait_until(both_adopted, timeout=5.0,
+                       message="both workers at weight 25")
+
+            # a RESPAWNED worker adopts the shared state at startup
+            # instead of booting with the launch-time weight (0)
+            w3 = RouterServer(RouterConfig(
+                ip="127.0.0.1", port=w1.port,
+                backends=(f"127.0.0.1:{s0.port}",),
+                canary_backends=(f"127.0.0.1:{c0.port}",),
+                reuse_port=True, worker_spool_dir=spool,
+                probe_interval_s=0.25, admin_sync_interval_s=0.1))
+            w3.start()
+            assert w3.service.router.canary.weight_pct == 25.0
+        finally:
+            for w in (w1, w2, w3):
+                if w is not None:
+                    w.stop()
+            s0.stop()
+            c0.stop()
+
+    def test_guardrail_abort_is_published_to_the_spool(self):
+        """The _exchange wiring end-to-end: a guardrail verdict tripped
+        by REAL traffic publishes an abort document for the siblings."""
+        stable = echo_server("s0")
+        bad_canary = echo_server("c0", fail=True)
+        spool = tempfile.mkdtemp(prefix="pio-test-abort-")
+        router = RouterServer(RouterConfig(
+            ip="127.0.0.1", port=0,
+            backends=(f"127.0.0.1:{stable.port}",),
+            canary_backends=(f"127.0.0.1:{bad_canary.port}",),
+            canary_weight_pct=50.0, breaker_threshold=50,
+            guardrail_min_requests=5, guardrail_max_error_rate=0.3,
+            guardrail_window=20,
+            worker_spool_dir=spool, probe_interval_s=0.25,
+            admin_sync_interval_s=0.1))
+        router.start()
+        try:
+            for i in range(60):
+                status, _, _ = post_query(router.port, {"i": i})
+                assert status == 200
+                if router.router.canary.aborted:
+                    break
+            assert router.router.canary.aborted
+            doc = router.service.worker_hub.read_admin()
+            assert doc is not None
+            assert doc["action"] == "abort"
+            assert doc["seq"] >= 1
+            assert "error rate" in doc["reason"]
+        finally:
+            router.stop()
+            stable.stop()
+            bad_canary.stop()
+            import shutil
+            shutil.rmtree(spool, ignore_errors=True)
+
+    def test_abort_latches_every_sibling(self):
+        """Both workers end aborted under a failing canary: whichever
+        worker's guardrail trips first publishes, the other adopts —
+        no sibling keeps routing canary traffic on a stale verdict."""
+        stable = echo_server("s0")
+        bad_canary = echo_server("c0", fail=True)
+        w1, w2, spool = self._worker_pair(
+            [stable.port], [bad_canary.port],
+            canary_weight_pct=50.0, breaker_threshold=50,
+            guardrail_min_requests=5, guardrail_max_error_rate=0.3,
+            guardrail_window=20)
+        try:
+            for i in range(120):
+                status, _, _ = post_query(w1.port, {"i": i})
+                assert status == 200
+                if all(w.service.router.canary.aborted for w in (w1, w2)):
+                    break
+
+            def both_aborted():
+                return all(w.service.router.canary.aborted
+                           for w in (w1, w2))
+            wait_until(both_aborted, timeout=5.0,
+                       message="abort latched on every sibling")
+        finally:
+            w1.stop()
+            w2.stop()
+            stable.stop()
+            bad_canary.stop()
