@@ -750,6 +750,21 @@ def bench_ann_retrieval(shrunk: bool = False):
     return bench_serving.bench_ann_section(shrunk=shrunk)
 
 
+def bench_workers_scaling(shrunk: bool = False):
+    """Prefork serving-pool core scaling (1 vs 2 SO_REUSEPORT workers)
+    — the `pio deploy --workers N` trajectory. Standalone harness:
+    bench_serving.py --workers-only (committed artifacts:
+    BENCH_workers_rNN.json, which also carry the 1M ANN-under-workers
+    re-run — skipped in this section at BOTH sizes: the index build
+    runs minutes). Under --skip-heavy the catalog and round count
+    shrink so the harness contract stays exercised cheaply. The
+    scaling ratio only clears 1 on a multi-core host — the section
+    records host_cores alongside."""
+    import bench_serving
+
+    return bench_serving.bench_workers_section(shrunk=shrunk)
+
+
 def bench_data_plane():
     """Columnar scan vs row iterator + transactional batch ingest — the
     PR 4 data-plane trajectory. Standalone harness: bench_ingest.py
@@ -1197,14 +1212,18 @@ def main() -> None:
         ("data_plane", bench_data_plane),
         ("ann_retrieval",
          lambda: bench_ann_retrieval(shrunk=args.skip_heavy)),
+        ("workers_scaling",
+         lambda: bench_workers_scaling(shrunk=args.skip_heavy)),
     ]
     failed = []
     if args.skip_heavy:
         # skipped sections' keys are absent, which IS an incomplete
         # artifact — the completeness marker must say so. data_plane
         # stays: it is CPU+storage bound like ingest, no device needed;
-        # ann_retrieval runs SHRUNK (one small indexable catalog)
-        keep = ("quality", "ingest", "data_plane", "ann_retrieval")
+        # ann_retrieval runs SHRUNK (one small indexable catalog), and
+        # workers_scaling SHRUNK (small catalog, no 1M ANN re-run)
+        keep = ("quality", "ingest", "data_plane", "ann_retrieval",
+                "workers_scaling")
         failed.extend(s[0] for s in sections if s[0] not in keep)
         sections = [s for s in sections if s[0] in keep]
     for section, fn in sections:

@@ -32,6 +32,19 @@ clients in three configurations:
                    (ops/ann) at 100k and 1M items, equal client count,
                    recall@shortlist and MAP@10 vs brute measured
                    alongside (BENCH_ann_rNN.json).
+- ``workers``    — the prefork serving pool's core-scaling pin
+                   (``pio deploy --workers N``; BENCH_workers_rNN.json):
+                   ONE adaptive engine-server process vs TWO sharing an
+                   SO_REUSEPORT port (spool peering on, the production
+                   shape), same model/config/client count, interleaved
+                   rounds, steady-state means. On a multi-core host the
+                   2-worker pool should clear ~1.6x (linear minus
+                   coordination); the artifact records ``host_cores`` —
+                   on a 1-core container the ratio is capacity-bound at
+                   ~1.0x and measures coordination overhead only. The
+                   ANN 1M HTTP phase re-runs under 2 workers to measure
+                   how much of the device-level 8.7x the multi-process
+                   plane recovers from the GIL floor.
 
 Prints ONE JSON line PER PHASE GROUP in the BENCH contract
 (``{"metric", "value", "unit", ...}``): the serving line (adaptive /
@@ -495,6 +508,277 @@ def _replica_main(argv: list[str]) -> None:
     server.stop()
 
 
+def _serving_worker_main(argv: list[str]) -> None:
+    """One `pio deploy --workers N` sibling for the workers phase: a
+    synthetic adaptive engine server on the SHARED SO_REUSEPORT port
+    with spool peering on — the production worker-pool shape, in its
+    own process so the GIL boundary is real. ``--model-dir`` loads a
+    persisted ALSModel (the ANN-under-workers phase shares ONE built
+    index across siblings through the checkpoint instead of paying
+    k-means per worker) and ``--retrieval ann`` serves through it."""
+    import argparse
+    import sys
+
+    sys.setswitchinterval(0.0005)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--items", type=int, default=DEF_ITEMS)
+    ap.add_argument("--rank", type=int, default=DEF_RANK)
+    ap.add_argument("--batch-max", type=int, required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--spool", default=None)
+    ap.add_argument("--model-dir", default=None)
+    ap.add_argument("--retrieval", default="brute")
+    ap.add_argument("--nprobe", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from predictionio_tpu.api.engine_server import EngineServer
+    from predictionio_tpu.templates import recommendation as rec
+    from predictionio_tpu.workflow.deploy import ServerConfig
+
+    if args.model_dir:
+        from predictionio_tpu.models.als import ALSModel
+
+        model = ALSModel.load(args.model_dir)
+        model.configure_retrieval(args.retrieval, nprobe=args.nprobe)
+        deployed = _deployed_from_model(model)
+    else:
+        deployed = build_deployed(items=args.items, rank=args.rank)
+    warm_batch_signatures(deployed, args.batch_max)
+    deployed.query(rec.Query(user="u0", num=10))     # compile B=1
+    server = EngineServer(deployed, ServerConfig(
+        ip="127.0.0.1", port=args.port, batching=True,
+        batch_policy="adaptive", batch_max=args.batch_max,
+        batch_wait_ms=5.0,
+        reuse_port=True, worker_spool_dir=args.spool,
+        admin_sync_interval_s=0.5))
+    server.start()
+    print(f"PORT {server.port}", flush=True)
+    sys.stdin.readline()                 # parent closes stdin to stop
+    server.stop()
+
+
+def _spawn_worker_pool(n: int, extra_args: list[str]):
+    """(children, shared_port, spool_dir): n serving-worker processes
+    on one SO_REUSEPORT port with a fresh peering spool."""
+    import tempfile
+
+    from predictionio_tpu.cli.pio import resolve_concrete_port
+
+    port = resolve_concrete_port("127.0.0.1", 0)
+    spool = tempfile.mkdtemp(prefix="pio-bench-workers-")
+    children = []
+    try:
+        for _ in range(n):
+            children.append(_spawn("serving-worker", [
+                "--port", str(port), "--spool", spool, *extra_args])[0])
+    except Exception:
+        import shutil
+
+        for proc in children:
+            proc.kill()
+        # callers only clean spools from SUCCESSFUL calls
+        shutil.rmtree(spool, ignore_errors=True)
+        raise
+    return children, port, spool
+
+
+def _stop_children(children) -> None:
+    for proc in children:
+        try:
+            if proc.stdin and not proc.stdin.closed:
+                proc.stdin.close()
+            proc.wait(timeout=15)
+        except Exception:
+            proc.kill()
+
+
+def bench_workers(items: int = DEF_ITEMS, rank: int = DEF_RANK,
+                  clients: int = DEF_CLIENTS,
+                  per_client: int = DEF_PER_CLIENT,
+                  batch_max: int = 32, rounds: int = 6,
+                  procs: int = DEF_CLIENT_PROCS,
+                  ann_items: int | None = 1_000_000,
+                  ann_per_client: int = 16,
+                  ann_rounds: int = 2) -> dict:
+    """The prefork pool's core-scaling phase (module docstring): the
+    SAME synthetic adaptive workload served by 1 process vs 2
+    SO_REUSEPORT siblings, paired order-alternated rounds, steady-state
+    means with the first paired round dropped — the router-overhead
+    measurement discipline. ``ann_items`` additionally re-runs the
+    PR 8 ANN-vs-brute HTTP ratio with both modes under 2 workers (one
+    index built once, shared through a checkpoint; None skips it)."""
+    import os
+
+    worker_args = ["--items", str(items), "--rank", str(rank),
+                   "--batch-max", str(batch_max)]
+    pool = [f"u{i}" for i in range(DEF_POOL)]
+    one_rounds: list[float] = []
+    two_rounds: list[float] = []
+    one_best = two_best = None
+    single: list = []
+    duo: list = []
+    spool = spool1 = None
+    workers_reported = None
+    try:
+        single, single_port, spool1 = _spawn_worker_pool(1, worker_args)
+        duo, duo_port, spool = _spawn_worker_pool(2, worker_args)
+        for i in range(rounds):
+            pair = [(single_port, "1"), (duo_port, "2")]
+            if i % 2:
+                pair.reverse()
+            for port, tag in pair:
+                r = _drive(port, pool, clients, per_client,
+                           rounds=1, procs=procs)
+                if tag == "1":
+                    one_rounds.append(r["qps"])
+                    if one_best is None or r["qps"] > one_best["qps"]:
+                        one_best = r
+                else:
+                    two_rounds.append(r["qps"])
+                    if two_best is None or r["qps"] > two_best["qps"]:
+                        two_best = r
+        # merged-scrape sanity: wherever the connection lands, the
+        # exposition must fold BOTH workers in (the tentpole invariant)
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{duo_port}/metrics", timeout=10) as r:
+            for line in r.read().decode().splitlines():
+                if line.startswith("pio_serving_workers"):
+                    workers_reported = float(line.split()[-1])
+    finally:
+        _stop_children(single + duo)
+        import shutil
+
+        for d in (spool1, spool):
+            if d:
+                shutil.rmtree(d, ignore_errors=True)
+
+    out = {
+        "metric": f"workers_scaling_2w_vs_1w_{clients}c",
+        "value": round(_steady_mean(two_rounds)
+                       / _steady_mean(one_rounds), 2),
+        "unit": "x",
+        "host_cores": os.cpu_count(),
+        "qps_1w": one_best["qps"],
+        "qps_2w": two_best["qps"],
+        "p50_ms_1w": one_best["p50_ms"],
+        "p50_ms_2w": two_best["p50_ms"],
+        "p99_ms_1w": one_best["p99_ms"],
+        "p99_ms_2w": two_best["p99_ms"],
+        "round_qps_1w": one_rounds,
+        "round_qps_2w": two_rounds,
+        "workers_reported_in_merged_metrics": workers_reported,
+        "errors": one_best["errors"] + two_best["errors"],
+        "clients": clients,
+        "items": items,
+        "rank": rank,
+    }
+    if ann_items:
+        out["ann_http_per_workers"] = _bench_workers_ann(
+            ann_items, rank, clients, ann_per_client, batch_max,
+            ann_rounds, procs)
+    return out
+
+
+def _bench_workers_ann(items: int, rank: int, clients: int,
+                       per_client: int, batch_max: int, rounds: int,
+                       procs: int,
+                       worker_counts: tuple = (1, 2)) -> dict:
+    """The ANN satellite: the PR 8 1M-item HTTP phase re-run with BOTH
+    retrieval modes behind 1 AND 2 SO_REUSEPORT workers. The original
+    single-process measurement compressed the device-level ratio to ~5x
+    because one Python process saturated the host; the per-workers
+    sweep isolates what the prefork pool changes ON THE SAME HOST: ANN
+    (host-bound, ~2ms device time per query) gains from a second
+    request-handling process, while brute (device-bound, alive only on
+    batch amortization of its full-table scan) LOSES — two workers
+    fragment the concurrent batch into two half-size dispatches, each
+    paying a full table traversal. The index is built ONCE and shared
+    with every sibling through the persisted checkpoint
+    (ALSModel.save/load — also the --model-mmap page-sharing path)."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from predictionio_tpu.ops import ann as ann_ops
+
+    _, ann_model, item_f, _user_f = _ann_models(
+        items, rank, DEF_ANN_CLUSTERS)
+    t0 = _time.perf_counter()
+    index = ann_ops.build_index(item_f, seed=0)
+    build_s = round(_time.perf_counter() - t0, 1)
+    nprobe = index.clamp_nprobe(0)
+    ann_model.ann_index = index
+    model_dir = tempfile.mkdtemp(prefix="pio-bench-workers-ann-")
+    # the index is already on the model: save persists it as-is (no
+    # second k-means); siblings load the ready checkpoint
+    ann_model.save(model_dir)
+    pool = [f"u{i}" for i in range(DEF_POOL)]
+    base_args = ["--batch-max", str(batch_max), "--model-dir", model_dir]
+    per_workers = []
+    try:
+        for n_workers in worker_counts:
+            results: dict[str, dict] = {}
+            for tag, extra in (("brute", ["--retrieval", "brute"]),
+                               ("ann", ["--retrieval", "ann",
+                                        "--nprobe", str(nprobe)])):
+                children, port, spool = _spawn_worker_pool(
+                    n_workers, base_args + extra)
+                try:
+                    results[tag] = _drive(port, pool, clients,
+                                          per_client, rounds=rounds,
+                                          procs=procs)
+                finally:
+                    _stop_children(children)
+                    shutil.rmtree(spool, ignore_errors=True)
+            brute, ann = results["brute"], results["ann"]
+            per_workers.append({
+                "workers": n_workers,
+                "brute_qps": brute["qps"],
+                "brute_p99_ms": brute["p99_ms"],
+                "ann_qps": ann["qps"],
+                "ann_p99_ms": ann["p99_ms"],
+                "speedup_x": round(ann["qps"] / brute["qps"], 2)
+                if brute["qps"] else None,
+                "p99_ratio_x": round(brute["p99_ms"] / ann["p99_ms"], 2)
+                if ann["p99_ms"] else None,
+                "errors": brute["errors"] + ann["errors"],
+            })
+    finally:
+        shutil.rmtree(model_dir, ignore_errors=True)
+    return {
+        "items": items,
+        "nlist": index.nlist,
+        "served_nprobe": nprobe,
+        "build_s": build_s,
+        "clients": clients,
+        "per_workers": per_workers,
+    }
+
+
+def bench_workers_section(shrunk: bool = False) -> dict:
+    """The ``workers_scaling`` section for bench.py's round artifact:
+    the core-scaling phase only — the 1M ANN-under-workers re-run is
+    the STANDALONE harness's job (``--workers-only``, minutes of index
+    build; committed as BENCH_workers_rNN.json) and is skipped here at
+    both sizes. ``shrunk`` (--skip-heavy) additionally shrinks the
+    catalog and round count."""
+    if shrunk:
+        r = bench_workers(items=16_384, per_client=8, rounds=2,
+                          ann_items=None)
+    else:
+        r = bench_workers(per_client=16, rounds=4, ann_items=None)
+    return {
+        "workers_scaling_2w_vs_1w_x": r["value"],
+        "workers_qps_1w": r["qps_1w"],
+        "workers_qps_2w": r["qps_2w"],
+        "workers_host_cores": r["host_cores"],
+        "workers_reported_in_merged_metrics":
+            r["workers_reported_in_merged_metrics"],
+    }
+
+
 def _router_main(argv: list[str]) -> None:
     """Router worker subprocess (how `pio router` deploys: its own
     process; ``--workers N`` spawns N of these sharing one
@@ -561,7 +845,7 @@ def bench_router(items: int = DEF_ITEMS, rank: int = DEF_RANK,
     the bench_serving client lesson again). Paired order-alternated
     rounds; overhead from STEADY-STATE MEANS with the first paired
     round dropped — the same reasoning as tracing_overhead_pct above."""
-    import socket as _socket
+    from predictionio_tpu.cli.pio import resolve_concrete_port
 
     replica_args = ["--items", str(items), "--rank", str(rank),
                     "--batch-max", str(batch_max)]
@@ -585,10 +869,7 @@ def bench_router(items: int = DEF_ITEMS, rank: int = DEF_RANK,
         # at ~200 qps on this host while the 2-replica fleet clears
         # ~300 — the router tier scales horizontally exactly like the
         # model tier
-        probe = _socket.socket()
-        probe.bind(("127.0.0.1", 0))
-        router_port = probe.getsockname()[1]
-        probe.close()
+        router_port = resolve_concrete_port("127.0.0.1", 0)
         backend_args = [a for port in replica_ports
                         for a in ("--backend", f"127.0.0.1:{port}")]
         for _ in range(2):
@@ -955,6 +1236,11 @@ def main() -> None:
     if "--router" in sys.argv:
         _router_main([a for a in sys.argv[1:] if a != "--router"])
         return
+    if "--serving-worker" in sys.argv:
+        # prefork-pool sibling entry (spawned by bench_workers)
+        _serving_worker_main(
+            [a for a in sys.argv[1:] if a != "--serving-worker"])
+        return
     # 48+ threads at CPython's default 5ms GIL switch interval add
     # multi-ms scheduling jitter per request; tighten it for the
     # serving process (the client processes do the same)
@@ -972,7 +1258,21 @@ def main() -> None:
                         help="run only the ANN catalog-size sweep")
     parser.add_argument("--ann-sizes", type=int, nargs="+", default=None,
                         help="catalog sizes for the ANN sweep")
+    parser.add_argument("--workers-only", action="store_true",
+                        help="run only the prefork-pool core-scaling "
+                             "phase (BENCH_workers_rNN.json)")
+    parser.add_argument("--workers-ann-items", type=int, default=1_000_000,
+                        help="catalog size for the ANN re-run under 2 "
+                             "workers (0 skips it)")
+    parser.add_argument("--workers-rounds", type=int, default=6)
     args = parser.parse_args()
+    if args.workers_only:
+        print(json.dumps(bench_workers(
+            items=args.items, rank=args.rank, clients=args.clients,
+            per_client=args.per_client, batch_max=args.batch_max,
+            rounds=args.workers_rounds, procs=args.client_procs,
+            ann_items=args.workers_ann_items or None)))
+        return
     if args.ann_only:
         print(json.dumps(bench_ann(
             sizes=tuple(args.ann_sizes or DEF_ANN_SIZES), rank=args.rank,

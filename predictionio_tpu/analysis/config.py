@@ -187,8 +187,13 @@ def default_config() -> LintConfig:
             # transport's kw-only `timeout` is policed too (`request`
             # with a large positional index: it can only be passed by
             # keyword, and its absence is the finding)
+            # serving/ added with the prefork worker pool: the engine
+            # side of the worker-coherence machinery
+            # (serving/workers.py) must never grow an untimed fetch or
+            # a bare sleep in its sync loop
             "untimed-blocking-io": RuleConfig(
-                paths=("api/", "storage/", "fleet/", "obs/", "cli/"),
+                paths=("api/", "storage/", "fleet/", "obs/", "cli/",
+                       "serving/"),
                 options={
                     "policed_calls": {
                         "urlopen": 2, "create_connection": 1,
@@ -207,7 +212,8 @@ def default_config() -> LintConfig:
                     # must be clock-injectable, so a bare time.sleep
                     # there is a finding — use clock.sleep or
                     # Event.wait (PR 9; docs/static-analysis.md)
-                    "banned_sleep_paths": ["fleet/"],
+                    "banned_sleep_paths": ["fleet/",
+                                           "serving/workers.py"],
                 },
             ),
             "lock-discipline": RuleConfig(paths=("",)),

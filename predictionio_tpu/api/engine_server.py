@@ -19,6 +19,21 @@ Route and behavior parity with the reference deploy server
 - ``GET /stats.json``    serving hot-path internals (beyond reference):
                          batch-size histogram, adaptive-wait EWMA,
                          cache hit ratio, dedup count, resilience
+- ``POST /retrieval``    runtime retrieval reconfig (brute <-> ann,
+                         nprobe/rescore; key-authenticated)
+
+Prefork worker pool (``pio deploy --workers N``; docs/
+serving-performance.md "Multi-process serving"): N of these servers
+run as separate processes sharing one SO_REUSEPORT listen port. Each
+holds its own model/batcher/cache/registry; a ``/metrics`` or
+``/stats.json`` scrape landing on any worker merges every sibling
+(fleet/workers.WorkerHub + obs/aggregate.merge_sources),
+``/traces.json`` folds sibling rings in, and the admin surfaces
+(``/reload``, ``/drain``, ``POST /retrieval``) publish a sequenced
+admin-state document every sibling's sync loop applies
+(serving/workers.WorkerCoherence) — so a reload bumps the result-cache
+generation on ALL workers, not the 1/N the connection hash happened to
+pick.
 
 Graceful degradation (beyond reference, docs/operations-resilience.md):
 storage-unavailable failures map to ``503`` + ``Retry-After`` instead of
@@ -79,8 +94,14 @@ from predictionio_tpu.core.json_codec import (
     compile_wire_decoder,
     encode_wire,
 )
+from predictionio_tpu.obs.aggregate import (
+    ExpositionParseError,
+    merge_sources,
+    parse_exposition,
+    source_count_metric,
+)
 from predictionio_tpu.obs.exporter import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
-from predictionio_tpu.obs.exporter import render_prometheus
+from predictionio_tpu.obs.exporter import render_metrics, render_prometheus
 from predictionio_tpu.obs.registry import (
     HistogramFamily,
     Metric,
@@ -103,6 +124,7 @@ from predictionio_tpu.obs.trace import (
 )
 from predictionio_tpu.serving.batch_policy import make_batch_policy
 from predictionio_tpu.serving.result_cache import ResultCache
+from predictionio_tpu.serving.workers import WorkerCoherence
 from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.utils.resilience import (
     STORAGE_UNAVAILABLE_ERRORS,
@@ -116,6 +138,7 @@ from predictionio_tpu.workflow.deploy import (
     QueryBatcher,
     QueryDeadlineExceeded,
     ServerConfig,
+    apply_retrieval_config,
     load_deployed_engine,
     retrieval_targets,
 )
@@ -337,6 +360,124 @@ class EngineService:
         #: already in flight still answer; the latch only refuses NEW
         #: placement. Guarded by _reload_lock at writer and readers.
         self._draining = False
+        #: `pio deploy --workers N` peering + shared admin state
+        #: (fleet/workers.py spool + serving/workers.WorkerCoherence;
+        #: docs/serving-performance.md "Multi-process serving"): a
+        #: /metrics or /stats.json scrape landing on THIS worker
+        #: reports fleet-of-workers truth, /traces.json folds sibling
+        #: rings in, and /reload, /drain, POST /retrieval landing
+        #: anywhere reach every sibling through the sequenced
+        #: admin.state document
+        self.worker_hub = None
+        self.coherence: WorkerCoherence | None = None
+        if config.worker_spool_dir:
+            from predictionio_tpu.fleet.workers import WorkerHub
+
+            self.worker_hub = WorkerHub(
+                config.worker_spool_dir,
+                metrics_text=lambda: render_prometheus(self.registry),
+                traces_snapshot=self.trace_log.snapshot,
+                timeout_s=config.worker_peer_timeout_s,
+                # LOCAL stats for sibling fan-out: a peer callback that
+                # itself fanned out would recurse across the pool
+                extra_paths={"/stats.json":
+                             lambda: self.stats_doc(include_workers=False)})
+            self.coherence = WorkerCoherence(
+                self.worker_hub, on_state=self._on_admin_state,
+                interval_s=config.admin_sync_interval_s)
+            adopted = self.coherence.adopt()
+            # respawn adoption: a fresh boot already loaded the latest
+            # completed instance, so reloadSeq is history (the cache —
+            # empty anyway — aligns its generation with the pool's);
+            # the drain latch and retrieval config apply for real
+            if self.cache is not None and adopted["reloadSeq"] > 0:
+                self.cache.invalidate(generation=adopted["reloadSeq"])
+            if adopted["draining"]:
+                with self._reload_lock:
+                    self._draining = True
+            if adopted["retrieval"]:
+                # guarded like the sync path: an unappliable adopted
+                # doc (index-less model, version skew) must degrade,
+                # not abort boot — under --supervise a boot abort
+                # respawns into the same document until the
+                # crash-loop latch permanently shrinks the pool
+                try:
+                    self._apply_retrieval_doc(adopted["retrieval"])
+                except Exception:
+                    logger.exception(
+                        "adopted retrieval config %s failed to "
+                        "apply; serving %s retrieval",
+                        adopted["retrieval"], self.config.retrieval)
+            self.coherence.start()
+
+    @property
+    def worker_id(self) -> str | None:
+        """This worker's spool identity (None outside a worker pool) —
+        stamped into access-log lines so per-worker skew is visible."""
+        return self.worker_hub.worker_id if self.worker_hub else None
+
+    def _publish_admin(self, applied_note: str, **changes) -> None:
+        """Publish admin ``changes`` to the worker pool and VERIFY they
+        committed: ``WorkerCoherence.publish`` swallows spool I/O
+        failures (returning the previous state), and answering 200
+        while N-1 siblings silently stay on the old state would
+        contradict the coherence contract. The local mutation stands
+        either way — the 500 tells the operator the pool is split and
+        a retry (every admin mutation here is idempotent) heals it."""
+        if self.coherence is None:
+            return
+        published = self.coherence.publish(**changes)
+        for key, value in changes.items():
+            if published.get(key) != value:
+                raise _Reject(
+                    500, f"{applied_note}, but publishing to the "
+                         "worker pool failed; sibling workers are "
+                         "unchanged — check the spool directory and "
+                         "retry")
+
+    def _on_admin_state(self, new: dict, prev: dict) -> None:
+        """WorkerCoherence apply callback: perform whatever changed
+        between two cumulative admin states (serving/workers.py). A
+        sibling's /reload becomes a local reload adopting the shared
+        sequence as the cache generation — a failed local reload keeps
+        last-known-good exactly like a direct /reload failure (the
+        sibling that succeeded is ahead; this one answers /readyz
+        truthfully and retries on the next seq bump)."""
+        if new["draining"] != prev["draining"]:
+            with self._reload_lock:
+                self._draining = new["draining"]
+            logger.info("adopted sibling drain latch: %s",
+                        "set" if new["draining"] else "cleared")
+        # reload BEFORE retrieval: a cumulative document can carry both
+        # (operator reloaded onto an index-bearing model, then flipped
+        # to ann, inside one sync interval) — a lagging sibling that
+        # applied retrieval against the still-deployed OLD model would
+        # reject the mode and never retry it
+        if new["reloadSeq"] > prev["reloadSeq"]:
+            try:
+                self.reload(generation=new["reloadSeq"])
+                logger.info("adopted sibling reload (seq %d): now "
+                            "serving %s", new["reloadSeq"],
+                            self.deployed.instance.id)
+            except Exception:
+                record_fallback("serving/reload")
+                logger.exception(
+                    "sibling-triggered reload failed; still serving "
+                    "instance %s", self.deployed.instance.id)
+        if new["retrieval"] != prev["retrieval"] and new["retrieval"]:
+            # guarded like the reload above: a failed local apply must
+            # not abort the remaining deltas in this document (the
+            # sequence has already advanced — an aborted callback would
+            # silently desync this worker from the pool forever)
+            try:
+                self._apply_retrieval_doc(new["retrieval"])
+                logger.info("adopted sibling retrieval config: %s",
+                            new["retrieval"])
+            except Exception:
+                logger.exception(
+                    "sibling retrieval config %s failed to apply; "
+                    "still serving %s retrieval", new["retrieval"],
+                    self.config.retrieval)
 
     # -- sublinear retrieval wiring (ops/ann) -------------------------------
     def _wire_ann_observers(self) -> None:
@@ -346,6 +487,92 @@ class EngineService:
                 getattr(self.deployed, "models", ())):
             if hasattr(target, "set_ann_observer"):
                 target.set_ann_observer(self.serving_stats.record_ann)
+
+    def _missing_index_targets(self) -> list:
+        """ANN-capable deployed models WITHOUT a ready index — the
+        runtime-switch blocker: configure-time fallback builds (fine at
+        deploy) would run a full k-means on whatever thread applies the
+        change, and on the single admin-sync thread that stalls every
+        later /drain//reload for minutes."""
+        return [t for t in retrieval_targets(
+                    getattr(self.deployed, "models", ()))
+                if getattr(t, "ann_index", None) is None]
+
+    def _apply_retrieval_doc(self, doc: Mapping[str, Any]) -> None:
+        """Apply a runtime retrieval reconfiguration (POST /retrieval,
+        a sibling's admin document, or respawn adoption): push the
+        knobs onto every ANN-capable model, re-wire the dispatch
+        observers, invalidate the cache — ann and brute answer the
+        same query with (potentially) different rankings, so entries
+        computed under the old mode must die with it — and only then
+        commit the new ServerConfig (a mid-apply failure must not
+        leave the config claiming a mode the models don't serve)."""
+        mode = str(doc.get("retrieval", self.config.retrieval))
+        if mode not in ("brute", "ann"):
+            raise ValueError(f"invalid retrieval mode {mode!r}")
+
+        def _int(key: str, current: int) -> int:
+            value = doc.get(key, current)
+            if not isinstance(value, int) or value < 0:
+                raise ValueError(f"invalid {key}: {value!r}")
+            return value
+
+        if mode == "ann" and self._missing_index_targets():
+            # guarded HERE so every apply path (HTTP, sibling sync,
+            # respawn adoption) refuses the build — this worker may be
+            # on an older last-known-good model without an index even
+            # when the publishing sibling had one
+            raise ValueError(
+                "no persisted ANN index on the deployed model: build "
+                "it at train/persist time (PIO_SERVING_ANN_BUILD) or "
+                "deploy with --retrieval ann; the runtime switch only "
+                "flips between ready modes")
+        candidate = dataclasses.replace(
+            self.config, retrieval=mode,
+            ann_nprobe=_int("annNprobe", self.config.ann_nprobe),
+            ann_rescore=_int("annRescore", self.config.ann_rescore),
+            ann_nlist=_int("annNlist", self.config.ann_nlist))
+        apply_retrieval_config(getattr(self.deployed, "models", ()),
+                               candidate)
+        self._wire_ann_observers()
+        if self.cache is not None:
+            self.cache.invalidate()
+        self.config = candidate
+
+    def retrieval_admin(self, body: Any) -> tuple:
+        """``POST /retrieval`` — runtime retrieval reconfig without a
+        restart: ``{"retrieval": "ann"|"brute"[, "annNprobe": N,
+        "annRescore": N, "annNlist": N]}``. Key-authenticated like
+        /reload; under ``--workers N`` the change publishes to the
+        admin spool so every sibling reconfigures too."""
+        if not isinstance(body, dict) or "retrieval" not in body:
+            raise _Reject(400, 'expected {"retrieval": "ann"|"brute", ...}')
+        if body.get("retrieval") == "ann" and self._missing_index_targets():
+            # a state conflict, not a malformed request: the model has
+            # no ready index to flip onto (the same guard inside
+            # _apply_retrieval_doc protects the sibling/adoption paths)
+            raise _Reject(
+                409, "no persisted ANN index on the deployed model: "
+                     "build it at train/persist time "
+                     "(PIO_SERVING_ANN_BUILD) or deploy with "
+                     "--retrieval ann; the runtime switch only flips "
+                     "between ready modes")
+        try:
+            self._apply_retrieval_doc(body)
+        except ValueError as exc:
+            raise _Reject(400, str(exc))
+        self._publish_admin("retrieval applied on this worker",
+                            retrieval={
+                                "retrieval": self.config.retrieval,
+                                "annNprobe": self.config.ann_nprobe,
+                                "annRescore": self.config.ann_rescore,
+                                "annNlist": self.config.ann_nlist,
+                            })
+        logger.info("retrieval reconfigured: %s (nprobe=%d rescore=%d)",
+                    self.config.retrieval, self.config.ann_nprobe,
+                    self.config.ann_rescore)
+        return (200, {"retrieval": self.config.retrieval,
+                      "annEnabled": self.ann_enabled()})
 
     def ann_enabled(self) -> bool:
         """True when any deployed model answers queries through its ANN
@@ -393,13 +620,13 @@ class EngineService:
                 return (200, self.stats_doc())
             if method == "GET" and path == "/metrics":
                 # Prometheus exposition: serving counters + latency
-                # histograms + resilience state (docs/observability.md)
+                # histograms + resilience state (docs/observability.md);
+                # under `--workers N` merged with every live sibling
                 return (200, PlainTextPayload(
-                    render_prometheus(self.registry),
-                    PROMETHEUS_CONTENT_TYPE))
+                    self.metrics_text(), PROMETHEUS_CONTENT_TYPE))
             if method == "GET" and path == "/traces.json":
                 return (200, {"tracing": self.tracing,
-                              "traces": self.trace_log.snapshot()})
+                              "traces": self.traces_merged()})
             if method == "GET" and path == "/healthz":
                 # liveness: the process answers; nothing else implied
                 return (200, {"status": "ok"})
@@ -407,8 +634,15 @@ class EngineService:
                 return self.readyz()
             if path == "/reload" and method in ("GET", "POST"):
                 self._check_server_key(params)
+                # the shared reload sequence doubles as the new cache
+                # generation, so every sibling's private cache lands on
+                # the SAME generation (serving/workers.py); reload
+                # FIRST, publish only on success — a failed swap keeps
+                # last-known-good and announces nothing to the pool
+                reload_seq = (self.coherence.next_reload_seq()
+                              if self.coherence is not None else None)
                 try:
-                    self.reload()
+                    self.reload(generation=reload_seq)
                 except LookupError as e:
                     raise _Reject(404, str(e))
                 except Exception as e:
@@ -422,7 +656,13 @@ class EngineService:
                         503,
                         f"reload failed ({e}); still serving instance {keep}",
                         {"Retry-After": retry_after_header(retry_after_hint(e))})
+                self._publish_admin("reloaded on this worker",
+                                    **({"reloadSeq": reload_seq}
+                                       if reload_seq is not None else {}))
                 return (200, {"message": "Reloading"})
+            if method == "POST" and path == "/retrieval":
+                self._check_server_key(params)
+                return self.retrieval_admin(body)
             if method == "POST" and path == "/drain":
                 self._check_server_key(params)
                 return self.drain(body)
@@ -474,6 +714,13 @@ class EngineService:
         undrain = isinstance(body, dict) and body.get("action") == "undrain"
         with self._reload_lock:
             self._draining = not undrain
+        # workers share ONE public port, so an operator draining "the
+        # deployment" cannot address one process — the latch propagates
+        # to every sibling through the admin spool (verified: a
+        # swallowed spool failure must not read as a drained pool)
+        self._publish_admin(
+            f"drain latch {'cleared' if undrain else 'set'} on this "
+            "worker", draining=not undrain)
         logger.info("drain latch %s", "cleared" if undrain else "set")
         return (200, {"status": "ready" if undrain else "draining"})
 
@@ -560,15 +807,92 @@ class EngineService:
             **({"resilience": snap} if (snap := resilience_snapshot()) else {}),
         }
 
-    def stats_doc(self) -> dict:
+    # -- `--workers N` scrape-time aggregation ------------------------------
+    def metrics_text(self) -> str:
+        """This worker's exposition — merged with every live sibling's
+        when the worker pool is on (counters summed, histograms
+        bucket-merged, gauges labeled ``worker=<id>`` per the
+        merge_sources convention), plus the ``pio_serving_workers``
+        gauge, so a scrape landing on one SO_REUSEPORT worker reports
+        fleet-of-workers truth instead of a 1/N sample."""
+        own = self.registry.collect()
+        hub = self.worker_hub
+        if hub is None:
+            return render_metrics(own + [source_count_metric(
+                "pio_serving_workers",
+                "Live engine-server worker processes folded into this "
+                "scrape (1 outside a worker pool)", 1)])
+        sources: list[tuple[str, list]] = [(hub.worker_id, own)]
+        for worker_id, body in hub.fetch_peer_bodies("/metrics"):
+            try:
+                sources.append((worker_id,
+                                parse_exposition(body.decode())))
+            except (ExpositionParseError, UnicodeDecodeError) as exc:
+                logger.warning("worker %s exposition unparseable: %s",
+                               worker_id, exc)
+        merged = merge_sources(sources, source_label="worker")
+        merged.append(source_count_metric(
+            "pio_serving_workers",
+            "Live engine-server worker processes folded into this "
+            "scrape (1 outside a worker pool)", len(sources)))
+        return render_metrics(merged)
+
+    def traces_merged(self) -> list:
+        """The local trace ring, with every live sibling's ring folded
+        in (tagged ``source: worker:<id>``) under the worker pool —
+        one ``GET /traces.json`` sees the whole pool's recent traces
+        wherever the SO_REUSEPORT hash landed it."""
+        traces = self.trace_log.snapshot()
+        hub = self.worker_hub
+        if hub is None:
+            return traces
+        for worker_id, body in hub.fetch_peer_bodies("/traces.json"):
+            try:
+                docs = json.loads(body).get("traces", [])
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            for doc in docs:
+                doc.setdefault("source", f"worker:{worker_id}")
+                traces.append(doc)
+        return traces
+
+    def _workers_doc(self) -> dict:
+        """The /stats.json ``workers`` section: per-worker request
+        counts (this worker's live, siblings' fetched) plus pool
+        totals — the sum is the number an operator wants, the split is
+        where SO_REUSEPORT skew shows."""
+        hub = self.worker_hub
+        per_worker: dict[str, int] = {
+            hub.worker_id: self.deployed.request_count}
+        for worker_id, body in hub.fetch_peer_bodies("/stats.json"):
+            try:
+                doc = json.loads(body)
+                per_worker[worker_id] = int(doc.get("requestCount", 0))
+            except (json.JSONDecodeError, UnicodeDecodeError,
+                    TypeError, ValueError):
+                continue
+        return {
+            "worker": hub.worker_id,
+            "count": len(per_worker),
+            "requestCount": sum(per_worker.values()),
+            "perWorker": per_worker,
+        }
+
+    def stats_doc(self, include_workers: bool = True) -> dict:
         """GET /stats.json — the serving hot path's internals (beyond
         reference; docs/serving-performance.md): batch-size histogram,
         the adaptive policy's inter-arrival EWMA and last plan, cache
         hit/miss/eviction counters and dedup count, per-backend
         resilience state. All counters are read under their own locks
-        (ServingStats), so a concurrent burst never tears the doc."""
+        (ServingStats), so a concurrent burst never tears the doc.
+        Under ``--workers N`` a ``workers`` section reports pool-wide
+        request totals; ``include_workers=False`` is the sibling
+        fan-out view (fetching peers from a peer callback would recurse
+        across the pool)."""
         d = self.deployed
         return {
+            **({"workers": self._workers_doc()}
+               if include_workers and self.worker_hub is not None else {}),
             "engineInstanceId": d.instance.id,
             "requestCount": d.request_count,
             "avgServingSec": d.avg_serving_sec,
@@ -737,13 +1061,15 @@ class EngineService:
                 raise QueryDeadlineExceeded(budget) from None
             raise  # the work itself raised a TimeoutError (3.11 alias)
 
-    def reload(self) -> None:
+    def reload(self, generation: int | None = None) -> None:
         """Hot-swap to the latest completed instance
         (CreateServer.scala:316-342). While the reload is in flight
         /readyz reports not-ready (503 "reloading") so fleet membership
         drains this replica; failure semantics are unchanged — the
         last-known-good model keeps serving and the caller maps the
-        error to 503."""
+        error to 503. ``generation`` pins the post-swap result-cache
+        generation (the shared reload sequence under ``--workers N``,
+        so sibling caches stay generationally comparable)."""
         with self._reload_lock:
             self._reloads_in_flight += 1
         try:
@@ -767,7 +1093,7 @@ class EngineService:
                 # model die with its generation (ResultCache docstring); a
                 # FAILED reload never reaches here, so last-known-good
                 # keeps its warm cache
-                self.cache.invalidate()
+                self.cache.invalidate(generation=generation)
             logger.info("reloaded: instance %s -> %s", old_id, new.instance.id)
         finally:
             with self._reload_lock:
@@ -888,9 +1214,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._trace.finish(status=self._last_status)
                 self.service.trace_log.record(self._trace)
             if self.service.access_log:
+                # the worker id (satellite of the prefork pool): with N
+                # processes behind one port, per-worker skew is only
+                # visible when each line says WHICH worker served it
+                wid = self.service.worker_id
                 emit_access_log(
                     "engine", method, path, self._last_status, dt,
-                    self._request_id, client=self.address_string())
+                    self._request_id, client=self.address_string(),
+                    **({"worker": wid} if wid else {}))
 
     def _dispatch_inner(self, method: str, path: str) -> None:
         body: Any = None
@@ -1024,6 +1355,13 @@ class EngineServer(RestServer):
             _Handler,
             EngineService(deployed, config, storage, ctx, plugin_context),
             config.ip, config.port,
+            # N prefork workers share one listen port (`pio deploy
+            # --workers N`); the CLI pool path sets the flag explicitly
+            # — deliberately NOT derived from config.workers, which is
+            # env-overridable: a standalone server constructed under a
+            # stray PIO_SERVING_WORKERS=2 must not bind SO_REUSEPORT
+            # (a later unrelated bind would silently siphon traffic)
+            reuse_port=config.reuse_port,
         )
         self.service.on_stop = self.stop
         self.service.client_disconnects = lambda: self.client_disconnects
@@ -1034,6 +1372,10 @@ class EngineServer(RestServer):
             undeploy(ip, port, self.config.server_key)
 
     def _on_close(self) -> None:
+        if self.service.coherence is not None:
+            self.service.coherence.close()
+        if self.service.worker_hub is not None:
+            self.service.worker_hub.close()
         if self.service.batcher is not None:
             self.service.batcher.close()
         self.service._query_pool.shutdown(wait=False)

@@ -75,6 +75,7 @@ from predictionio_tpu.obs.aggregate import (
     merge_sources,
     parse_exposition,
     relabel,
+    source_count_metric,
 )
 from predictionio_tpu.obs.exporter import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
 from predictionio_tpu.obs.exporter import render_metrics, render_prometheus
@@ -347,10 +348,10 @@ class RouterService:
                 logger.warning("worker %s exposition unparseable: %s",
                                worker_id, exc)
         merged = merge_sources(sources, source_label="worker")
-        merged.append(Metric(
-            name="pio_router_workers", kind="gauge",
-            help="Live router worker processes folded into this scrape",
-            samples=[({}, float(len(sources)))]))
+        merged.append(source_count_metric(
+            "pio_router_workers",
+            "Live router worker processes folded into this scrape",
+            len(sources)))
         return render_metrics(merged)
 
     def fleet_metrics_text(self) -> str:

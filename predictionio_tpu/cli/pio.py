@@ -65,6 +65,23 @@ def _cmd_eventserver(args, storage: Storage) -> int:
     return 0
 
 
+def resolve_concrete_port(ip: str, port: int) -> int:
+    """A concrete listen port for a prefork worker pool: every
+    SO_REUSEPORT sibling must bind the SAME number, so an ephemeral
+    request (``port=0``) is resolved by a throwaway bind BEFORE any
+    worker forks — shared by ``pio router --workers N`` and
+    ``pio deploy --workers N``."""
+    import socket
+
+    if port:
+        return port
+    probe = socket.socket()
+    probe.bind((ip, 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
+
 def _router_worker(config) -> None:
     """One extra `pio router --workers N` worker process: a full
     RouterServer on the shared SO_REUSEPORT listen port."""
@@ -170,17 +187,10 @@ def _cmd_router(args, storage: Storage) -> int:
     worker_specs = []
     if workers > 1:
         import multiprocessing
-        import socket as _socket
         import tempfile
 
-        if config.port == 0:
-            # every worker must share ONE concrete port; resolve the
-            # ephemeral request before forking
-            probe = _socket.socket()
-            probe.bind((config.ip, 0))
-            config = dataclasses.replace(config,
-                                         port=probe.getsockname()[1])
-            probe.close()
+        config = dataclasses.replace(
+            config, port=resolve_concrete_port(config.ip, config.port))
         # worker peering spool (fleet/workers.py): each worker
         # registers its loopback peer endpoint here, so a /metrics
         # scrape landing on ONE SO_REUSEPORT worker reports ALL of

@@ -83,6 +83,14 @@ class _PeerHandler(BaseHTTPRequestHandler):
             body = json.dumps(
                 {"traces": self.hub._traces_snapshot()}).encode()
             ctype = "application/json; charset=UTF-8"
+        elif self.path in self.hub._extra_paths:
+            # extra LOCAL documents a server registers for sibling
+            # fan-out (the engine server's per-worker /stats.json);
+            # the callback must return this worker's OWN view — a
+            # callback that itself fans out to peers would recurse
+            # A -> B -> A across the pool
+            body = json.dumps(self.hub._extra_paths[self.path]()).encode()
+            ctype = "application/json; charset=UTF-8"
         else:
             body, ctype = b'{"message": "not found"}', "application/json"
             self.send_response(404)
@@ -108,12 +116,16 @@ class WorkerHub:
     def __init__(self, spool_dir: str,
                  metrics_text: Callable[[], str],
                  traces_snapshot: Callable[[], list],
-                 timeout_s: float = DEFAULT_PEER_TIMEOUT_S):
+                 timeout_s: float = DEFAULT_PEER_TIMEOUT_S,
+                 extra_paths: dict[str, Callable[[], object]] | None = None):
         self.spool_dir = spool_dir
         self.worker_id = f"{os.getpid()}-{next(_HUB_SEQ)}"
         self.timeout_s = timeout_s
         self._metrics_text = metrics_text
         self._traces_snapshot = traces_snapshot
+        #: additional loopback-only JSON documents (path -> callable
+        #: returning this worker's LOCAL view; see _PeerHandler)
+        self._extra_paths = dict(extra_paths or {})
         os.makedirs(spool_dir, exist_ok=True)
         handler = type("BoundPeerHandler", (_PeerHandler,), {"hub": self})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)

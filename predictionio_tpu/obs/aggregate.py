@@ -210,6 +210,17 @@ def merge_snapshots(snaps: Sequence[HistogramSnapshot]) -> HistogramSnapshot:
     )
 
 
+def source_count_metric(name: str, help: str, count: int) -> Metric:
+    """The "how many processes fed this scrape" gauge every merged
+    exposition appends AFTER :func:`merge_sources` (so it never gains a
+    per-source label itself): ``pio_router_workers`` on the router,
+    ``pio_serving_workers`` on the engine server. A reading below the
+    launched worker count means a sibling is dead or wedged —
+    docs/fleet.md and docs/serving-performance.md runbooks key off it."""
+    return Metric(name=name, kind="gauge", help=help,
+                  samples=[({}, float(count))])
+
+
 def relabel(metrics: Iterable[Metric], extra: Mapping[str, str]) -> list[Metric]:
     """Copies with ``extra`` merged into every sample's label set (the
     ``replica=...`` annotation on ``/fleet/metrics``). Existing keys

@@ -95,11 +95,24 @@ class ResultCache:
                 self.stats.bump("cache_evictions")
             return True
 
-    def invalidate(self) -> None:
-        """Atomically drop everything and start a new generation."""
+    def invalidate(self, generation: int | None = None) -> None:
+        """Atomically drop everything and start a new generation.
+
+        ``generation`` pins the NEW generation number explicitly — the
+        ``pio deploy --workers N`` coherence path passes the fleet's
+        shared reload sequence so every sibling's private cache lands
+        on the SAME generation after a ``/reload``, making the
+        per-worker generations comparable across the pool
+        (docs/serving-performance.md "Multi-process serving"). It only
+        ever moves the counter FORWARD: a lagging sibling applying an
+        old document cannot rewind a newer local generation (the stale
+        ``put()`` guard depends on generations never repeating)."""
         with self._lock:
             self._entries.clear()
-            self._generation += 1
+            if generation is not None:
+                self._generation = max(self._generation + 1, generation)
+            else:
+                self._generation += 1
             self.stats.bump("cache_invalidations")
 
     def __len__(self) -> int:

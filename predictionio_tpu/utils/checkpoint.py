@@ -35,6 +35,29 @@ fail LOUDLY at load, never deploy garbage:
 
 Pre-manifest checkpoints (version 1) load without verification, so
 existing artifacts keep working.
+
+Memory-mapped loading (``pio deploy --workers N``; docs/
+serving-performance.md "Multi-process serving"): ``load_sharded(...,
+mmap_mode="r")`` maps each npz member's raw ``.npy`` bytes straight out
+of the page cache instead of copying them onto the heap. N prefork
+worker processes that load the same checkpoint then *share* one
+physical copy of the factor tables — the kernel backs every worker's
+mapping with the same pages — so model memory is O(1) in workers
+instead of O(N). ``PIO_CHECKPOINT_MMAP=r`` turns it on fleet-wide
+without a code change (read per load call, never frozen at import).
+
+Checksum-verification story under mmap: the sha256 content check reads
+every byte, which would fault the whole file in and erase the laziness
+the mapping exists for. The policy is **verify-once at save, verify
+eagerly on integrity-suspect paths**: a mmap load verifies the
+manifest's *shape/dtype* per array (header-only, O(arrays)) but skips
+the content hash — the save path already fsync'd + atomically renamed
+the content-addressed payload, so a torn write cannot be named by a
+committed meta. Deployments that want the full content check (e.g.
+after a disk scare) load eagerly (the default), which verifies every
+checksum as before. Any mmap failure — compressed member, legacy
+layout, filesystem without mmap — logs a warning and falls back to the
+eager verified load; the knob can degrade, never brick a deploy.
 """
 
 from __future__ import annotations
@@ -136,9 +159,61 @@ def save_sharded(directory: str, arrays: Mapping[str, Any]) -> str:
     return "npz"
 
 
+def default_mmap_mode() -> str | None:
+    """The fleet-wide mmap default: ``PIO_CHECKPOINT_MMAP`` set to
+    ``r``/``1``/``true`` means read-only mapping, anything else (or
+    unset) means eager copy-and-verify. Read at call time — the
+    ServerConfig env discipline, never frozen at import."""
+    raw = os.environ.get("PIO_CHECKPOINT_MMAP", "").strip().lower()
+    if raw in ("r", "1", "true", "yes", "on"):
+        return "r"
+    return None
+
+
+def _mmap_npz(path: str) -> dict[str, Any]:
+    """Map every member of an uncompressed npz as a read-only
+    ``np.memmap`` view into the archive file (module docstring). Raises
+    on anything unexpected (compressed member, pickled object array,
+    short file) — the caller falls back to the eager load."""
+    import zipfile
+
+    from numpy.lib import format as npy_format
+
+    out: dict[str, Any] = {}
+    with zipfile.ZipFile(path) as zf, open(path, "rb") as f:
+        for info in zf.infolist():
+            if info.compress_type != zipfile.ZIP_STORED:
+                raise ValueError(
+                    f"member {info.filename!r} is compressed; "
+                    "mmap needs raw stored bytes")
+            name = info.filename
+            if name.endswith(".npy"):
+                name = name[:-4]
+            # the zip local file header is variable length: seek to
+            # it, read the name/extra lengths, land on the .npy data
+            f.seek(info.header_offset)
+            local = f.read(30)
+            if len(local) != 30 or local[:4] != b"PK\x03\x04":
+                raise ValueError("torn local header")
+            name_len = int.from_bytes(local[26:28], "little")
+            extra_len = int.from_bytes(local[28:30], "little")
+            f.seek(info.header_offset + 30 + name_len + extra_len)
+            version = npy_format.read_magic(f)
+            shape, fortran, dtype = npy_format._read_array_header(
+                f, version)
+            if dtype.hasobject:
+                raise ValueError(
+                    f"member {name!r} holds objects; not mappable")
+            out[name] = np.memmap(
+                path, dtype=dtype, mode="r", shape=shape,
+                offset=f.tell(), order="F" if fortran else "C")
+    return out
+
+
 def load_sharded(
     directory: str,
     shardings: Mapping[str, Any] | None = None,
+    mmap_mode: str | None = None,
 ) -> dict[str, Any]:
     """Restore a mapping saved by :func:`save_sharded`, verifying the
     integrity manifest when one exists (raises
@@ -147,7 +222,14 @@ def load_sharded(
     ``shardings`` optionally maps names to ``jax.sharding.Sharding``
     targets — orbax then materialises each array directly with that
     placement (shard-by-shard on multi-host meshes). Without it, arrays
-    restore host-local."""
+    restore host-local.
+
+    ``mmap_mode="r"`` (npz backend only) maps the arrays instead of
+    copying them — the prefork-worker page-sharing path; shape/dtype
+    still verify against the manifest but content checksums are skipped
+    (module docstring has the verification trade-off). ``None`` defers
+    to :func:`default_mmap_mode` (the ``PIO_CHECKPOINT_MMAP`` env);
+    orbax checkpoints and device-sharded restores ignore it."""
     meta = _read_meta(directory)
     backend = meta.get("backend", "npz")
     manifest: Mapping[str, Any] | None = meta.get("arrays")
@@ -184,6 +266,30 @@ def load_sharded(
         return out
     payload_name = meta.get("payload", _NPZ_FILE)
     npz_path = os.path.join(directory, payload_name)
+    if mmap_mode is None:
+        mmap_mode = default_mmap_mode()
+    if mmap_mode is not None:
+        try:
+            out = _mmap_npz(npz_path)
+        except FileNotFoundError:
+            raise CheckpointCorruptError(
+                f"checkpoint at {directory} is missing {payload_name} — "
+                "incomplete or deleted save") from None
+        except Exception as exc:  # degrade to the eager verified load
+            logger.warning(
+                "mmap load of %s failed (%s); falling back to the "
+                "eager copy-and-verify load", npz_path, exc)
+        else:
+            # header-only verification: the content hash would fault
+            # the whole mapping in (module docstring)
+            _verify(directory, out, manifest, check_sums=False)
+            if shardings:
+                import jax
+
+                for name, sh in shardings.items():
+                    if name in out:
+                        out[name] = jax.device_put(out[name], sh)
+            return out
     try:
         data = np.load(npz_path)
         out = {k: data[k] for k in data.files}

@@ -193,6 +193,26 @@ def _configure_deploy(sub) -> None:
     p = sub.add_parser("deploy", help="deploy the latest trained engine instance")
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
+    # prefork worker pool (docs/serving-performance.md "Multi-process
+    # serving"): N engine-server processes share one SO_REUSEPORT
+    # listen port — the serving plane's escape from the single-process
+    # GIL floor. None defers to PIO_SERVING_WORKERS.
+    p.add_argument("--workers", type=int, default=None,
+                   help="engine-server worker processes sharing the "
+                        "listen port via SO_REUSEPORT; /metrics, "
+                        "/stats.json and /traces.json report the whole "
+                        "pool from any worker, and /reload//drain/"
+                        "/retrieval reach every sibling")
+    p.add_argument("--supervise", action="store_true",
+                   help="own the worker siblings: respawn on death "
+                        "with damped backoff, latch crash loops, stop "
+                        "the whole pool on SIGTERM (fleet/supervisor)")
+    p.add_argument("--model-mmap", action="store_true", dest="model_mmap",
+                   help="load npz model checkpoints with mmap so the "
+                        "worker processes share one physical copy of "
+                        "the factor tables (sets PIO_CHECKPOINT_MMAP=r; "
+                        "utils/checkpoint has the verification "
+                        "trade-off)")
     p.add_argument("--engine-instance-id", default=None)
     p.add_argument("--engine-json", default="engine.json")
     p.add_argument("--feedback", action="store_true")
@@ -255,7 +275,24 @@ def _configure_deploy(sub) -> None:
                         "status, latency_ms, request_id)")
 
 
+def _deploy_worker(config) -> None:
+    """One extra `pio deploy --workers N` sibling process: a full
+    engine server on the shared SO_REUSEPORT port, with its OWN storage
+    connection and model replica (mmap-share the factor tables via
+    --model-mmap / PIO_CHECKPOINT_MMAP=r)."""
+    from predictionio_tpu.api.engine_server import create_engine_server
+    from predictionio_tpu.storage.registry import Storage
+
+    server = create_engine_server(storage=Storage.default(), config=config)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+
+
 def _cmd_deploy(args, storage) -> int:
+    import dataclasses
+
     from predictionio_tpu.api.engine_server import create_engine_server
     from predictionio_tpu.workflow.deploy import ServerConfig
 
@@ -264,6 +301,11 @@ def _cmd_deploy(args, storage) -> int:
     variant = _load_variant(args.engine_json)
     if variant is None:
         return 1
+    if args.model_mmap:
+        # before any model load, and inherited by every worker spawn:
+        # N processes map the same checkpoint pages instead of holding
+        # N heap copies (utils/checkpoint module docstring)
+        os.environ["PIO_CHECKPOINT_MMAP"] = "r"
     config = ServerConfig(
         ip=args.ip,
         port=args.port,
@@ -290,14 +332,101 @@ def _cmd_deploy(args, storage) -> int:
             "ann_rescore": args.ann_rescore,
             "tracing": args.tracing,
             "access_log": args.access_log,
+            "workers": args.workers,
         }.items() if v is not None},
     )
-    server = create_engine_server(storage=storage, config=config)
-    return _serve(
-        server,
-        f"Engine instance {server.service.deployed.instance.id}",
-        args.ip,
-    )
+    workers = max(1, config.workers)
+    if workers == 1:
+        if args.supervise:
+            # nothing to supervise: the supervisor owns worker
+            # SIBLINGS, and a 1-worker deploy is just this process —
+            # say so instead of silently dropping the flag
+            print("[WARN] --supervise has no effect with --workers 1 "
+                  "(it respawns worker siblings); use an external "
+                  "supervisor for a single process.")
+        server = create_engine_server(storage=storage, config=config)
+        return _serve(
+            server,
+            f"Engine instance {server.service.deployed.instance.id}",
+            args.ip,
+        )
+
+    # prefork pool: N-1 sibling processes + this one share the
+    # SO_REUSEPORT port; the spool carries peering + shared admin state
+    # (docs/serving-performance.md "Multi-process serving")
+    import multiprocessing
+    import shutil
+    import signal
+    import tempfile
+
+    from predictionio_tpu.cli.pio import resolve_concrete_port
+
+    config = dataclasses.replace(
+        config,
+        port=resolve_concrete_port(config.ip, config.port),
+        reuse_port=True,
+        worker_spool_dir=tempfile.mkdtemp(prefix="pio-deploy-workers-"))
+
+    def sibling():
+        return multiprocessing.Process(
+            target=_deploy_worker, args=(config,), daemon=True)
+
+    # SIGTERM's default action would kill this parent without running
+    # any finally, orphaning the SO_REUSEPORT siblings on the shared
+    # port; route it through KeyboardInterrupt (the `pio router`
+    # discipline) BEFORE the first sibling spawns — a stop landing
+    # mid-model-load must tear the pool down too, so everything from
+    # the spawns on runs inside the cleanup try
+    def _on_sigterm(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    supervisor = None
+    worker_procs: list = []
+    server = None
+    try:
+        if args.supervise:
+            from predictionio_tpu.fleet.supervisor import (
+                WORKER,
+                FleetSupervisor,
+                ProcessHandle,
+                SpawnSpec,
+            )
+
+            supervisor = FleetSupervisor([
+                SpawnSpec(id=f"worker:{i}",
+                          spawn=lambda: ProcessHandle(sibling()),
+                          role=WORKER)
+                for i in range(1, workers)
+            ])
+            supervisor.start()
+        else:
+            for _ in range(workers - 1):
+                proc = sibling()
+                proc.start()
+                worker_procs.append(proc)
+        server = create_engine_server(storage=storage, config=config)
+        print(f"[INFO] Engine instance "
+              f"{server.service.deployed.instance.id} listening on "
+              f"{args.ip}:{server.port} ({workers} worker(s)"
+              + (", supervised" if supervisor is not None else "") + ")")
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if supervisor is not None:
+            supervisor.shutdown()
+        if server is not None:
+            server.stop()
+        for proc in worker_procs:
+            proc.terminate()
+        for proc in worker_procs:
+            proc.join(timeout=5)
+        # terminate() is SIGTERM: siblings die without running
+        # WorkerHub.close, leaving spool entries behind — the parent
+        # mkdtemp'd the dir, the parent removes it
+        shutil.rmtree(config.worker_spool_dir, ignore_errors=True)
+    return 0
 
 
 def _configure_undeploy(sub) -> None:

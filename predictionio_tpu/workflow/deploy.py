@@ -156,6 +156,32 @@ class ServerConfig:
     #: structured JSON access logs on the ``pio.access`` logger; None
     #: defers to the PIO_ACCESS_LOG env var (api/http_base.py)
     access_log: bool | None = None
+    #: prefork worker pool (docs/serving-performance.md "Multi-process
+    #: serving"): ``pio deploy --workers N`` runs N engine-server
+    #: processes sharing ONE SO_REUSEPORT listen port — one CPython
+    #: process tops out on its GIL long before a multi-core host does.
+    #: Each worker holds its own model replica (mmap-share it via
+    #: PIO_CHECKPOINT_MMAP=r; utils/checkpoint), batcher, cache, and
+    #: registry; cross-worker truth/coherence ride worker_spool_dir.
+    workers: int = _env_field("WORKERS", 1, int)
+    #: spool directory for worker peering + shared admin state
+    #: (fleet/workers.WorkerHub, serving/workers.WorkerCoherence); the
+    #: CLI mkdtemps it and passes it to every worker. None = no pool.
+    worker_spool_dir: str | None = None
+    #: bind with SO_REUSEPORT so the N worker processes share the port
+    #: (set by the CLI when workers > 1)
+    reuse_port: bool = False
+    #: socket bound per sibling fetch on the scrape fan-out paths
+    #: (/metrics, /stats.json, /traces.json merging) — a wedged worker
+    #: costs the scrape its timeout, never a hang (the untimed-
+    #: blocking-io contract)
+    worker_peer_timeout_s: float = _env_field("WORKER_PEER_TIMEOUT_S",
+                                              2.0, float)
+    #: cadence of the shared-admin-state sync loop: a /reload, /drain,
+    #: or retrieval reconfig landing on ANY worker reaches every
+    #: sibling within about this many seconds
+    admin_sync_interval_s: float = _env_field("ADMIN_SYNC_INTERVAL_S",
+                                              0.5, float)
 
 
 class DeployedEngine:

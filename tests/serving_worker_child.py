@@ -1,0 +1,90 @@
+"""A real `pio deploy --workers N` sibling PROCESS for the serving-pool
+chaos suite: one full EngineServer (worker hub + admin coherence on the
+shared spool) bound to the shared SO_REUSEPORT port, launched as a
+subprocess so the supervisor can kill -9 it and respawn a clean
+incarnation — exactly the `pio deploy --workers N --supervise` worker
+lifecycle.
+
+The deployed engine is a pure-Python echo (tag + pid per answer, so
+tests see WHICH incarnation served) — the REAL serving surface
+(/queries.json through EngineService, /metrics merged across siblings,
+/stats.json pool totals, the admin sync loop) over a model that costs
+nothing to load, keeping respawn windows tight.
+
+Usage: python tests/serving_worker_child.py --port N --spool DIR \
+           [--tag w0] [--admin-sync-interval-s 0.1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import sys
+
+# launched as `python tests/serving_worker_child.py`: sys.path[0] is
+# tests/, so the in-repo package needs the repo root added explicitly
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _EchoAlgo:
+    """Answers every query with its own identity — no device, no
+    storage, boots in import time only."""
+
+    def __init__(self, tag: str):
+        self.tag = tag
+
+    def predict(self, model, query):
+        return {"tag": self.tag, "pid": os.getpid(), "echo": query}
+
+    def batch_predict(self, model, indexed):
+        return [(i, self.predict(model, q)) for i, q in indexed]
+
+
+class _PassthroughServing:
+    def supplement(self, query):
+        return query
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--spool", required=True)
+    parser.add_argument("--tag", default="w")
+    parser.add_argument("--admin-sync-interval-s", type=float, default=0.1)
+    args = parser.parse_args()
+
+    from predictionio_tpu.api.engine_server import EngineServer
+    from predictionio_tpu.storage.base import EngineInstance
+    from predictionio_tpu.workflow.deploy import DeployedEngine, ServerConfig
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    deployed = DeployedEngine(
+        engine=None,
+        instance=EngineInstance(
+            id="serving-worker-child", status="COMPLETED",
+            start_time=now, completion_time=now,
+            engine_id="serving-worker-child", engine_version="1",
+            engine_variant="serving-worker-child",
+            engine_factory="serving-worker-child"),
+        algorithms=[_EchoAlgo(args.tag)],
+        serving=_PassthroughServing(),
+        models=[None],
+    )
+    server = EngineServer(deployed, ServerConfig(
+        ip="127.0.0.1", port=args.port,
+        reuse_port=True, worker_spool_dir=args.spool,
+        admin_sync_interval_s=args.admin_sync_interval_s,
+        cache_enabled=False))
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,783 @@
+"""Prefork engine-serving pool (`pio deploy --workers N`;
+docs/serving-performance.md "Multi-process serving").
+
+The acceptance scenarios:
+
+- under 2 SO_REUSEPORT workers, an aggregated ``/metrics`` scrape
+  landing on EITHER worker reports counter totals equal to the sum of
+  per-worker traffic (and ``/stats.json`` reports pool request
+  totals);
+- ``/reload`` landing on one worker reaches every sibling through the
+  sequenced admin-state document and invalidates ALL result caches
+  onto the SAME generation — a stale-generation ``put`` is dropped,
+  never served;
+- ``kill -9`` one worker under live load with ``--supervise``
+  semantics → the supervisor respawns it, clients see ZERO 5xx, and
+  the restored worker is folded back into the merged ``/metrics``.
+
+Plus the satellite pins: drain/undrain and runtime retrieval reconfig
+propagate, a respawned worker adopts the current admin state at init,
+``WorkerCoherence`` publish/merge semantics on a bare spool, the
+checkpoint ``mmap_mode`` path (round-trip equality, manifest
+verification, graceful fallback), the ``pio_serving_workers`` gauge,
+the access-log ``worker`` field, and the ``--workers`` CLI/env knobs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.api.engine_server import create_engine_server
+from predictionio_tpu.serving.result_cache import ResultCache
+from predictionio_tpu.serving.workers import WorkerCoherence
+from predictionio_tpu.workflow.deploy import ServerConfig
+
+from tests.test_observability import parse_prometheus
+
+pytestmark = pytest.mark.workers
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER_CHILD = os.path.join(HERE, "serving_worker_child.py")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_until(pred, timeout: float = 15.0, interval: float = 0.05,
+               message: str = "condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for: {message}")
+
+
+def _train(storage, mult=2):
+    from predictionio_tpu.controller import EngineParams
+    from predictionio_tpu.workflow.train import run_train
+    from tests.sample_engine import AlgoParams, DSParams
+
+    params = EngineParams.of(
+        data_source=DSParams(id=7, n_train=5),
+        algorithms=[("sample", AlgoParams(id=0, mult=mult))],
+    )
+    return run_train(
+        engine_factory="tests.sample_engine.engine_factory",
+        engine_params=params,
+        variant={"id": "sample-engine"},
+        storage=storage,
+    )
+
+
+def _post_query(port: int, payload: dict) -> tuple[int, dict]:
+    """One query over a FRESH connection so the kernel's SO_REUSEPORT
+    hash can spread requests across the pool."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get_json(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _worker_pool(storage, n=2, port=None, spool=None, **overrides):
+    """n in-process EngineServers sharing one SO_REUSEPORT port and one
+    spool — each holds its own EngineService/cache/registry, exactly
+    the per-process state the prefork pool replicates (the processes
+    themselves are exercised by the chaos suite below)."""
+    port = port or free_port()
+    spool = spool or tempfile.mkdtemp(prefix="pio-test-serving-workers-")
+    servers = []
+    for _ in range(n):
+        cfg = ServerConfig(
+            ip="127.0.0.1", port=port, reuse_port=True,
+            worker_spool_dir=spool, admin_sync_interval_s=0.1,
+            cache_enabled=True, cache_ttl_s=300.0, **overrides)
+        server = create_engine_server(storage=storage, config=cfg)
+        server.start()
+        servers.append(server)
+    return servers, port, spool
+
+
+# ---------------------------------------------------------------------------
+# acceptance: truthful /metrics + /stats.json under 2 workers
+# ---------------------------------------------------------------------------
+
+class TestWorkerPoolScrape:
+    def test_metrics_sum_of_per_worker_traffic(self, storage):
+        """THE aggregation criterion: drive traffic over fresh
+        connections across the shared port, then ONE scrape — wherever
+        it lands — reports the pool total, the worker-count gauge, and
+        per-worker-labeled gauges."""
+        _train(storage)
+        (w1, w2), port, _ = _worker_pool(storage)
+        try:
+            n = 24
+            for i in range(n):
+                status, _ = _post_query(port, {"x": i})
+                assert status == 200
+            per_worker = [w.service.deployed.request_count
+                          for w in (w1, w2)]
+            assert sum(per_worker) == n
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                families = parse_prometheus(r.read().decode())
+            # counters sum across workers: the per-route request
+            # histogram's _count is the served-query total
+            total = families["pio_http_request_seconds"]["samples"][
+                ("pio_http_request_seconds_count",
+                 (("route", "queries"),))]
+            assert total == float(n), (total, per_worker)
+            workers = families["pio_serving_workers"]["samples"][
+                ("pio_serving_workers", ())]
+            assert workers == 2.0
+            # gauges per-worker labeled (the merge_sources convention)
+            info = families["pio_server_info"]["samples"]
+            assert len(info) == 2
+            assert all(dict(labels).get("worker") for _, labels in info)
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_stats_json_reports_pool_totals(self, storage):
+        _train(storage)
+        (w1, w2), port, _ = _worker_pool(storage)
+        try:
+            n = 10
+            for i in range(n):
+                _post_query(port, {"x": i})
+            doc = _get_json(port, "/stats.json")
+            assert doc["workers"]["count"] == 2
+            assert doc["workers"]["requestCount"] == n
+            assert sum(doc["workers"]["perWorker"].values()) == n
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_single_worker_metrics_still_carry_the_gauge(self, storage):
+        """Outside a pool the gauge reads 1 and /stats.json stays
+        unchanged — dashboards key off one name either way."""
+        _train(storage)
+        server = create_engine_server(
+            storage=storage, config=ServerConfig(ip="127.0.0.1", port=0))
+        server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics",
+                    timeout=10) as r:
+                families = parse_prometheus(r.read().decode())
+            assert families["pio_serving_workers"]["samples"][
+                ("pio_serving_workers", ())] == 1.0
+            assert "workers" not in _get_json(server.port, "/stats.json")
+        finally:
+            server.stop()
+
+    def test_traces_merge_sibling_rings(self, storage):
+        _train(storage)
+        (w1, w2), port, _ = _worker_pool(storage, tracing=True)
+        try:
+            n = 8
+            for i in range(n):
+                _post_query(port, {"x": i})
+            # both workers saw traffic or not — either way the merged
+            # ring must hold every trace wherever the scrape lands
+            doc = _get_json(port, "/traces.json")
+            assert len(doc["traces"]) == n
+            local = [t for t in doc["traces"] if "source" not in t]
+            remote = [t for t in doc["traces"] if "source" in t]
+            assert len(local) + len(remote) == n
+        finally:
+            w1.stop()
+            w2.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: /reload coherence — every sibling, same generation
+# ---------------------------------------------------------------------------
+
+class TestAdminCoherence:
+    def test_reload_reaches_every_sibling_and_aligns_generations(
+            self, storage):
+        _train(storage, mult=2)
+        (w1, w2), port, _ = _worker_pool(storage)
+        try:
+            old_id = w1.service.deployed.instance.id
+            _train(storage, mult=3)
+            status, _ = w1.service.handle("GET", "/reload", {}, {},
+                                          None)[:2]
+            assert status == 200
+            assert w1.service.deployed.instance.id != old_id
+            assert w1.service.cache.generation == 1
+            # the sibling adopts within its sync interval: same new
+            # instance, same cache generation
+            wait_until(
+                lambda: w2.service.deployed.instance.id
+                == w1.service.deployed.instance.id,
+                message="sibling adopted the reload")
+            assert w2.service.cache.generation == 1
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_stale_generation_put_dropped_after_sibling_reload(
+            self, storage):
+        """A result computed against the old model on worker B while
+        worker A's /reload propagates must never land in (or serve
+        from) B's post-reload cache — the generational guard."""
+        _train(storage, mult=2)
+        (w1, w2), port, _ = _worker_pool(storage)
+        try:
+            hit, _, observed_gen = w2.service.cache.lookup("q1")
+            assert not hit and observed_gen == 0
+            _train(storage, mult=3)
+            w1.service.handle("GET", "/reload", {}, {}, None)
+            wait_until(lambda: w2.service.cache.generation == 1,
+                       message="sibling cache invalidated")
+            # the in-flight computation finishes AFTER the sibling
+            # invalidation: its put carries the stale generation
+            assert w2.service.cache.put("q1", "old-model-answer",
+                                        generation=observed_gen) is False
+            assert w2.service.cache.lookup("q1")[0] is False
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_drain_latches_and_clears_on_every_sibling(self, storage):
+        _train(storage)
+        (w1, w2), port, _ = _worker_pool(storage)
+        try:
+            w1.service.handle("POST", "/drain", {}, {}, None)
+            assert w1.service.readyz()[0] == 503
+            wait_until(lambda: w2.service.readyz()[0] == 503,
+                       message="sibling drained")
+            w2.service.handle("POST", "/drain", {}, {},
+                              {"action": "undrain"})
+            wait_until(lambda: w1.service.readyz()[0] == 200,
+                       message="sibling undrained")
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_retrieval_reconfig_propagates(self, storage):
+        _train(storage)
+        (w1, w2), port, _ = _worker_pool(storage)
+        try:
+            status, payload = w2.service.handle(
+                "POST", "/retrieval", {}, {},
+                {"retrieval": "ann", "annNprobe": 32})[:2]
+            assert status == 200
+            assert w2.service.config.retrieval == "ann"
+            wait_until(lambda: w1.service.config.retrieval == "ann",
+                       message="sibling reconfigured retrieval")
+            assert w1.service.config.ann_nprobe == 32
+            # bad mode rejected, nothing published
+            status, payload = w1.service.handle(
+                "POST", "/retrieval", {}, {}, {"retrieval": "nope"})[:2]
+            assert status == 400
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_respawned_worker_adopts_current_state_at_init(self, storage):
+        """A worker joining an existing pool (the respawn case) boots
+        with the CURRENT admin state: drain latch set, cache generation
+        aligned — not the launch-time defaults."""
+        _train(storage, mult=2)
+        (w1, w2), port, spool = _worker_pool(storage)
+        try:
+            _train(storage, mult=3)
+            w1.service.handle("GET", "/reload", {}, {}, None)
+            w1.service.handle("POST", "/drain", {}, {}, None)
+            (w3,), _, _ = _worker_pool(storage, n=1, port=port,
+                                       spool=spool)
+            try:
+                assert w3.service.readyz()[0] == 503      # drained at boot
+                assert w3.service.cache.generation == 1   # aligned
+                # and it did NOT reload redundantly: a fresh boot
+                # already loaded the latest completed instance
+                assert (w3.service.deployed.instance.id
+                        == w1.service.deployed.instance.id)
+            finally:
+                w3.stop()
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_swallowed_publish_failure_surfaces_as_500(self, storage):
+        """WorkerCoherence.publish swallows spool I/O errors (returns
+        the previous state); the admin handler must verify the commit
+        and answer 500 — a 200 that silently left N-1 siblings on the
+        old state would contradict the coherence contract. The local
+        mutation stands (the message says so; a retry heals the
+        pool)."""
+        _train(storage)
+        (w1, w2), port, _ = _worker_pool(storage)
+        try:
+            coherence = w1.service.coherence
+            coherence.publish = lambda **kw: coherence.state()
+            status, payload = w1.service.handle(
+                "POST", "/drain", {}, {}, None)[:2]
+            assert status == 500
+            assert "publishing to the worker pool failed" \
+                in payload["message"]
+            assert w1.service.readyz()[0] == 503    # local latch stands
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_runtime_ann_switch_requires_ready_index(self, storage):
+        """POST /retrieval {"retrieval": "ann"} is a mode FLIP, not a
+        build: an ANN-capable model without a persisted index answers
+        409 (a configure-time fallback k-means would run on the handler
+        thread and once more in every sibling's sync loop, stalling
+        admin propagation for minutes). With a ready index the switch
+        applies."""
+
+        class FakeAnnModel:
+            def __init__(self, ready):
+                self.ann_index = object() if ready else None
+                self.calls = []
+
+            def configure_retrieval(self, mode, nprobe=0, rescore=0,
+                                    nlist=0):
+                self.calls.append(mode)
+
+        _train(storage)
+        (w1,), port, _ = _worker_pool(storage, n=1)
+        try:
+            w1.service.deployed.models = [FakeAnnModel(ready=False)]
+            status, _ = w1.service.handle(
+                "POST", "/retrieval", {}, {}, {"retrieval": "ann"})[:2]
+            assert status == 409
+            ready = FakeAnnModel(ready=True)
+            w1.service.deployed.models = [ready]
+            status, _ = w1.service.handle(
+                "POST", "/retrieval", {}, {}, {"retrieval": "ann"})[:2]
+            assert status == 200
+            assert ready.calls == ["ann"]
+        finally:
+            w1.stop()
+
+    def test_auth_required_when_keyed(self, storage):
+        _train(storage)
+        (w1,), port, _ = _worker_pool(storage, n=1,
+                                      server_key="sekrit")
+        try:
+            status, _ = w1.service.handle(
+                "POST", "/retrieval", {}, {}, {"retrieval": "ann"})[:2]
+            assert status == 401
+            status, _ = w1.service.handle(
+                "POST", "/retrieval", {"accessKey": "sekrit"}, {},
+                {"retrieval": "brute"})[:2]
+            assert status == 200
+        finally:
+            w1.stop()
+
+
+# ---------------------------------------------------------------------------
+# WorkerCoherence unit semantics on a bare spool
+# ---------------------------------------------------------------------------
+
+class TestWorkerCoherenceUnit:
+    def _hub(self, spool):
+        from predictionio_tpu.fleet.workers import WorkerHub
+
+        return WorkerHub(spool, metrics_text=lambda: "",
+                         traces_snapshot=lambda: [])
+
+    def test_publish_merges_and_sequences(self, tmp_path):
+        spool = str(tmp_path)
+        applied_a, applied_b = [], []
+        a = WorkerCoherence(self._hub(spool),
+                            lambda new, prev: applied_a.append((new, prev)))
+        b = WorkerCoherence(self._hub(spool),
+                            lambda new, prev: applied_b.append((new, prev)))
+        a.adopt()
+        b.adopt()
+        a.publish(reloadSeq=1)
+        assert a.state()["reloadSeq"] == 1
+        assert applied_a == []            # own mutation is not re-applied
+        assert b.sync_once() is True
+        assert applied_b[-1][0]["reloadSeq"] == 1
+        b.publish(draining=True)
+        assert b.state() == {"reloadSeq": 1, "draining": True,
+                             "retrieval": None}
+        assert a.sync_once() is True
+        assert applied_a[-1][0]["draining"] is True
+        assert a.sync_once() is False     # nothing new
+
+    def test_publish_applies_carried_sibling_delta(self, tmp_path):
+        """A publishes drain; B (not yet synced) publishes a reload —
+        the merge carries A's drain forward AND fires B's apply
+        callback for it, so the latch is never silently lost."""
+        spool = str(tmp_path)
+        seen_b = []
+        a = WorkerCoherence(self._hub(spool), lambda n, p: None)
+        b = WorkerCoherence(self._hub(spool),
+                            lambda new, prev: seen_b.append((new, prev)))
+        a.adopt()
+        b.adopt()
+        a.publish(draining=True)
+        merged = b.publish(reloadSeq=1)
+        assert merged["draining"] is True and merged["reloadSeq"] == 1
+        assert seen_b and seen_b[-1][0]["draining"] is True
+        assert seen_b[-1][1]["draining"] is False
+        assert b.sync_once() is False     # already applied
+
+    def test_next_reload_seq_sees_unsynced_spool(self, tmp_path):
+        spool = str(tmp_path)
+        a = WorkerCoherence(self._hub(spool), lambda n, p: None)
+        b = WorkerCoherence(self._hub(spool), lambda n, p: None)
+        a.publish(reloadSeq=a.next_reload_seq())
+        assert b.next_reload_seq() == 2   # spool ahead of local state
+
+    def test_junk_document_degrades_to_defaults(self, tmp_path):
+        from predictionio_tpu.serving.workers import _normalize
+
+        assert _normalize(None) == {"reloadSeq": 0, "draining": False,
+                                    "retrieval": None}
+        assert _normalize({"reloadSeq": "9", "draining": 3,
+                           "retrieval": 7})["reloadSeq"] == 0
+
+    def test_adopt_marks_applied_without_callback(self, tmp_path):
+        spool = str(tmp_path)
+        a = WorkerCoherence(self._hub(spool), lambda n, p: None)
+        a.publish(reloadSeq=3, draining=True)
+        fired = []
+        c = WorkerCoherence(self._hub(spool),
+                            lambda n, p: fired.append(n))
+        adopted = c.adopt()
+        assert adopted["reloadSeq"] == 3 and adopted["draining"] is True
+        assert fired == []
+        assert c.sync_once() is False
+
+
+class TestResultCacheGenerationPin:
+    def test_invalidate_to_explicit_generation_is_monotonic(self):
+        cache = ResultCache()
+        cache.invalidate(generation=5)
+        assert cache.generation == 5
+        cache.invalidate(generation=3)    # lagging doc cannot rewind
+        assert cache.generation == 6
+        cache.invalidate()
+        assert cache.generation == 7
+
+    def test_stale_put_guard_spans_explicit_generations(self):
+        cache = ResultCache()
+        _, _, gen = cache.lookup("k")
+        cache.invalidate(generation=4)
+        assert cache.put("k", "v", generation=gen) is False
+        assert cache.put("k", "v", generation=4) is True
+
+
+# ---------------------------------------------------------------------------
+# checkpoint mmap (the model-sharing satellite)
+# ---------------------------------------------------------------------------
+
+class TestCheckpointMmap:
+    @pytest.fixture(autouse=True)
+    def _force_npz(self, monkeypatch):
+        # mmap is the npz backend's feature; force it even where orbax
+        # is importable (the same approach as test_persistence_extras)
+        from predictionio_tpu.utils import checkpoint as ckpt
+
+        monkeypatch.setattr(ckpt, "_ocp", lambda: None)
+
+    def _save(self, tmp_path):
+        from predictionio_tpu.utils import checkpoint as ckpt
+
+        arrays = {
+            "user": np.arange(24, dtype=np.float32).reshape(6, 4),
+            "item": np.ones((3, 4), dtype=np.float32) * 2.5,
+        }
+        directory = tmp_path / "ckpt"
+        assert ckpt.save_sharded(str(directory), arrays) == "npz"
+        return str(directory), arrays
+
+    def test_mmap_round_trip_equals_eager(self, tmp_path):
+        from predictionio_tpu.utils import checkpoint as ckpt
+
+        directory, arrays = self._save(tmp_path)
+        eager = ckpt.load_sharded(directory)
+        mapped = ckpt.load_sharded(directory, mmap_mode="r")
+        for name in arrays:
+            np.testing.assert_array_equal(eager[name], mapped[name])
+            assert isinstance(mapped[name], np.memmap)
+            assert mapped[name].dtype == arrays[name].dtype
+
+    def test_mmap_verifies_shape_and_dtype_headers(self, tmp_path):
+        import predictionio_tpu.utils.checkpoint as ckpt
+
+        directory, _ = self._save(tmp_path)
+        meta_path = os.path.join(directory, "checkpoint_meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        meta["arrays"]["user"]["shape"] = [5, 4]
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_sharded(directory, mmap_mode="r")
+
+    def test_mmap_skips_content_checksum_by_policy(self, tmp_path):
+        """The documented trade-off: a flipped byte fails the eager
+        load's checksum but not the header-only mmap verification —
+        operators who need the content check load eagerly."""
+        import predictionio_tpu.utils.checkpoint as ckpt
+
+        directory, _ = self._save(tmp_path)
+        meta_path = os.path.join(directory, "checkpoint_meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        meta["arrays"]["user"]["sha256"] = "0" * 64
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        ckpt.load_sharded(directory, mmap_mode="r")      # headers fine
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_sharded(directory)                 # content caught
+
+    def test_unmappable_payload_falls_back_to_eager(self, tmp_path,
+                                                    caplog):
+        """A compressed payload (not produced by save_sharded, but a
+        valid npz) degrades to the eager verified load with a warning —
+        the knob can never brick a deploy."""
+        import predictionio_tpu.utils.checkpoint as ckpt
+
+        directory, arrays = self._save(tmp_path)
+        with open(os.path.join(directory, "checkpoint_meta.json")) as f:
+            payload = json.load(f)["payload"]
+        with open(os.path.join(directory, payload), "wb") as f:
+            np.savez_compressed(f, **arrays)
+        with caplog.at_level(logging.WARNING,
+                             logger="predictionio_tpu.utils.checkpoint"):
+            out = ckpt.load_sharded(directory, mmap_mode="r")
+        assert any("falling back" in r.message for r in caplog.records)
+        np.testing.assert_array_equal(out["user"], arrays["user"])
+
+    def test_env_default_turns_mmap_on(self, tmp_path, monkeypatch):
+        from predictionio_tpu.utils import checkpoint as ckpt
+
+        directory, _ = self._save(tmp_path)
+        monkeypatch.setenv("PIO_CHECKPOINT_MMAP", "r")
+        assert ckpt.default_mmap_mode() == "r"
+        out = ckpt.load_sharded(directory)
+        assert isinstance(out["user"], np.memmap)
+        monkeypatch.setenv("PIO_CHECKPOINT_MMAP", "off")
+        assert ckpt.default_mmap_mode() is None
+
+    def test_missing_payload_still_corrupt_error_under_mmap(
+            self, tmp_path):
+        import predictionio_tpu.utils.checkpoint as ckpt
+
+        directory, _ = self._save(tmp_path)
+        with open(os.path.join(directory, "checkpoint_meta.json")) as f:
+            payload = json.load(f)["payload"]
+        os.unlink(os.path.join(directory, payload))
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_sharded(directory, mmap_mode="r")
+
+
+# ---------------------------------------------------------------------------
+# knobs + observability satellites
+# ---------------------------------------------------------------------------
+
+class TestWorkerKnobs:
+    def test_workers_env_default(self, monkeypatch):
+        monkeypatch.setenv("PIO_SERVING_WORKERS", "4")
+        assert ServerConfig().workers == 4
+        monkeypatch.setenv("PIO_SERVING_WORKERS", "junk")
+        assert ServerConfig().workers == 1    # degrade, don't die
+
+    def test_deploy_parser_accepts_worker_flags(self):
+        from predictionio_tpu.cli.pio import build_parser
+        import predictionio_tpu.workflow.cli_commands  # noqa: F401
+        from predictionio_tpu.cli.pio import _EXTRA_PARSERS
+
+        parser = build_parser()
+        for name, configure in _EXTRA_PARSERS:
+            configure(parser.subparsers)
+        args = parser.parse_args(
+            ["deploy", "--workers", "2", "--supervise", "--model-mmap"])
+        assert args.workers == 2
+        assert args.supervise is True
+        assert args.model_mmap is True
+
+    def test_resolve_concrete_port(self):
+        from predictionio_tpu.cli.pio import resolve_concrete_port
+
+        assert resolve_concrete_port("127.0.0.1", 8123) == 8123
+        port = resolve_concrete_port("127.0.0.1", 0)
+        assert port > 0
+
+
+class TestAccessLogWorkerId:
+    def test_query_lines_carry_worker_field(self, storage):
+        _train(storage)
+
+        class Capture(logging.Handler):
+            def __init__(self):
+                super().__init__()
+                self.lines = []
+
+            def emit(self, record):
+                self.lines.append(json.loads(record.getMessage()))
+
+        capture = Capture()
+        access = logging.getLogger("pio.access")
+        access.addHandler(capture)
+        access.setLevel(logging.INFO)
+        (w1,), port, _ = _worker_pool(storage, n=1, access_log=True)
+        try:
+            _post_query(port, {"x": 1})
+            lines = [l for l in capture.lines
+                     if l.get("path") == "/queries.json"]
+            assert lines and lines[0]["worker"] == w1.service.worker_id
+        finally:
+            access.removeHandler(capture)
+            w1.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptance: kill -9 a worker under --supervise
+# ---------------------------------------------------------------------------
+
+class TestChaosWorkerPool:
+    def test_kill9_worker_respawned_zero_5xx_back_in_metrics(self):
+        """Live load over the shared SO_REUSEPORT port, kill -9 one of
+        two REAL worker processes under supervision: zero served 5xx
+        (ripped connections are transport errors, the kernel routes new
+        ones to the survivor), the supervisor respawns a clean
+        incarnation, and the merged /metrics folds it back in."""
+        from predictionio_tpu.fleet.supervisor import (
+            WORKER,
+            FleetSupervisor,
+            SpawnSpec,
+            SupervisorConfig,
+        )
+
+        port = free_port()
+        spool = tempfile.mkdtemp(prefix="pio-test-serving-chaos-")
+
+        def spawn(tag):
+            def _spawn():
+                return subprocess.Popen(
+                    [sys.executable, WORKER_CHILD,
+                     "--port", str(port), "--spool", spool,
+                     "--tag", tag])
+            return _spawn
+
+        sup = FleetSupervisor(
+            [SpawnSpec(id="worker:0", spawn=spawn("w0"), role=WORKER),
+             SpawnSpec(id="worker:1", spawn=spawn("w1"), role=WORKER)],
+            SupervisorConfig(
+                poll_interval_s=0.1, unhealthy_after=0,
+                backoff_base_s=0.2, backoff_max_s=1.0,
+                crash_loop_threshold=5, crash_loop_window_s=60.0,
+                term_grace_s=5.0))
+        sup.start()
+        try:
+            # both workers genuinely serving: a streak of fresh-
+            # connection successes spanning the SO_REUSEPORT spread
+            def pool_up():
+                try:
+                    return (_get_json(port, "/stats.json")
+                            ["workers"]["count"] == 2)
+                except OSError:
+                    return False
+            wait_until(pool_up, timeout=30, message="pool settled")
+            streak = 0
+            deadline = time.time() + 20.0
+            while streak < 10 and time.time() < deadline:
+                try:
+                    status, _ = _post_query(port, {"warm": streak})
+                    streak = streak + 1 if status == 200 else 0
+                except OSError:
+                    streak = 0
+            assert streak >= 10, "pool never settled"
+
+            statuses: list[int] = []
+            transport_errors: list[str] = []
+            lock = threading.Lock()
+            stop_load = threading.Event()
+
+            def client(cid: int) -> None:
+                i = 0
+                while not stop_load.is_set():
+                    try:
+                        status, _ = _post_query(port,
+                                                {"cid": cid, "i": i})
+                        with lock:
+                            statuses.append(status)
+                    except OSError as exc:
+                        # a killed worker rips live connections out from
+                        # under clients — transport errors, not 5xx
+                        with lock:
+                            transport_errors.append(repr(exc))
+                    i += 1
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(4)]
+            for t in threads:
+                t.start()
+
+            time.sleep(0.5)                        # load flowing
+            victim_pid = sup.child_pid("worker:1")
+            os.kill(victim_pid, signal.SIGKILL)
+            time.sleep(1.5)                        # load over the corpse
+            stop_load.set()
+            for t in threads:
+                t.join(timeout=20)
+
+            assert len(statuses) > 30
+            fives = [s for s in statuses if s >= 500]
+            assert fives == [], f"{len(fives)} 5xx of {len(statuses)}"
+
+            wait_until(
+                lambda: sup.child_pid("worker:1") not in
+                (None, victim_pid),
+                timeout=30, message="worker respawned")
+
+            def merged_back():
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/metrics",
+                            timeout=10) as r:
+                        families = parse_prometheus(r.read().decode())
+                    return families["pio_serving_workers"]["samples"][
+                        ("pio_serving_workers", ())] == 2.0
+                except OSError:
+                    return False
+            wait_until(merged_back, timeout=30,
+                       message="restored worker in merged /metrics")
+            assert sup.snapshot()["respawns"] >= 1
+            assert not sup.crash_looped()
+        finally:
+            sup.shutdown()
+            import shutil
+
+            shutil.rmtree(spool, ignore_errors=True)
