@@ -776,6 +776,75 @@ def bench_data_plane():
     return bench_ingest.bench_section()
 
 
+def bench_train_profile():
+    """Tiny `pio train --profile` on the recommendation template — the
+    device/compiler observability trajectory (PR 12,
+    docs/observability.md "Device and compiler observability"): the
+    artifact carries MFU (null where no peak-FLOPs entry exists —
+    honest-or-nothing), cumulative XLA compile seconds, and the compile
+    count, so a drift in the compile story (a new shape sneaking into
+    the menu, a program that stopped caching) shows round-over-round.
+    Cheap enough to run under --skip-heavy."""
+    import os
+    import tempfile
+
+    from predictionio_tpu.core.datamap import DataMap
+    from predictionio_tpu.core.event import Event
+    from predictionio_tpu.obs.compile import recorder
+    from predictionio_tpu.obs.device import TrainProfiler
+    from predictionio_tpu.storage.base import App
+    from predictionio_tpu.utils.testing import memory_storage
+    from predictionio_tpu.workflow.train import run_train
+
+    storage = memory_storage()
+    app_id = storage.get_meta_data_apps().insert(App(0, "BenchProfApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(5)
+    for u in range(32):
+        for i in range(24):
+            if rng.random() < 0.4:
+                events.insert(
+                    Event(event="rate", entity_type="user",
+                          entity_id=f"u{u}", target_entity_type="item",
+                          target_entity_id=f"i{i}",
+                          properties=DataMap(
+                              {"rating": float(rng.integers(1, 6))})),
+                    app_id)
+    variant = {
+        "id": "bench-profile",
+        "engineFactory":
+            "predictionio_tpu.templates.recommendation.engine_factory",
+        "datasource": {"params": {"app_name": "BenchProfApp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 8, "num_iterations": 3,
+                                   "lambda_": 0.05, "seed": 4}}],
+    }
+    recorder().reset()
+    with tempfile.TemporaryDirectory() as model_dir:
+        old = os.environ.get("PIO_MODEL_DIR")
+        os.environ["PIO_MODEL_DIR"] = model_dir
+        try:
+            outcome = run_train(variant=variant, storage=storage,
+                                profiler=TrainProfiler())
+        finally:
+            if old is None:
+                os.environ.pop("PIO_MODEL_DIR", None)
+            else:
+                os.environ["PIO_MODEL_DIR"] = old
+    report = outcome.report
+    recorder().reset()
+    mfu = report["mfu"]
+    return {
+        "train_profile_mfu": (round(mfu, 6) if isinstance(mfu, float)
+                              else None),
+        "train_profile_compile_seconds": round(
+            report["compile"]["totalSeconds"], 3),
+        "train_profile_compiles": report["compile"]["totalCompiles"],
+        "train_profile_wall_seconds": round(report["wallSeconds"], 3),
+    }
+
+
 def bench_batch_predict(n_items: int = 2_000_000, batch: int = 256,
                         rounds: int = 8):
     """Batched top-k scoring against a 2M-item catalog — the eval hot
@@ -1214,6 +1283,7 @@ def main() -> None:
          lambda: bench_ann_retrieval(shrunk=args.skip_heavy)),
         ("workers_scaling",
          lambda: bench_workers_scaling(shrunk=args.skip_heavy)),
+        ("train_profile", bench_train_profile),
     ]
     failed = []
     if args.skip_heavy:
@@ -1221,9 +1291,10 @@ def main() -> None:
         # artifact — the completeness marker must say so. data_plane
         # stays: it is CPU+storage bound like ingest, no device needed;
         # ann_retrieval runs SHRUNK (one small indexable catalog), and
-        # workers_scaling SHRUNK (small catalog, no 1M ANN re-run)
+        # workers_scaling SHRUNK (small catalog, no 1M ANN re-run);
+        # train_profile is a seconds-scale tiny train either way
         keep = ("quality", "ingest", "data_plane", "ann_retrieval",
-                "workers_scaling")
+                "workers_scaling", "train_profile")
         failed.extend(s[0] for s in sections if s[0] not in keep)
         sections = [s for s in sections if s[0] in keep]
     for section, fn in sections:

@@ -174,7 +174,13 @@ def default_config() -> LintConfig:
                     "no_import_ok": ["pgwire.py"],
                 },
             ),
-            "jit-purity": RuleConfig(paths=COMPUTE_PATHS),
+            # the device/compiler observability layer rides along
+            # (PR 12): obs/compile.py wraps the jit entry points and
+            # obs/device.py prices their programs — any jitted helper
+            # growing there must obey the same purity contract as the
+            # compute modules it instruments
+            "jit-purity": RuleConfig(
+                paths=COMPUTE_PATHS + ("obs/compile.py", "obs/device.py")),
             "host-sync-in-hot-path": RuleConfig(paths=HOT_PATHS),
             "dtype-discipline": RuleConfig(paths=COMPUTE_PATHS),
             # storage/ included: the deleted PR 1 test pinned pgwire's

@@ -9,10 +9,13 @@ latent serving bugs, so they are banned outright in the compute
 modules. (Use ``jax.debug.print`` / ``jax.debug.callback`` for traced
 effects and ``jax.random`` for randomness — both are allowed.)
 
-Detected jit entry points: ``@jax.jit`` / ``@jit`` / ``@pjit``
-decorators, ``@partial(jax.jit, ...)`` (any alias of partial), and
-local functions passed by name to a ``jax.jit(fn)`` call. The whole
-body including nested defs is policed — everything inside is traced.
+Detected jit entry points: ``@jax.jit`` / ``@jit`` / ``@pjit`` /
+``@instrumented_jit`` (the recompile sentinel's wrapper,
+obs/compile.py — it IS jax.jit plus counters, so its bodies are traced
+exactly the same) decorators, ``@partial(jax.jit, ...)`` (any alias of
+partial), and local functions passed by name to a ``jax.jit(fn)``
+call. The whole body including nested defs is policed — everything
+inside is traced.
 """
 
 from __future__ import annotations
@@ -34,18 +37,22 @@ FORBIDDEN_PREFIXES = (
 )
 
 
+#: decorator/callable last-components that mean "this body is traced"
+_JIT_NAMES = ("jit", "pjit", "instrumented_jit")
+
+
 def _decorator_is_jit(dec: ast.expr) -> bool:
     name = Rule.dotted_name(dec)
     if name is not None:
-        return name.split(".")[-1] in ("jit", "pjit")
+        return name.split(".")[-1] in _JIT_NAMES
     if isinstance(dec, ast.Call):
         fn_name = Rule.dotted_name(dec.func) or ""
-        if fn_name.split(".")[-1] in ("jit", "pjit"):
+        if fn_name.split(".")[-1] in _JIT_NAMES:
             return True
         # partial(jax.jit, ...) under any partial alias
         if fn_name.split(".")[-1].lstrip("_") == "partial" and dec.args:
             inner = Rule.dotted_name(dec.args[0]) or ""
-            return inner.split(".")[-1] in ("jit", "pjit")
+            return inner.split(".")[-1] in _JIT_NAMES
     return False
 
 
@@ -66,7 +73,7 @@ class JitPurityRule(Rule):
             if not isinstance(node, ast.Call):
                 continue
             fn_name = self.dotted_name(node.func) or ""
-            if fn_name.split(".")[-1] in ("jit", "pjit"):
+            if fn_name.split(".")[-1] in _JIT_NAMES:
                 for arg in node.args[:1]:
                     if isinstance(arg, ast.Name):
                         wrapped_names.add(arg.id)

@@ -100,6 +100,12 @@ from predictionio_tpu.obs.aggregate import (
     parse_exposition,
     source_count_metric,
 )
+from predictionio_tpu.obs.compile import compile_metrics_collector
+from predictionio_tpu.obs.compile import recorder as compile_recorder
+from predictionio_tpu.obs.device import (
+    device_memory_collector,
+    train_report_collector,
+)
 from predictionio_tpu.obs.exporter import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
 from predictionio_tpu.obs.exporter import render_metrics, render_prometheus
 from predictionio_tpu.obs.registry import (
@@ -340,6 +346,20 @@ class EngineService:
         #: fresh model objects
         self._wire_ann_observers()
         self.registry.register(self._ann_mode_collector)
+        #: device/compiler observability (docs/observability.md "Device
+        #: and compiler observability"): the recompile sentinel's
+        #: counters (pio_jit_compiles_total / pio_serving_recompile_total),
+        #: device memory gauges (absent on backends without
+        #: memory_stats), and the last profiled train's MFU/HBM gauges.
+        #: Warmup is marked when this deployment answers its FIRST
+        #: query — every later compile is a live request paying the
+        #: XLA cliff and counts as a serving recompile with a WARN
+        #: (operators warm their batch widths before fronting traffic;
+        #: runbook in docs/observability.md)
+        self.registry.register(compile_metrics_collector())
+        self.registry.register(device_memory_collector())
+        self.registry.register(train_report_collector())
+        self._compile_warmup_marked = False
         #: deadline enforcement for the NON-batched path: the query runs
         #: on a pool thread so a blown budget returns 503 instead of
         #: holding the socket (threads spawn lazily; idle pool is free)
@@ -900,6 +920,10 @@ class EngineService:
             "clientDisconnects": self.client_disconnects(),
             "annEnabled": self.ann_enabled(),
             "retrieval": self.config.retrieval,
+            # the recompile sentinel's view (docs/observability.md):
+            # compiles, cumulative compile seconds, post-warmup
+            # serving recompiles — per-process like the jit caches
+            "compile": compile_recorder().stats_doc(),
             "serving": self.serving_stats.snapshot(),
             "batching": (
                 {"enabled": True, **self.batcher.policy.snapshot()}
@@ -1043,6 +1067,13 @@ class EngineService:
             pr_id = pr_id_in or uuid.uuid4().hex
             response["prId"] = pr_id
             self._post_feedback(pr_id, body, response)
+        if not self._compile_warmup_marked:
+            # the first answered query ends serving warmup: from here
+            # on, any jit compile under a request is an incident the
+            # recompile sentinel WARNs about (a benign double-mark race
+            # is fine — mark_warmup_complete is idempotent)
+            self._compile_warmup_marked = True
+            compile_recorder().mark_warmup_complete()
         return (200, response)
 
     def _query_with_deadline(self, query: Any, budget: float) -> Any:

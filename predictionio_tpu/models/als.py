@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.obs.compile import instrumented_jit
 from predictionio_tpu.ops import ann as ann_ops
 from predictionio_tpu.ops import topk as topk_ops
 from predictionio_tpu.utils.bimap import BiMap, EntityIdIxMap
@@ -40,7 +41,7 @@ _SEEN_PAD = 512
 _ANN_SUBDIR = "ann"
 
 
-@_partial(jax.jit, static_argnames=("k",))
+@_partial(instrumented_jit, static_argnames=("k",))
 def _serve_recommend(user_factors, item_f, packed, allow, k):
     """Single-dispatch, single-transfer serving path.
 
@@ -60,7 +61,7 @@ def _serve_recommend(user_factors, item_f, packed, allow, k):
         [jax.lax.bitcast_convert_type(vals[0], jnp.int32), idxs[0]])
 
 
-@_partial(jax.jit, static_argnames=("k", "nprobe", "rescore"))
+@_partial(instrumented_jit, static_argnames=("k", "nprobe", "rescore"))
 def _serve_recommend_ann(user_factors, item_f, centroids, flat_items,
                          flat_vecs, cell_offset, packed, allow, k, nprobe,
                          rescore):
@@ -82,7 +83,7 @@ def _serve_recommend_ann(user_factors, item_f, centroids, flat_items,
         [jax.lax.bitcast_convert_type(vals[0], jnp.int32), idxs[0]])
 
 
-@_partial(jax.jit, static_argnames=("k", "nprobe", "rescore"))
+@_partial(instrumented_jit, static_argnames=("k", "nprobe", "rescore"))
 def _serve_similar_ann(item_f, centroids, flat_items, flat_vecs,
                        cell_offset, packed, allow, k, nprobe, rescore):
     """ANN twin of :func:`_serve_similar`: cosine probe + exact cosine
@@ -101,7 +102,7 @@ def _serve_similar_ann(item_f, centroids, flat_items, flat_vecs,
         [jax.lax.bitcast_convert_type(vals[0], jnp.int32), idxs[0]])
 
 
-@_partial(jax.jit, static_argnames=("k",))
+@_partial(instrumented_jit, static_argnames=("k",))
 def _serve_similar(item_f, packed, allow, k):
     """Single-dispatch, single-transfer similar-items path. Upload is one
     int32 buffer [n_real, query_ixs(_SEEN_PAD)]; the query vector is the

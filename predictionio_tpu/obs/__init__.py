@@ -19,7 +19,13 @@ library for the privilege of being measured. Seven layers:
 - :mod:`~predictionio_tpu.obs.stitch` — cross-process trace stitching
   plus text/Chrome-trace renderers (``pio trace``);
 - :mod:`~predictionio_tpu.obs.slo` — declarative SLOs evaluated into
-  multi-window burn-rate gauges and the fleet-pressure signal.
+  multi-window burn-rate gauges and the fleet-pressure signal;
+- :mod:`~predictionio_tpu.obs.compile` — the recompile sentinel:
+  ``instrumented_jit`` wraps the package's jit entry points and turns
+  post-warmup serving compiles into counters, WARNs and trace spans;
+- :mod:`~predictionio_tpu.obs.device` — device memory gauges, the
+  peak-FLOPs table, and the ``pio train --profile`` profiler
+  (TRAIN_REPORT.json + MFU/HBM gauges).
 
 The fan-out I/O that feeds aggregate/stitch lives in the FLEET tier
 (fleet/workers.py, api/router_server.py) — obs/ itself stays pure
@@ -37,6 +43,19 @@ from predictionio_tpu.obs.aggregate import (
     parse_exposition,
     relabel,
     unescape_label_value,
+)
+from predictionio_tpu.obs.compile import (
+    CompileRecorder,
+    compile_metrics_collector,
+    instrumented_jit,
+)
+from predictionio_tpu.obs.device import (
+    TrainProfiler,
+    device_memory_collector,
+    device_memory_snapshot,
+    resolve_peak_flops,
+    summarize_train_report,
+    train_report_collector,
 )
 from predictionio_tpu.obs.exporter import (
     escape_label_value,
@@ -74,6 +93,7 @@ from predictionio_tpu.obs.trace import (
 )
 
 __all__ = [
+    "CompileRecorder",
     "HistogramFamily",
     "LatencyHistogram",
     "Metric",
@@ -84,8 +104,13 @@ __all__ = [
     "TRACE_ID_HEADER",
     "Trace",
     "TraceLog",
+    "TrainProfiler",
     "active_trace",
+    "compile_metrics_collector",
+    "device_memory_collector",
+    "device_memory_snapshot",
     "escape_label_value",
+    "instrumented_jit",
     "fleet_pressure",
     "ingest_collector",
     "merge_snapshots",
@@ -97,13 +122,16 @@ __all__ = [
     "render_prometheus",
     "render_tree",
     "resilience_collector",
+    "resolve_peak_flops",
     "server_info_collector",
     "serving_collector",
     "serving_pressure_collector",
     "span",
     "start_trace",
     "stitch",
+    "summarize_train_report",
     "to_chrome_trace",
+    "train_report_collector",
     "tracing_default",
     "unescape_label_value",
     "use_trace",

@@ -118,7 +118,7 @@ class Trace:
 
     __slots__ = ("trace_id", "name", "request_id", "parent_span_id",
                  "service", "tags", "_t0", "_wall_start", "_spans",
-                 "_span_seq", "_span_prefix", "_duration")
+                 "_span_seq", "_span_prefix", "_duration", "observer")
 
     def __init__(self, name: str, request_id: str | None = None,
                  trace_id: str | None = None,
@@ -145,6 +145,11 @@ class Trace:
         self._span_seq = itertools.count()
         self._span_prefix = f"{_SPAN_ID_PREFIX}{next(_SPAN_SEG_SEQ):x}"
         self._duration: float | None = None
+        #: optional span-completion callback ``(name, start_off_s,
+        #: dur_s)`` — the train profiler samples device memory as each
+        #: DASE stage closes (obs/device.TrainProfiler). Exceptions are
+        #: swallowed: an observer must never fail the traced work.
+        self.observer = None
 
     # -- span recording ------------------------------------------------------
     def span(self, name: str, parent_id: str = _ROOT_PARENT) -> "_ActiveSpan":
@@ -175,6 +180,13 @@ class Trace:
         self._spans.append(
             (name, parent_id, span_id,
              start_perf - self._t0, max(0.0, end_perf - start_perf)))
+        observer = self.observer
+        if observer is not None:
+            try:
+                observer(name, start_perf - self._t0,
+                         max(0.0, end_perf - start_perf))
+            except Exception:
+                pass
         return span_id
 
     def finish(self, **tags: Any) -> None:
@@ -183,6 +195,18 @@ class Trace:
             self.tags.update(tags)
 
     # -- views ---------------------------------------------------------------
+    @property
+    def start_perf(self) -> float:
+        """The ``time.perf_counter`` origin span offsets are relative
+        to — lets external clock readings (the recompile sentinel's
+        compile events) be binned into this trace's spans."""
+        return self._t0
+
+    def spans(self) -> list[tuple[str, str, str, float, float]]:
+        """Atomic copy of the raw span records ``(name, parent_id,
+        span_id, start_off_s, dur_s)`` (the Trace read contract)."""
+        return list(self._spans)
+
     def stage_seconds(self) -> dict[str, float]:
         """Total seconds per span name, insertion-ordered — the
         ``pio train`` stage breakdown."""

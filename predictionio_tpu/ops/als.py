@@ -46,6 +46,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.obs.compile import instrumented_jit
+
 logger = logging.getLogger(__name__)
 
 
@@ -893,7 +895,7 @@ def _normal_eq_solve(V, c, v, d, lam, alpha, gram, implicit, mm, prec,
     return jnp.where(d[:, None] > 0, x, 0.0)
 
 
-@partial(jax.jit,
+@partial(instrumented_jit,
          static_argnames=("implicit", "bf16", "lam", "alpha", "cg_steps",
                           "solver", "cg_bf16"),
          donate_argnums=())
@@ -937,12 +939,12 @@ def _solve_slabs(
     return X  # (S, B, K)
 
 
-@jax.jit
+@instrumented_jit
 def _gramian(V: jax.Array) -> jax.Array:
     return jnp.einsum("ik,im->km", V, V, precision=_HI)
 
 
-@partial(jax.jit,
+@partial(instrumented_jit,
          static_argnames=("implicit", "bf16", "num_rows", "lam", "alpha",
                           "cg_steps", "cg_bf16"))
 def _solve_half_chunked(
@@ -1066,7 +1068,7 @@ def _solve_half_fused(V, buckets, lam, alpha, implicit, num_rows, bf16,
     return out
 
 
-@partial(jax.jit,
+@partial(instrumented_jit,
          static_argnames=("iterations", "lam", "alpha", "implicit",
                           "num_users", "num_items", "bf16", "cg_steps",
                           "solver", "mesh", "shard_factors", "cg_bf16"),
@@ -1517,7 +1519,7 @@ def als_train(
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
+@instrumented_jit
 def predict_ratings(user_f: jax.Array, item_f: jax.Array,
                     users: jax.Array, items: jax.Array) -> jax.Array:
     """Pointwise predicted ratings for (user, item) pairs."""

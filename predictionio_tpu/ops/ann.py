@@ -57,6 +57,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.obs.compile import instrumented_jit
+
 from predictionio_tpu.ops.topk import NEG_INF
 
 #: below this catalog size the flat matmul beats any probe+gather trip
@@ -435,7 +437,7 @@ def _ann_topk_impl(user_vecs, item_f, centroids, flat_items, flat_vecs,
     return _finish(cand, scores, k, item_f.shape[0])
 
 
-@partial(jax.jit, static_argnames=("k", "nprobe", "rescore"))
+@partial(instrumented_jit, static_argnames=("k", "nprobe", "rescore"))
 def ann_topk(
     user_vecs: jax.Array,    # (B, K) query user factors
     item_f: jax.Array,       # (I, K) item factor table (the brute table)
@@ -486,7 +488,7 @@ def ann_topk(
     return jax.lax.map(one, xs)
 
 
-@partial(jax.jit, static_argnames=("k", "nprobe", "rescore"))
+@partial(instrumented_jit, static_argnames=("k", "nprobe", "rescore"))
 def ann_similar_topk(
     query_vecs: jax.Array,   # (B, K) query item factors (unnormalized)
     item_f: jax.Array,       # (I, K)

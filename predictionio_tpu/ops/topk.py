@@ -18,10 +18,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.obs.compile import instrumented_jit
+
 NEG_INF = jnp.float32(-jnp.inf)
 
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(instrumented_jit, static_argnames=("k",))
 def topk_scores(scores: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     """(values, indices) of the top-k per row. ``k`` beyond the
     candidate count clamps (fewer columns back, never an XLA assert) —
@@ -31,7 +33,7 @@ def topk_scores(scores: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     return jax.lax.top_k(scores, min(k, scores.shape[-1]))
 
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(instrumented_jit, static_argnames=("k",))
 def recommend_topk(
     user_vecs: jax.Array,    # (B, K) query user factors
     item_f: jax.Array,       # (I, K) item factor table
@@ -57,7 +59,7 @@ def recommend_topk(
     return jax.lax.top_k(scores, min(k, scores.shape[-1]))
 
 
-@partial(jax.jit, static_argnames=("k", "chunk"))
+@partial(instrumented_jit, static_argnames=("k", "chunk"))
 def recommend_topk_chunked(
     user_vecs: jax.Array,    # (B, K)
     item_f: jax.Array,       # (I, K)
@@ -309,10 +311,12 @@ def _sharded_topk_fn(mesh, k: int, shard_rows: int):
     # the all-gather makes both outputs replicated over "model", which
     # the static replication checker cannot infer — disable it (the
     # jax_compat shim normalizes the check_rep -> check_vma rename)
-    return jax.jit(shard_map(local, mesh=mesh, check_vma=False, **specs))
+    return instrumented_jit(
+        shard_map(local, mesh=mesh, check_vma=False, **specs),
+        jit_name="sharded_topk")
 
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(instrumented_jit, static_argnames=("k",))
 def similar_topk(
     query_vecs: jax.Array,   # (B, K) query item factors
     item_f: jax.Array,       # (I, K)

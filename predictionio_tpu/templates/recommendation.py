@@ -377,7 +377,12 @@ class ALSAlgorithm(ShardedAlgorithm):
         # batch sizes, so without this a varying-concurrency workload
         # compiles forever instead of dispatching (padding rows repeat
         # row 0 and are sliced off the result). Eval-scale batches
-        # pass through unpadded (serving_batch docstring)
+        # pass through unpadded (serving_batch docstring). The
+        # recompile sentinel (obs/compile.py) watches this contract in
+        # production: a post-warmup width that misses the compiled
+        # menu counts on pio_serving_recompile_total with a WARN, and
+        # tests/test_compile_obs.py pins on-menu == zero /
+        # off-menu == one through this exact path
         padB = topk_ops.serving_batch(B)
         if padB != B:
             uixs = np.concatenate(

@@ -33,6 +33,19 @@ def force_cpu_devices(n: int) -> None:
         clear_backends()
 
 
+def sqlite_supports_returning() -> bool:
+    """Whether this interpreter's bundled SQLite understands the
+    ``RETURNING`` clause (3.35.0+, 2021). The channels DAO — and the PG
+    wire emulator, which is backed by the same library — issue
+    ``INSERT ... RETURNING id``; containers shipping an older libsqlite
+    cannot run those paths at all, so their tests capability-skip with
+    this check instead of failing on a syntax error (a container
+    artifact, not a regression)."""
+    import sqlite3
+
+    return sqlite3.sqlite_version_info >= (3, 35, 0)
+
+
 def memory_storage():
     """A fresh all-in-memory Storage (the three repositories on the MEM
     source) — the standard test storage, analogous to the reference's

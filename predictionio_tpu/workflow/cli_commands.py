@@ -94,6 +94,18 @@ def _configure_train(sub) -> None:
     p.add_argument("--stop-after-read", action="store_true")
     p.add_argument("--stop-after-prepare", action="store_true")
     p.add_argument("--no-save-model", action="store_true", dest="no_save_model")
+    p.add_argument("--profile", action="store_true",
+                   help="profile the run: per-stage wall/compile/execute "
+                        "split, MFU, HBM peaks and the recompile table, "
+                        "written to TRAIN_REPORT.json (docs/observability.md "
+                        "'Device and compiler observability')")
+    p.add_argument("--profile-dir", default="",
+                   help="with --profile: also dump a jax.profiler trace "
+                        "into this directory for deep dives (TensorBoard/"
+                        "Perfetto); implies --profile")
+    p.add_argument("--profile-out", default="TRAIN_REPORT.json",
+                   help="where --profile writes the report "
+                        "(default: ./TRAIN_REPORT.json)")
 
 
 def _cmd_train(args, storage) -> int:
@@ -114,11 +126,17 @@ def _cmd_train(args, storage) -> int:
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
     )
+    profiler = None
+    if args.profile or args.profile_dir:
+        from predictionio_tpu.obs.device import TrainProfiler
+
+        profiler = TrainProfiler(profile_dir=args.profile_dir or None)
     outcome = run_train(
         engine_factory=args.engine_factory,
         variant=variant,
         workflow_params=wp,
         storage=storage,
+        profiler=profiler,
     )
     print(f"[INFO] Training finished: engine instance {outcome.instance_id} "
           f"({outcome.status})")
@@ -128,6 +146,24 @@ def _cmd_train(args, storage) -> int:
         # per-DASE-stage walltimes (docs/observability.md): where a
         # slow train actually spent its time
         print(f"[INFO] Stage times: {format_stage_times(outcome.stage_seconds)}")
+    if outcome.report is not None:
+        import json as _json
+
+        from predictionio_tpu.obs.device import summarize_train_report
+
+        print(f"[INFO] Train profile: {summarize_train_report(outcome.report)}")
+        try:
+            with open(args.profile_out, "w") as f:
+                _json.dump(outcome.report, f, indent=2)
+        except OSError as e:
+            # the train itself succeeded and the summary already
+            # printed — an unwritable report path must not turn a
+            # completed (and persisted) run into a failing exit code
+            print(f"[WARN] could not write {args.profile_out}: {e}")
+        else:
+            print(f"[INFO] Train report written to {args.profile_out}")
+        if args.profile_dir:
+            print(f"[INFO] jax.profiler trace in {args.profile_dir}")
     return 0 if outcome.status in ("COMPLETED", "INTERRUPTED") else 1
 
 

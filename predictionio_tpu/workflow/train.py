@@ -66,6 +66,10 @@ class TrainOutcome:
     #: per-DASE-stage walltimes (read/prepare/train/persist seconds),
     #: collected by the training trace (docs/observability.md)
     stage_seconds: dict[str, float] = dataclasses.field(default_factory=dict)
+    #: the TRAIN_REPORT document when the run was profiled
+    #: (``pio train --profile``; obs/device.TrainProfiler) — per-stage
+    #: wall/compile/execute split, MFU, HBM peaks, recompile table
+    report: dict[str, Any] | None = None
 
 
 def run_train(
@@ -76,12 +80,19 @@ def run_train(
     workflow_params: WorkflowParams = WorkflowParams(),
     storage: Storage | None = None,
     ctx: EngineContext | None = None,
+    profiler: Any | None = None,
 ) -> TrainOutcome:
     """Train one engine variant and persist the results.
 
     Either pass a constructed ``engine`` (tests, programmatic use) or an
     ``engine_factory`` spec string (CLI path). ``variant`` is the parsed
     engine.json; ``engine_params`` overrides it when given.
+
+    ``profiler`` (an :class:`~predictionio_tpu.obs.device.TrainProfiler`,
+    `pio train --profile`) binds to the training trace before the run
+    and its report lands on ``TrainOutcome.report``; it is always
+    closed, so an interrupted or failed run cannot leak a running
+    ``jax.profiler`` capture.
     """
     storage = storage or Storage.default()
     variant = dict(variant or {})
@@ -122,6 +133,8 @@ def run_train(
     # read/prepare/train stages against the ambient binding, persist is
     # timed here, and `pio train` prints the breakdown
     trace = Trace("train", request_id=instance_id)
+    if profiler is not None:
+        profiler.begin(trace)
     try:
         try:
             with use_trace(trace):
@@ -134,8 +147,10 @@ def run_train(
             )
             instances.update(interrupted)
             logger.info("engine instance %s: INTERRUPTED (%s)", instance_id, stop)
+            report = (profiler.finish(trace, instance_id, "INTERRUPTED")
+                      if profiler is not None else None)
             return TrainOutcome(instance_id, "INTERRUPTED", [],
-                                trace.stage_seconds())
+                                trace.stage_seconds(), report=report)
         with use_trace(trace), span("persist"):
             save_models(storage, instance_id, result.persisted)
         completed = dataclasses.replace(
@@ -147,8 +162,10 @@ def run_train(
         stage_seconds = trace.stage_seconds()
         logger.info("engine instance %s: COMPLETED (%s)", instance_id,
                     format_stage_times(stage_seconds))
+        report = (profiler.finish(trace, instance_id, "COMPLETED")
+                  if profiler is not None else None)
         return TrainOutcome(instance_id, "COMPLETED", result.models,
-                            stage_seconds)
+                            stage_seconds, report=report)
     except Exception:
         # training failures leave the instance non-COMPLETED
         # (CoreWorkflow.scala:68-73 only updates on success)
@@ -158,3 +175,8 @@ def run_train(
         instances.update(failed)
         logger.error("engine instance %s: FAILED\n%s", instance_id, traceback.format_exc())
         raise
+    finally:
+        if profiler is not None:
+            # idempotent: stops a still-running jax.profiler capture on
+            # the failure path (finish already ran on success)
+            profiler.finish(trace, instance_id, "FAILED")

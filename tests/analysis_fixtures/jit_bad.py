@@ -48,3 +48,24 @@ def logs_once(x):
     logger.warning("shape %s", x.shape)   # fires at trace time only
     return x
 
+
+
+from predictionio_tpu.obs.compile import instrumented_jit
+
+
+@partial(instrumented_jit, static_argnames=())
+def sentinel_partial_noise(x):
+    return x * time.time()           # instrumented_jit IS jax.jit
+
+
+@instrumented_jit
+def sentinel_decorated_print(x):
+    print("tracing")                 # policed under the sentinel too
+    return x
+
+
+def _sentinel_wrapped(x):
+    return x + random.random()
+
+
+instrumented_fast = instrumented_jit(_sentinel_wrapped, jit_name="w")

@@ -107,6 +107,16 @@ class TestJitPurityRule:
         assert "open() inside jit-compiled _wrapped()" in messages
         # the module-level `logger = logging.getLogger(...)` spelling
         assert "logger.warning() inside jit-compiled logs_once()" in messages
+        # the recompile sentinel's wrapper (obs/compile.instrumented_jit)
+        # is jax.jit plus counters — bodies under it stay policed in
+        # every spelling: @partial(instrumented_jit, ...), bare
+        # decorator, and functional wrapping
+        assert "time.time() inside jit-compiled sentinel_partial_noise()" \
+            in messages
+        assert "print() inside jit-compiled sentinel_decorated_print()" \
+            in messages
+        assert "random.random() inside jit-compiled _sentinel_wrapped()" \
+            in messages
 
     def test_good_fixture_clean(self):
         # jax.debug.print / jax.random / host timing outside jit all pass

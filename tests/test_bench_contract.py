@@ -89,8 +89,17 @@ def test_single_json_line_with_primary_contract(tiny_bench, capsys, monkeypatch)
                 "ingest_events_per_sec", "ingest_events_per_sec_stdev_pct",
                 "calibration_matmul_ms", "scan_speedup_x_sqlite",
                 "ingest_tx_speedup_x", "ann_speedup_100k_x",
-                "workers_scaling_2w_vs_1w_x", "workers_host_cores"):
+                "workers_scaling_2w_vs_1w_x", "workers_host_cores",
+                # train_profile runs REAL (tiny train, seconds): the
+                # device/compiler observability trajectory keys
+                "train_profile_mfu", "train_profile_compile_seconds",
+                "train_profile_compiles", "train_profile_wall_seconds"):
         assert key in line, key
+    # MFU is honest-or-nothing: a float when a peak is known, else
+    # null — never absent, never fabricated
+    assert line["train_profile_mfu"] is None \
+        or isinstance(line["train_profile_mfu"], float)
+    assert line["train_profile_compiles"] >= 1
     # a complete artifact says so explicitly (VERDICT r4 weak #7)
     assert line["sections_failed"] == []
 

@@ -386,8 +386,18 @@ class TestStitchedTraceE2E:
             assert status == 200 and body["tag"] == "s1"
             trace_id = headers["x-pio-trace-id"]
 
+            # the replica records its segment AFTER writing the
+            # response (engine_server handler finally), so an
+            # immediate scrape can race it onto a loaded 1-core host —
+            # poll with a deadline instead of asserting the first read
+            deadline = time.monotonic() + 10.0
             st, doc = get_json(router.port,
                                f"/traces.json?trace_id={trace_id}")
+            while (doc.get("segments", 0) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+                st, doc = get_json(router.port,
+                                   f"/traces.json?trace_id={trace_id}")
             assert st == 200 and doc["found"]
             assert doc["segments"] == 2      # router + the winning replica
             tree = doc["trace"]

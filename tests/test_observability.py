@@ -17,6 +17,7 @@ import json
 import logging
 import re
 import threading
+import time
 import urllib.request
 
 import pytest
@@ -431,10 +432,22 @@ class TestEventServerObservability:
         try:
             _http(event_server.port, "POST", "/events.json?accessKey=k",
                   EVENT, headers={"X-PIO-Request-Id": "log-me"})
+            # the access line is emitted AFTER the response is written:
+            # the client can observe the 201 before the handler thread
+            # reaches the logger (reliably so on a 1-core host), so
+            # poll with a deadline instead of racing the removeHandler
+            deadline = time.monotonic() + 10.0
+            entry = None
+            while entry is None and time.monotonic() < deadline:
+                records = [json.loads(r.getMessage()) for r in list(captured)]
+                entry = next(
+                    (r for r in records if r["request_id"] == "log-me"),
+                    None)
+                if entry is None:
+                    time.sleep(0.02)
         finally:
             access.removeHandler(handler)
-        records = [json.loads(r.getMessage()) for r in captured]
-        entry = next(r for r in records if r["request_id"] == "log-me")
+        assert entry is not None, "access-log line never emitted"
         assert entry["method"] == "POST"
         assert entry["path"] == "/events.json"
         assert entry["status"] == 201

@@ -222,21 +222,49 @@ class TestDedupAndStats:
     def test_chunked_request_gets_411_and_close(self, batching_server):
         """HTTP/1.1 keep-alive + an undecoded chunked body would desync
         every later request on the socket — the server must 411 and
-        close instead (RFC 9112 §6.3)."""
-        import http.client
+        close instead (RFC 9112 §6.3).
 
-        conn = http.client.HTTPConnection(
-            "127.0.0.1", batching_server.port, timeout=10)
-        try:
-            conn.request("POST", "/queries.json", iter([b'{"x": 1}']),
-                         {"Content-Type": "application/json"},
-                         encode_chunked=True)
-            resp = conn.getresponse()
-            assert resp.status == 411
-            resp.read()
-            assert resp.will_close
-        finally:
-            conn.close()
+        Raw socket, ONE write: http.client streams chunked bodies, and
+        the server 411s + closes after the HEADERS — a mid-stream chunk
+        write then races the close and intermittently dies on
+        ECONNRESET before getresponse() ever runs (flaky on 1-core
+        hosts, where the server wins the race reliably). Sending the
+        complete request in a single send and reading to EOF removes
+        the race: there is nothing left to write when the close
+        lands."""
+        import socket
+
+        request = (
+            b"POST /queries.json HTTP/1.1\r\n"
+            b"Host: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Transfer-Encoding: chunked\r\n"
+            b"\r\n"
+            b"8\r\n"
+            b'{"x": 1}\r\n'
+            b"0\r\n\r\n"
+        )
+        with socket.create_connection(
+                ("127.0.0.1", batching_server.port), timeout=10) as s:
+            s.sendall(request)
+            data = b""
+            try:
+                while b"\r\n\r\n" not in data:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+            except ConnectionResetError:
+                # the server closes with our (never-read) chunk bytes
+                # still buffered, so its stack may RST; whatever
+                # arrived before the reset IS the response — the
+                # header assertions below decide
+                pass
+        status_line, _, rest = data.partition(b"\r\n")
+        assert status_line.startswith(b"HTTP/1.1 411"), data[:80]
+        headers = rest.split(b"\r\n\r\n", 1)[0].lower()
+        # the desync guard: the connection must not be reused
+        assert b"connection: close" in headers, headers
 
     def test_handler_has_idle_read_timeout(self):
         """Keep-alive without a read timeout would pin one handler

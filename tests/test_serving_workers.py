@@ -161,6 +161,14 @@ class TestWorkerPoolScrape:
             info = families["pio_server_info"]["samples"]
             assert len(info) == 2
             assert all(dict(labels).get("worker") for _, labels in info)
+            # the recompile sentinel's always-present families survive
+            # the worker merge (PR 12 acceptance: device/compiler
+            # observability rides the same exposition plane) — counters
+            # summed across siblings, zero on this no-jax echo engine
+            assert ("pio_serving_recompile_total", ()) in \
+                families["pio_serving_recompile_total"]["samples"]
+            assert ("pio_jit_compile_seconds_total", ()) in \
+                families["pio_jit_compile_seconds_total"]["samples"]
         finally:
             w1.stop()
             w2.stop()

@@ -13,6 +13,7 @@ from predictionio_tpu.core.datamap import DataMap
 from predictionio_tpu.core.event import Event
 from predictionio_tpu.data.view import BatchView
 from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
+from predictionio_tpu.utils.testing import sqlite_supports_returning
 
 T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
 
@@ -185,6 +186,10 @@ class TestBasicAppUsecases:
         yield main
         Storage.reset_default()
 
+    @pytest.mark.skipif(
+        not sqlite_supports_returning(),
+        reason="container sqlite < 3.35 lacks RETURNING — the channels "
+               "DAO cannot run here (container artifact)")
     def test_app_channel_lifecycle(self, cli, capsys):
         from predictionio_tpu.storage.registry import Storage
 
