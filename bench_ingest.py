@@ -252,6 +252,74 @@ def bench_ingest_dao(n_events: int = DEF_INGEST_EVENTS,
 
 
 # ---------------------------------------------------------------------------
+# WAL: journal-append throughput vs direct insert, per fsync policy
+# ---------------------------------------------------------------------------
+
+def bench_wal(n_events: int = DEF_INGEST_EVENTS,
+              batch: int = DEF_INGEST_BATCH, rounds: int = 3) -> dict:
+    """Durable-ingest overhead (PR 13, docs/operations-resilience.md):
+    events/sec APPENDING to the write-ahead journal per fsync policy
+    (``off`` / ``interval`` / ``always``) vs the direct sqlite
+    ``insert_batch`` ingest path — the cost a client pays for a 202
+    during ride-through vs a 201 in steady state. Appends are
+    per-event (the ride-through shape: each accepted request journals
+    its own record(s) before acknowledging). Interleaved best-of-N
+    rounds, fresh journal/table per phase (the ratio discipline).
+    Acceptance anchor: ``interval`` within 15% of direct-insert
+    throughput; ``always`` is bounded by the disk's flush latency and
+    is reported honestly, not gated."""
+    import tempfile
+    import uuid
+
+    from predictionio_tpu.data.wal import WriteAheadLog, encode_record
+    from predictionio_tpu.storage.base import StorageClientConfig
+    from predictionio_tpu.storage.sqlite import SQLiteStorageClient
+
+    events = [
+        e if e.event_id else e.with_event_id(uuid.uuid4().hex)
+        for e in make_events(n_events)
+    ]
+    payloads = [encode_record(e, 1, None) for e in events]
+    policies = ("off", "interval", "always")
+    direct_times: list[float] = []
+    wal_times: dict[str, list[float]] = {p: [] for p in policies}
+    with tempfile.TemporaryDirectory() as tmp:
+        client = SQLiteStorageClient(StorageClientConfig(
+            properties={"PATH": f"{tmp}/ingest.sqlite"}))
+        dao = client.events()
+        try:
+            for r in range(rounds):
+                dao.remove(1)
+                dao.init(1)
+                dao.insert_batch(events[:batch], 1)   # warm table/WAL
+                t0 = time.perf_counter()
+                for at in range(0, n_events, batch):
+                    dao.insert_batch(events[at:at + batch], 1)
+                direct_times.append(time.perf_counter() - t0)
+                for policy in policies:
+                    wal = WriteAheadLog(f"{tmp}/wal-{policy}-{r}",
+                                        fsync=policy)
+                    t0 = time.perf_counter()
+                    for payload in payloads:
+                        wal.append(payload)
+                    wal_times[policy].append(time.perf_counter() - t0)
+                    wal.close()
+        finally:
+            client.close()
+    direct_rate = n_events / min(direct_times)
+    out = {
+        "wal_direct_batch_events_per_sec": round(direct_rate, 1),
+        "wal_events": n_events,
+        "wal_rounds": rounds,
+    }
+    for policy in policies:
+        rate = n_events / min(wal_times[policy])
+        out[f"wal_append_{policy}_events_per_sec"] = round(rate, 1)
+        out[f"wal_{policy}_vs_direct_x"] = round(rate / direct_rate, 3)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Ingest, HTTP level: multi-process load against a real EventServer
 # ---------------------------------------------------------------------------
 
@@ -452,6 +520,7 @@ def bench_data_plane(scan_events: int = DEF_SCAN_EVENTS,
                      procs: int = DEF_HTTP_PROCS) -> dict:
     scan = bench_scan(n_events=scan_events, rounds=rounds)
     dao = bench_ingest_dao(n_events=ingest_events, rounds=rounds)
+    wal = bench_wal(n_events=ingest_events, rounds=rounds)
     http = bench_ingest_http(clients=clients, rounds=rounds, procs=procs)
     headline = scan["scan_columnar_events_per_sec_sqlite"]
     return {
@@ -460,6 +529,7 @@ def bench_data_plane(scan_events: int = DEF_SCAN_EVENTS,
         "unit": "events/sec",
         **scan,
         **dao,
+        **wal,
         **http,
     }
 
@@ -479,6 +549,10 @@ def bench_section() -> dict:
         "scan_speedup_x_memory": r["scan_speedup_x_memory"],
         "ingest_tx_speedup_x": r["ingest_tx_speedup_x"],
         "ingest_http_events_per_sec": r["ingest_http_events_per_sec"],
+        "wal_append_interval_events_per_sec":
+            r["wal_append_interval_events_per_sec"],
+        "wal_interval_vs_direct_x": r["wal_interval_vs_direct_x"],
+        "wal_always_vs_direct_x": r["wal_always_vs_direct_x"],
     }
 
 
@@ -495,7 +569,17 @@ def main() -> None:
     parser.add_argument("--clients", type=int, default=DEF_HTTP_CLIENTS)
     parser.add_argument("--rounds", type=int, default=DEF_SCAN_ROUNDS)
     parser.add_argument("--client-procs", type=int, default=DEF_HTTP_PROCS)
+    parser.add_argument("--wal-only", action="store_true",
+                        help="run only the WAL fsync-policy phase "
+                             "(BENCH_wal_rNN.json artifacts)")
     args = parser.parse_args()
+    if args.wal_only:
+        r = bench_wal(n_events=args.ingest_events, rounds=args.rounds)
+        print(json.dumps({
+            "metric": "wal_interval_vs_direct_x",
+            "value": r["wal_interval_vs_direct_x"],
+            "unit": "ratio", **r}))
+        return
     print(json.dumps(bench_data_plane(
         scan_events=args.scan_events, ingest_events=args.ingest_events,
         clients=args.clients, rounds=args.rounds, procs=args.client_procs)))

@@ -197,9 +197,13 @@ def default_config() -> LintConfig:
             # side of the worker-coherence machinery
             # (serving/workers.py) must never grow an untimed fetch or
             # a bare sleep in its sync loop
+            # data/wal.py added with durable ingest (PR 13): the WAL
+            # drainer's retry loop must ride clock.sleep/Event.wait —
+            # a bare time.sleep there is unstoppable during shutdown
+            # and untestable on a ManualClock
             "untimed-blocking-io": RuleConfig(
                 paths=("api/", "storage/", "fleet/", "obs/", "cli/",
-                       "serving/"),
+                       "serving/", "data/wal.py"),
                 options={
                     "policed_calls": {
                         "urlopen": 2, "create_connection": 1,
@@ -219,7 +223,8 @@ def default_config() -> LintConfig:
                     # there is a finding — use clock.sleep or
                     # Event.wait (PR 9; docs/static-analysis.md)
                     "banned_sleep_paths": ["fleet/",
-                                           "serving/workers.py"],
+                                           "serving/workers.py",
+                                           "data/wal.py"],
                 },
             ),
             "lock-discipline": RuleConfig(paths=("",)),

@@ -77,6 +77,7 @@ from predictionio_tpu.obs.registry import (
     ingest_collector,
     resilience_collector,
     server_info_collector,
+    wal_collector,
 )
 from predictionio_tpu.obs.slo import SLOEngine
 from predictionio_tpu.obs.trace import (
@@ -87,10 +88,18 @@ from predictionio_tpu.obs.trace import (
     tracing_default,
     use_trace,
 )
+from predictionio_tpu.data.wal import (
+    WalDrainer,
+    WalFullError,
+    WriteAheadLog,
+    encode_record,
+    make_storage_unavailable,
+)
 from predictionio_tpu.storage.base import EventFilter
 from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.utils.resilience import (
     STORAGE_UNAVAILABLE_ERRORS,
+    StorageUnavailableError,
     deadline_scope,
     retry_after_hint,
 )
@@ -123,6 +132,45 @@ def _default_max_batch() -> int:
     return value
 
 
+#: journal disk budget past which ingest reverts to 503 backpressure
+DEFAULT_WAL_MAX_BYTES = 256 << 20
+
+
+def _env_str(name: str, default: str | None,
+             allowed: tuple[str, ...] | None = None):
+    """Env-defaulted string field (read at construction time); a value
+    outside ``allowed`` degrades to the default with a warning."""
+    def build() -> str | None:
+        raw = os.environ.get(name)
+        if raw is None or raw == "":
+            return default
+        if allowed is not None and raw not in allowed:
+            logger.warning("ignoring malformed %s=%r (using %r)",
+                           name, raw, default)
+            return default
+        return raw
+    return build
+
+
+def _env_int(name: str, default: int):
+    """Env-defaulted positive-int field: malformed/non-positive values
+    degrade to the default with a warning (never kill startup)."""
+    def build() -> int:
+        raw = os.environ.get(name)
+        if raw is None:
+            return default
+        try:
+            value = int(raw)
+        except ValueError:
+            value = 0
+        if value <= 0:
+            logger.warning("ignoring malformed %s=%r (using %d)",
+                           name, raw, default)
+            return default
+        return value
+    return build
+
+
 @dataclasses.dataclass(frozen=True)
 class EventServerConfig:
     """Parity: EventServerConfig (EventServer.scala:626-630), plus the
@@ -141,6 +189,34 @@ class EventServerConfig:
     #: PIO_ACCESS_LOG)
     tracing: bool | None = None
     access_log: bool | None = None
+    #: -- durable ingest (docs/operations-resilience.md "The ingest
+    #: durability ladder") -------------------------------------------
+    #: journal directory; None (the default) disables the WAL — the
+    #: pre-PR-13 503-only rung of the ladder
+    wal_dir: str | None = dataclasses.field(
+        default_factory=_env_str("PIO_EVENTSERVER_WAL_DIR", None))
+    #: ``always`` | ``interval`` | ``off`` (data/wal.py)
+    wal_fsync: str = dataclasses.field(
+        default_factory=_env_str("PIO_EVENTSERVER_WAL_FSYNC", "interval",
+                                 allowed=("always", "interval", "off")))
+    #: disk budget: past this many pending journal bytes, ingest sheds
+    #: 503s again (bounded ride-through, never a full disk)
+    wal_max_bytes: int = dataclasses.field(
+        default_factory=_env_int("PIO_EVENTSERVER_WAL_MAX_BYTES",
+                                 DEFAULT_WAL_MAX_BYTES))
+    #: ``ride-through`` journals only when storage is down (202 during
+    #: the outage, 201 otherwise); ``write-through`` journals EVERY
+    #: accepted event and answers 202 always — storage is written
+    #: exclusively by the drainer (the top rung: max ingest throughput,
+    #: reads lag by the drain depth)
+    wal_policy: str = dataclasses.field(
+        default_factory=_env_str(
+            "PIO_EVENTSERVER_WAL_POLICY", "ride-through",
+            allowed=("ride-through", "write-through")))
+    #: application-level replay failures before a record is quarantined
+    #: to the dead-letter series
+    wal_replay_attempts: int = dataclasses.field(
+        default_factory=_env_int("PIO_EVENTSERVER_WAL_REPLAY_ATTEMPTS", 5))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,6 +279,50 @@ class EventService:
         #: (obs/slo.py; docs/fleet.md autoscaler contract)
         self.slo = SLOEngine()
         self.registry.register(self.slo.collector())
+        #: auth results served while the metadata store was REACHABLE,
+        #: replayed stale during an outage: without this every POST of
+        #: the ride-through dies at authenticate() before the journal
+        #: is ever reached. Storage stays authoritative while healthy
+        #: (revocation honored); only STORAGE_UNAVAILABLE falls back.
+        self._auth_cache: dict[str, Any] = {}
+        self._auth_cache_lock = threading.Lock()
+        #: durable ingest (data/wal.py; docs/operations-resilience.md
+        #: "The ingest durability ladder")
+        self.wal = None
+        self.wal_drainer = None
+        if config.wal_dir:
+            self.wal = WriteAheadLog(
+                config.wal_dir, fsync=config.wal_fsync,
+                max_bytes=config.wal_max_bytes)
+            self.wal_drainer = WalDrainer(
+                self.wal, self._drain_insert_batch,
+                max_replay_attempts=config.wal_replay_attempts,
+                trace_factory=(self._wal_trace if self.tracing else None),
+                trace_sink=(self.trace_log.record if self.tracing
+                            else None))
+            self.registry.register(wal_collector(self.wal,
+                                                 self.wal_drainer))
+            self.wal_drainer.start()
+            logger.info(
+                "durable ingest: WAL at %s (fsync=%s, budget=%d bytes, "
+                "policy=%s, %d pending record(s) recovered)",
+                config.wal_dir, config.wal_fsync, config.wal_max_bytes,
+                config.wal_policy, self.wal.pending_records())
+
+    def _drain_insert_batch(self, events, app_id, channel_id):
+        """The drainer's storage write: the DAO's idempotent
+        pre-assigned-id ``insert_batch``, counted into IngestStats so
+        ``pio_ingest_events_total`` keeps meaning "landed in storage"."""
+        t0 = time.perf_counter()
+        ids = self.events.insert_batch(list(events), app_id, channel_id)
+        self.ingest_stats.insert_latency.observe(time.perf_counter() - t0)
+        self.ingest_stats.record_batch(len(events))
+        return ids
+
+    def _wal_trace(self):
+        """One trace per replay pass: decode → insert_batch → commit
+        spans land in the same /traces.json ring as the request paths."""
+        return start_trace("wal.replay", service="event")
 
     # -- auth (EventServer.scala:92-131) ------------------------------------
     def authenticate(
@@ -219,19 +339,48 @@ class EventService:
                     raise _Reject(401, "Invalid accessKey.")
         if not key:
             raise _Reject(401, "Missing accessKey.")
-        access_key = self.access_keys.get(key)
+        access_key = self._cached_lookup(
+            ("key", key), lambda: self.access_keys.get(key))
         if access_key is None:
             raise _Reject(401, "Invalid accessKey.")
         channel_id: int | None = None
         channel_name = params.get("channel")
         if channel_name:
-            channel_map = {
-                c.name: c.id for c in self.channels.get_by_app_id(access_key.appid)
-            }
+            channel_map = self._cached_lookup(
+                ("channels", access_key.appid),
+                lambda: {c.name: c.id
+                         for c in self.channels.get_by_app_id(
+                             access_key.appid)})
             if channel_name not in channel_map:
                 raise _Reject(401, f"Invalid channel '{channel_name}'.")
             channel_id = channel_map[channel_name]
         return AuthData(access_key.appid, channel_id, tuple(access_key.events))
+
+    def _cached_lookup(self, cache_key, fetch):
+        """Metadata lookup with STALE fallback: storage stays
+        authoritative while reachable (key revocation takes effect
+        immediately); during an outage the last-known answer is served
+        so the WAL ride-through can authenticate the clients it was
+        built for. A key never seen while storage was healthy still
+        503s — the server must not invent credentials."""
+        try:
+            value = fetch()
+        except STORAGE_UNAVAILABLE_ERRORS:
+            with self._auth_cache_lock:
+                if cache_key in self._auth_cache:
+                    return self._auth_cache[cache_key]
+            raise
+        with self._auth_cache_lock:
+            if value is None:
+                # negative results are NOT cached: an attacker cycling
+                # bogus keys must not grow this dict one entry per
+                # guess (the positive set is bounded by the app's real
+                # keys/channels), and a key deleted while storage is
+                # healthy must drop out of the stale set too
+                self._auth_cache.pop(cache_key, None)
+            else:
+                self._auth_cache[cache_key] = value
+        return value
 
     # -- route handlers ------------------------------------------------------
     def alive(self) -> Response:
@@ -254,6 +403,14 @@ class EventService:
 
         err = bounded_probe(probe, timeout=1.0)
         if err is not None:
+            if self.wal is not None and not self.wal.is_full():
+                # the WAL ride-through IS the ready state during an
+                # outage: draining this replica would shed exactly the
+                # writes the journal was built to keep accepting. Only
+                # a journal at its disk budget makes ingest truly
+                # unready (docs/operations-resilience.md).
+                return 200, {"status": "ready", "storage": "unavailable",
+                             "durability": "journaling"}
             return (503,
                     {"status": "unavailable", "storage": f"{err}"},
                     {"Retry-After": retry_after_header(retry_after_hint(err))})
@@ -283,17 +440,82 @@ class EventService:
             )
         except Exception as exc:
             return 403, {"message": str(exc)}
-        t0 = time.perf_counter()
-        with span("insert"):
-            event_id = self.events.insert(event, auth.app_id, auth.channel_id)
-        self.ingest_stats.insert_latency.observe(time.perf_counter() - t0)
+        return self._insert_or_journal(event, auth)
+
+    # -- durable ingest (docs/operations-resilience.md) ----------------------
+    def _insert_or_journal(self, event, auth: AuthData) -> Response:
+        """The single-event write path of the durability ladder: direct
+        insert (201) with WAL ride-through on a storage outage (202 +
+        durability marker), or journal-first under ``write-through``.
+        Sniffers and the hourly stats fire on ACCEPTANCE (201 and 202
+        alike — the event is durably owned by the server either way)."""
+        if self.wal is not None and self.config.wal_policy == "write-through":
+            status, body = self._journal(event, auth)
+        else:
+            try:
+                t0 = time.perf_counter()
+                with span("insert"):
+                    event_id = self.events.insert(
+                        event, auth.app_id, auth.channel_id)
+                self.ingest_stats.insert_latency.observe(
+                    time.perf_counter() - t0)
+                self.ingest_stats.record_batch(1)
+                status, body = 201, {"eventId": event_id}
+            except STORAGE_UNAVAILABLE_ERRORS as exc:
+                if self.wal is None:
+                    raise
+                status, body = self._journal(event, auth, cause=exc)
         self.plugin_context.notify_sniffers(
-            EventInfo(auth.app_id, auth.channel_id, event)
-        )
+            EventInfo(auth.app_id, auth.channel_id, event))
         if self.stats:
-            self.stats.update(auth.app_id, 201, event)
-        self.ingest_stats.record_batch(1)
-        return 201, {"eventId": event_id}
+            self.stats.update(auth.app_id, status, event)
+        return status, body
+
+    def _journal(self, event, auth: AuthData,
+                 cause: BaseException | None = None) -> tuple[int, dict]:
+        """Append one accepted event to the WAL → ``202`` with a
+        durability marker. At the disk budget the journal refuses and
+        this degrades to the ladder's 503 rung, with a Retry-After hint
+        that tracks drain progress (shrinks as the backlog drains)."""
+        import uuid as _uuid
+
+        if not event.event_id:
+            # replay idempotency: the id the client gets acknowledged
+            # IS the id the drainer upserts under
+            event = event.with_event_id(_uuid.uuid4().hex)
+        try:
+            with span("journal"):
+                self.wal.append(
+                    encode_record(event, auth.app_id, auth.channel_id))
+        except WalFullError as exc:
+            hint = self.wal_drainer.backpressure_hint()
+            if hint is None and cause is not None:
+                hint = retry_after_hint(cause)
+            raise make_storage_unavailable(exc, hint) from exc
+        except OSError as exc:
+            # a sick journal DISK (ENOSPC before the budget, EIO) is an
+            # availability problem, not a server bug: the ladder's
+            # honest answer stays 503 + Retry-After, never a 500
+            logger.warning("WAL append failed (%s); shedding 503", exc)
+            raise StorageUnavailableError("wal", str(exc)) from exc
+        self.wal_drainer.notify()
+        return 202, {"eventId": event.event_id, "durability": "journaled"}
+
+    def _journal_result(self, event, auth: AuthData,
+                        cause: BaseException | None) -> dict[str, Any]:
+        """Per-event batch status for the ride-through: 202 journaled,
+        or the honest 503 when no WAL is configured / it is at budget."""
+        if self.wal is None:
+            return {"status": 503, "message": str(cause)}
+        try:
+            status, body = self._journal(event, auth, cause=cause)
+        except STORAGE_UNAVAILABLE_ERRORS as exc:
+            return {"status": 503, "message": str(exc)}
+        self.plugin_context.notify_sniffers(
+            EventInfo(auth.app_id, auth.channel_id, event))
+        if self.stats:
+            self.stats.update(auth.app_id, status, event)
+        return {"status": status, **body}
 
     def get_event(
         self, event_id: str, params: Mapping[str, str], headers: Mapping[str, str]
@@ -415,6 +637,14 @@ class EventService:
                 for pos, e in pending
             ]
             events = [e for _, e in pending]
+            if (self.wal is not None
+                    and self.config.wal_policy == "write-through"):
+                # the top durability rung: storage is written only by
+                # the drainer — the whole valid subset journals
+                for pos, event in pending:
+                    results[pos] = self._journal_result(event, auth,
+                                                        cause=None)
+                return 200, results
             try:
                 t0 = time.perf_counter()
                 with span("insert_batch"):
@@ -432,10 +662,14 @@ class EventService:
                 # backend is DOWN — re-walking up to max_batch_events
                 # per-event inserts would multiply load on an outage
                 # and hold the handler thread through more retry
-                # cycles for the same all-503 answer. Every pending
-                # event fails together as a retryable 503.
-                for pos, _ in pending:
-                    results[pos] = {"status": 503, "message": str(exc)}
+                # cycles for the same all-503 answer. With a WAL the
+                # pending events ride the outage out as journaled 202s
+                # (position-correct: invalid events kept their 400/403
+                # above); without one they fail together as retryable
+                # 503s.
+                for pos, event in pending:
+                    results[pos] = self._journal_result(event, auth,
+                                                        cause=exc)
                 return 200, results
             except Exception:
                 # insert_batch is one transaction on the backends that
@@ -453,16 +687,19 @@ class EventService:
                 for pos, event in pending:
                     if down is not None:
                         # backend went down mid-fallback: later events
-                        # cannot have landed — fail them without
-                        # hammering a dead store once per event
-                        results[pos] = {"status": 503, "message": str(down)}
+                        # cannot have landed — journal them (or fail
+                        # 503) without hammering a dead store once per
+                        # event
+                        results[pos] = self._journal_result(event, auth,
+                                                            cause=down)
                         continue
                     try:
                         event_id = self.events.insert(
                             event, auth.app_id, auth.channel_id)
                     except STORAGE_UNAVAILABLE_ERRORS as exc:
                         down = exc
-                        results[pos] = {"status": 503, "message": str(exc)}
+                        results[pos] = self._journal_result(event, auth,
+                                                            cause=exc)
                         continue
                     except Exception as exc:
                         results[pos] = {"status": 500, "message": str(exc)}
@@ -498,6 +735,8 @@ class EventService:
             }
         doc = self.stats.get(auth.app_id)
         doc["ingest"] = self.ingest_stats.snapshot()
+        if self.wal_drainer is not None:
+            doc["wal"] = self.wal_drainer.snapshot()
         snap = resilience_snapshot()
         if snap:
             doc["resilience"] = snap
@@ -521,11 +760,9 @@ class EventService:
             event = connector_to_event(connector, body)
         except (ConnectorError, EventValidationError) as exc:
             return 400, {"message": str(exc)}
-        event_id = self.events.insert(event, auth.app_id, auth.channel_id)
-        if self.stats:
-            self.stats.update(auth.app_id, 201, event)
-        self.ingest_stats.record_batch(1)
-        return 201, {"eventId": event_id}
+        # webhook inserts ride the same durability ladder as
+        # /events.json: 201 direct, 202 journaled during an outage
+        return self._insert_or_journal(event, auth)
 
     def get_webhook(self, site: str, form: bool, params, headers) -> Response:
         """Existence check (Webhooks.getJson/getForm, api/Webhooks.scala:116-154)."""
@@ -638,6 +875,10 @@ class EventService:
             return 500, {"message": str(exc)}
 
     def close(self) -> None:
+        if self.wal_drainer is not None:
+            self.wal_drainer.stop()
+        if self.wal is not None:
+            self.wal.close()
         self.plugin_context.close()
 
 

@@ -52,17 +52,144 @@ def _cmd_status(args, storage: Storage) -> int:
 def _cmd_eventserver(args, storage: Storage) -> int:
     from predictionio_tpu.api.event_server import EventServer, EventServerConfig
 
+    # None/absent flags fall through to the PIO_EVENTSERVER_WAL_* env
+    # defaults in EventServerConfig (the ServerConfig discipline)
+    wal_overrides = {
+        k: v for k, v in {
+            "wal_dir": args.wal_dir,
+            "wal_fsync": args.wal_fsync,
+            "wal_max_bytes": args.wal_max_bytes,
+            "wal_policy": args.wal_policy,
+        }.items() if v is not None
+    }
     server = EventServer(
         storage,
         EventServerConfig(ip=args.ip, port=args.port, stats=args.stats,
-                          tracing=args.tracing, access_log=args.access_log),
+                          tracing=args.tracing, access_log=args.access_log,
+                          **wal_overrides),
     )
     print(f"[INFO] Event Server listening on {args.ip}:{server.port}")
+    if server.service.wal is not None:
+        cfg = server.service.config
+        print(f"[INFO] Durable ingest: WAL at {cfg.wal_dir} "
+              f"(fsync={cfg.wal_fsync}, budget={cfg.wal_max_bytes} bytes, "
+              f"policy={cfg.wal_policy}, "
+              f"{server.service.wal.pending_records()} pending)")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         server.stop()
     return 0
+
+
+def _wal_dir_from(args) -> str | None:
+    import os
+
+    return args.wal_dir or os.environ.get("PIO_EVENTSERVER_WAL_DIR") or None
+
+
+def _cmd_wal(args, storage: Storage) -> int:
+    """`pio wal` — operate the durable-ingest journal
+    (docs/operations-resilience.md "The ingest durability ladder"):
+
+    - ``status``      non-mutating scan (safe against a LIVE server)
+    - ``replay``      foreground drain into storage (server STOPPED)
+    - ``dead-letter`` inspect / requeue quarantined records
+    """
+    from predictionio_tpu.data.wal import (
+        WalDrainer,
+        WalError,
+        WriteAheadLog,
+        scan_status,
+    )
+
+    wal_dir = _wal_dir_from(args)
+    if not wal_dir:
+        print("[ERROR] --wal-dir (or PIO_EVENTSERVER_WAL_DIR) is required.")
+        return 1
+    try:
+        if args.wal_command == "status":
+            doc = scan_status(wal_dir)
+            if args.format == "json":
+                print(json.dumps(doc, indent=2))
+            else:
+                print(f"[INFO] WAL at {doc['dir']}")
+                print(f"[INFO]   pending: {doc['depth']} record(s), "
+                      f"{doc['bytes']} byte(s) in {doc['segments']} "
+                      f"segment(s)")
+                print(f"[INFO]   cursor: segment {doc['cursor']['segment']} "
+                      f"offset {doc['cursor']['offset']} "
+                      f"({doc['replayedTotal']} replayed lifetime)")
+                print(f"[INFO]   dead letters: {doc['deadLetterPending']} "
+                      f"pending ({doc['deadLetterTotal']} lifetime), "
+                      f"corrupt: {doc['corruptRecords']}")
+                if doc["tornTail"]:
+                    print("[WARN]   torn tail detected (crash artifact; "
+                          "recovered on next server start or replay)")
+            return 0
+
+        if args.wal_command == "replay":
+            # opening the journal RECOVERS it (torn tail truncated) —
+            # only safe with the owning event server stopped
+            if storage is None:
+                storage = Storage.default()
+            wal = WriteAheadLog(wal_dir)
+            events = storage.get_events()
+            drainer = WalDrainer(wal, events.insert_batch,
+                                 max_replay_attempts=args.max_attempts)
+            start_depth = wal.pending_records()
+            print(f"[INFO] replaying {start_depth} journaled record(s) "
+                  f"from {wal_dir} ...")
+            while True:
+                verdict = drainer.drain_once()
+                if verdict == "empty":
+                    break
+                if verdict == "unavailable":
+                    print("[ERROR] storage unavailable "
+                          f"({wal.pending_records()} record(s) still "
+                          "pending) — fix the backend and re-run.")
+                    return 1
+                # "progress"/"blocked" keep going: blocked records
+                # escalate to the dead-letter series after
+                # --max-attempts passes
+            stats = wal.stats()
+            wal.close()
+            print(f"[INFO] replay complete: {stats['replayedTotal']} "
+                  f"replayed lifetime, {stats['deadLetterTotal']} "
+                  f"dead-letter record(s).")
+            return 0
+
+        if args.wal_command == "dead-letter":
+            wal = WriteAheadLog(wal_dir)
+            try:
+                if args.requeue:
+                    n, kept = wal.requeue_dead_letters()
+                    print(f"[INFO] requeued {n} dead-letter record(s) "
+                          "into the journal; run `pio wal replay` (or "
+                          "start the event server) to drain them.")
+                    if kept:
+                        print(f"[WARN] kept {kept} undecodable "
+                              "envelope(s) in the dead-letter series "
+                              "(inspect with `pio wal dead-letter`).")
+                    return 0
+                shown = 0
+                for env_doc in wal.dead_letters():
+                    if shown >= args.show:
+                        print(f"[INFO] ... (--show {args.show} cap; "
+                              "use --show N for more)")
+                        break
+                    print(json.dumps(env_doc))
+                    shown += 1
+                if shown == 0:
+                    print("[INFO] no dead-letter records.")
+                return 0
+            finally:
+                wal.close()
+    except WalError as exc:
+        print(f"[ERROR] {exc}")
+        return 1
+    print(f"[ERROR] Unknown wal command {args.wal_command}")
+    return 1
 
 
 def resolve_concrete_port(ip: str, port: int) -> int:
@@ -607,6 +734,29 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None, dest="access_log",
                    help="structured JSON access logs (method, path, "
                         "status, latency_ms, request_id)")
+    # durable ingest (docs/operations-resilience.md "The ingest
+    # durability ladder"); None defers to PIO_EVENTSERVER_WAL_* env
+    p.add_argument("--wal-dir", default=None, dest="wal_dir",
+                   help="write-ahead journal directory: storage outages "
+                        "ride through as 202-journaled events replayed "
+                        "by a background drainer (default: WAL off, "
+                        "outages shed 503s)")
+    p.add_argument("--wal-fsync", default=None, dest="wal_fsync",
+                   choices=("always", "interval", "off"),
+                   help="journal fsync policy: always = every 202 is "
+                        "crash-durable; interval (default) = bounded "
+                        "loss window, near-direct throughput; off = OS "
+                        "page cache only")
+    p.add_argument("--wal-max-bytes", type=int, default=None,
+                   dest="wal_max_bytes",
+                   help="journal disk budget; past it ingest reverts to "
+                        "503 backpressure with a drain-aware Retry-After")
+    p.add_argument("--wal-policy", default=None, dest="wal_policy",
+                   choices=("ride-through", "write-through"),
+                   help="ride-through (default): journal only during "
+                        "outages; write-through: journal EVERY accepted "
+                        "event (always 202, storage written by the "
+                        "drainer; reads lag by the drain depth)")
 
     p = sub.add_parser(
         "router",
@@ -780,6 +930,38 @@ def build_parser() -> argparse.ArgumentParser:
                    help="list registered rules and exit")
     p.add_argument("--format", choices=("text", "json"), default="text")
 
+    p = sub.add_parser(
+        "wal",
+        help="operate the durable-ingest write-ahead journal "
+             "(docs/operations-resilience.md)",
+    )
+    wal_sub = p.add_subparsers(dest="wal_command", required=True)
+    ws = wal_sub.add_parser(
+        "status", help="non-mutating journal scan (safe against a "
+                       "running event server)")
+    ws.add_argument("--wal-dir", default=None, dest="wal_dir",
+                    help="journal directory (default: "
+                         "PIO_EVENTSERVER_WAL_DIR)")
+    ws.add_argument("--format", choices=("text", "json"), default="text")
+    wr = wal_sub.add_parser(
+        "replay", help="foreground drain into storage — run with the "
+                       "owning event server STOPPED (opening the "
+                       "journal recovers torn tails)")
+    wr.add_argument("--wal-dir", default=None, dest="wal_dir")
+    wr.add_argument("--max-attempts", type=int, default=5,
+                    dest="max_attempts",
+                    help="application-failure passes per record before "
+                         "dead-letter quarantine")
+    wd = wal_sub.add_parser(
+        "dead-letter", help="inspect or requeue quarantined records")
+    wd.add_argument("--wal-dir", default=None, dest="wal_dir")
+    wd.add_argument("--show", type=int, default=20,
+                    help="print at most this many envelopes")
+    wd.add_argument("--requeue", action="store_true",
+                    help="move every dead-letter record back into the "
+                         "live journal (after fixing the cause — see "
+                         "the runbook)")
+
     p = sub.add_parser("accesskey", help="access key administration")
     ak_sub = p.add_subparsers(dest="ak_command", required=True)
     an = ak_sub.add_parser("new")
@@ -801,8 +983,11 @@ COMPUTE_COMMANDS = frozenset({"train", "eval", "deploy", "run"})
 
 #: commands that never touch storage — they must work (CI lint hooks,
 #: version probes, the storage-free fleet router and its trace viewer)
-#: even when PIO_STORAGE_* env is broken or absent
-STORAGE_FREE_COMMANDS = frozenset({"version", "lint", "router", "trace"})
+#: even when PIO_STORAGE_* env is broken or absent. `wal` rides here
+#: because status/dead-letter operate on the journal directory alone;
+#: its replay subcommand builds Storage.default() itself.
+STORAGE_FREE_COMMANDS = frozenset({"version", "lint", "router", "trace",
+                                   "wal"})
 
 _COMMANDS = {
     "version": _cmd_version,
@@ -810,6 +995,7 @@ _COMMANDS = {
     "eventserver": _cmd_eventserver,
     "router": _cmd_router,
     "trace": _cmd_trace,
+    "wal": _cmd_wal,
     "app": _cmd_app,
     "accesskey": _cmd_accesskey,
     "lint": _cmd_lint,

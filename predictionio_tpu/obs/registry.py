@@ -250,6 +250,52 @@ def ingest_collector(stats: Any) -> Collector:
     return collect
 
 
+def wal_collector(wal: Any, drainer: Any) -> Collector:
+    """Adopt a :class:`~predictionio_tpu.data.wal.WriteAheadLog` and
+    its drainer (duck-typed like the other adapters): journal depth and
+    disk footprint, the ride-through mode gauge, and the lifetime
+    journal/replay/dead-letter counters — the operator's view of the
+    ingest durability ladder (docs/operations-resilience.md)."""
+
+    def collect() -> list[Metric]:
+        c = wal.counters()
+        gauges = (
+            ("pio_ingest_wal_depth",
+             "Journaled events awaiting replay into storage",
+             float(c["depth"])),
+            ("pio_ingest_wal_bytes",
+             "Pending journal bytes on disk (budget: wal_max_bytes)",
+             float(c["bytes"])),
+            ("pio_ingest_wal_mode",
+             "Durable-ingest mode: 0 idle (direct inserts), 1 draining "
+             "(ride-through backlog replaying), 2 backpressure "
+             "(journal at disk budget; ingest shedding 503s)",
+             float(drainer.mode())),
+        )
+        counters = (
+            ("pio_ingest_wal_journaled_total",
+             "Events appended to the write-ahead journal",
+             float(c["journaledTotal"])),
+            ("pio_ingest_wal_replayed_total",
+             "Journaled events successfully replayed into storage",
+             float(c["replayedTotal"])),
+            ("pio_ingest_wal_dead_letter_total",
+             "Records quarantined to the dead-letter series",
+             float(c["deadLetterTotal"])),
+            ("pio_ingest_wal_corrupt_total",
+             "CRC-corrupt journal records skipped at recovery",
+             float(c["corruptRecords"])),
+        )
+        return [
+            *(Metric(name=n, kind="gauge", help=h, samples=[({}, v)])
+              for n, h, v in gauges),
+            *(Metric(name=n, kind="counter", help=h, samples=[({}, v)])
+              for n, h, v in counters),
+        ]
+
+    return collect
+
+
 #: breaker state encoding for the gauge (strings are not a sample value)
 _BREAKER_STATES = {"closed": 0.0, "half-open": 1.0, "half_open": 1.0,
                    "open": 2.0}

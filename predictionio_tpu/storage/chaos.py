@@ -108,6 +108,14 @@ class ChaosInjector:
         self.delays_injected = 0
         self.calls = 0
 
+    def set_fault_rate(self, fault_rate: float) -> None:
+        """Thread-safe runtime fault-rate flip — the chaos suites'
+        outage window (``set_fault_rate(1.0)`` = hard outage,
+        ``set_fault_rate(0.0)`` = recovery) without racing the seeded
+        draw in :meth:`before` on another thread."""
+        with self._lock:
+            self.fault_rate = fault_rate
+
     def before(self, op: str) -> None:
         """Maybe sleep, maybe raise — always BEFORE the inner op runs."""
         with self._lock:

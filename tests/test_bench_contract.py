@@ -43,7 +43,8 @@ def tiny_bench(monkeypatch):
     # (bench_ingest.py) — stubbed here, covered by its own perf test
     monkeypatch.setattr(bench, "bench_data_plane",
                         lambda: {"scan_speedup_x_sqlite": 3.0,
-                                 "ingest_tx_speedup_x": 2.0})
+                                 "ingest_tx_speedup_x": 2.0,
+                                 "wal_interval_vs_direct_x": 1.0})
     # ann_retrieval builds IVF indexes and drives HTTP server pairs at
     # catalog scale (bench_serving.py) — stubbed here; the shrunk
     # harness itself is covered by the --skip-heavy artifact runs.
@@ -131,6 +132,7 @@ def test_skip_heavy_lists_skipped_sections(tiny_bench, capsys, monkeypatch):
         "seqrec"}
     assert "ingest_events_per_sec" in line and "map10_tpu" in line
     assert "scan_speedup_x_sqlite" in line   # data_plane runs skip-heavy
+    assert "wal_interval_vs_direct_x" in line  # WAL phase rides data_plane
     assert "ann_speedup_16k_x" in line       # ann_retrieval runs SHRUNK
     # workers_scaling runs SHRUNK under --skip-heavy too
     assert "workers_scaling_2w_vs_1w_x" in line
@@ -153,6 +155,13 @@ def test_data_plane_harness_contract_tiny():
     assert dao["ingest_per_event_events_per_sec"] > 0
     assert dao["ingest_batch_tx_events_per_sec"] > 0
     assert dao["ingest_tx_speedup_x"] > 0
+    # the WAL phase (PR 13) reports every fsync policy plus the ratio
+    # against direct insert — the keys BENCH_wal_rNN.json records
+    wal = bench_ingest.bench_wal(n_events=300, batch=50, rounds=1)
+    for policy in ("off", "interval", "always"):
+        assert wal[f"wal_append_{policy}_events_per_sec"] > 0
+        assert wal[f"wal_{policy}_vs_direct_x"] > 0
+    assert wal["wal_direct_batch_events_per_sec"] > 0
 
 
 @pytest.mark.perf

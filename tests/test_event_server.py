@@ -258,6 +258,167 @@ def test_batch_partial_failure_falls_back_per_event_idempotently(server):
     assert sorted(ids) == sorted(r["eventId"] for r in results)
 
 
+@pytest.fixture
+def wal_server(tmp_path):
+    """An event server with the durable-ingest WAL enabled (memory
+    storage — the spies below fake the outage)."""
+    storage = memory_storage()
+    app_id = storage.get_meta_data_apps().insert(App(0, "walapp"))
+    storage.get_meta_data_access_keys().insert(AccessKey("walkey", app_id, ()))
+    storage.get_meta_data_access_keys().insert(
+        AccessKey("wal-whitelist", app_id, ("rate",)))
+    storage.get_events().init(app_id)
+    srv = EventServer(storage, EventServerConfig(
+        ip="127.0.0.1", port=0, stats=True, wal_dir=str(tmp_path / "wal")))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.mark.wal
+def test_batch_ride_through_statuses_stay_position_correct(wal_server):
+    """The PR 4 spy contract under ride-through: when insert_batch
+    raises StorageUnavailableError, journaled events answer 202 AT
+    THEIR POSITION while invalid (400) and whitelist-rejected (403)
+    events keep theirs — and the journaled subset drains into storage
+    under the acknowledged ids once the spy is lifted."""
+    from predictionio_tpu.utils.resilience import StorageUnavailableError
+
+    service = wal_server.service
+    real_batch = service.events.insert_batch
+    real_insert = service.events.insert
+    calls = {"insert": 0}
+
+    def outage_batch(events, app_id, channel_id=None):
+        raise StorageUnavailableError("spy", "backend down")
+
+    def spy_insert(event, app_id, channel_id=None):
+        calls["insert"] += 1
+        return real_insert(event, app_id, channel_id)
+
+    service.events.insert_batch = outage_batch
+    service.events.insert = spy_insert
+    try:
+        batch = [
+            EVENT,                                     # -> 202 journaled
+            {"event": "buy", "entityType": "user"},    # -> 400 invalid
+            {**EVENT, "entityId": "u2"},               # -> 202 journaled
+        ]
+        status, results = call(
+            wal_server, "POST", "/batch/events.json?accessKey=walkey",
+            batch)
+        assert status == 200
+        assert [r["status"] for r in results] == [202, 400, 202]
+        assert all(r["durability"] == "journaled"
+                   for r in results if r["status"] == 202)
+        acked = [r["eventId"] for r in results if r["status"] == 202]
+        # whitelist 403s keep position too
+        status, results = call(
+            wal_server, "POST", "/batch/events.json?accessKey=wal-whitelist",
+            [{**EVENT, "event": "buy"}, {**EVENT, "entityId": "u3"}])
+        assert [r["status"] for r in results] == [403, 202]
+        acked.append(results[1]["eventId"])
+        # a DOWN store is never hammered per event: the handler routes
+        # the whole pending set to the journal (the drainer keeps
+        # retrying insert_batch in the background — that's its job)
+        assert calls["insert"] == 0
+    finally:
+        service.events.insert_batch = real_batch
+        service.events.insert = real_insert
+    # recovery: the drainer replays under the ACKNOWLEDGED ids
+    import time as _time
+
+    deadline = _time.monotonic() + 10
+    while (_time.monotonic() < deadline
+           and service.wal.pending_records() > 0):
+        _time.sleep(0.02)
+    assert service.wal.pending_records() == 0, service.wal.stats()
+    stored = {e.event_id for e in service.events.find(
+        service.storage.get_meta_data_apps().get_by_name("walapp").id)}
+    assert set(acked) <= stored
+
+
+@pytest.mark.wal
+def test_mid_fallback_outage_journals_the_tail(wal_server):
+    """insert_batch fails with an application error (per-event fallback
+    engages), then the store dies mid-walk: the events after the death
+    point journal as 202 instead of 503ing."""
+    from predictionio_tpu.utils.resilience import StorageUnavailableError
+
+    service = wal_server.service
+    real_batch = service.events.insert_batch
+    real_insert = service.events.insert
+    inserts = {"n": 0}
+
+    def broken_batch(events, app_id, channel_id=None):
+        raise RuntimeError("no batch today")
+
+    def die_after_one(event, app_id, channel_id=None):
+        inserts["n"] += 1
+        if inserts["n"] > 1:
+            raise StorageUnavailableError("spy", "died mid-fallback")
+        return real_insert(event, app_id, channel_id)
+
+    service.events.insert_batch = broken_batch
+    service.events.insert = die_after_one
+    try:
+        status, results = call(
+            wal_server, "POST", "/batch/events.json?accessKey=walkey",
+            [EVENT, {**EVENT, "entityId": "u8"},
+             {**EVENT, "entityId": "u9"}])
+    finally:
+        service.events.insert_batch = real_batch
+        service.events.insert = real_insert
+    assert status == 200
+    assert [r["status"] for r in results] == [201, 202, 202]
+
+
+@pytest.mark.wal
+def test_bogus_access_keys_never_grow_the_auth_cache(wal_server):
+    """The stale-auth fallback caches only POSITIVE lookups: a client
+    cycling random accessKey values must not grow server memory one
+    dict entry per guess."""
+    service = wal_server.service
+    assert call(wal_server, "POST", "/events.json?accessKey=walkey",
+                EVENT)[0] == 201
+    with service._auth_cache_lock:
+        cached_before = len(service._auth_cache)
+    for i in range(25):
+        assert call(wal_server, "POST",
+                    f"/events.json?accessKey=bogus-{i}", EVENT)[0] == 401
+    with service._auth_cache_lock:
+        assert len(service._auth_cache) == cached_before
+
+
+@pytest.mark.wal
+def test_single_event_ride_through_and_stats(wal_server):
+    """POST /events.json during an outage: 202 + durability marker,
+    counted in the hourly stats under its real status, wal section on
+    /stats.json."""
+    from predictionio_tpu.utils.resilience import StorageUnavailableError
+
+    service = wal_server.service
+    real_insert = service.events.insert
+
+    def outage(event, app_id, channel_id=None):
+        raise StorageUnavailableError("spy", "backend down")
+
+    service.events.insert = outage
+    try:
+        status, body = call(wal_server, "POST",
+                            "/events.json?accessKey=walkey", EVENT)
+    finally:
+        service.events.insert = real_insert
+    assert status == 202
+    assert body["durability"] == "journaled" and body["eventId"]
+    status, stats = call(wal_server, "GET", "/stats.json?accessKey=walkey")
+    assert status == 200
+    assert stats["wal"]["journaledTotal"] >= 1
+    codes = {kv["key"]: kv["value"]
+             for kv in stats["currentHour"]["statusCode"]}
+    assert codes.get(202, 0) >= 1
+
+
 def test_max_batch_events_config_and_env(monkeypatch):
     """max_batch_events: explicit config wins; PIO_EVENTSERVER_MAX_BATCH
     sets the default; malformed env degrades to the reference 50."""

@@ -49,11 +49,24 @@ def test_trains_and_serves_with_zero_set_events(example_engine, storage):
     app_id = storage.get_meta_data_apps().insert(App(0, "NoSetUserApp"))
     events = storage.get_events()
     events.init(app_id)
+    # Signal stabilization (the last visible tier-1 failure after
+    # PR 12): the old sparse blocks (each matching-parity pair viewed
+    # with p=0.8) left the rank-8 ALS factors only MARGINALLY
+    # separated, and the even >= 3 assert below sat exactly on the
+    # boundary — 2/4 vs 3/4 flipped with the platform's matmul
+    # accumulation order (CPU vs TPU numerics), and even with the data
+    # seed. The fix strengthens the DATA, not the tolerance: complete
+    # parity blocks (every user views every matching-parity item) with
+    # sparse seeded cross-parity noise views (p=0.05) keep the
+    # property under test — zero $set events, users exist only as view
+    # subjects, and the recommender must still separate the blocks
+    # through noise — while putting the block margin far above the
+    # numerics noise floor for ANY seed. The assert stays >= 3 of 4.
     rng = np.random.default_rng(19)
     n_events = 0
     for u in range(20):
         for i in range(16):
-            if i % 2 == u % 2 and rng.random() < 0.8:
+            if i % 2 == u % 2 or rng.random() < 0.05:
                 events.insert(
                     Event(event="view", entity_type="user",
                           entity_id=f"u{u}", target_entity_type="item",

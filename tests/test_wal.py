@@ -1,0 +1,471 @@
+"""Unit tests for the write-ahead event journal (data/wal.py): framing,
+CRC handling, torn-tail recovery, rotation under concurrent append,
+drainer semantics (batch runs, per-record isolation, dead-letter
+quarantine), disk-budget backpressure, and the drain-aware Retry-After
+hint. The live-server chaos pins are in tests/test_wal_durability.py."""
+
+import json
+import os
+import threading
+import uuid
+import zlib
+
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.data.wal import (
+    _HEADER,
+    BLOCKED,
+    EMPTY,
+    PROGRESS,
+    UNAVAILABLE,
+    WalDrainer,
+    WalFullError,
+    WriteAheadLog,
+    decode_record,
+    encode_record,
+    scan_status,
+)
+from predictionio_tpu.utils.resilience import StorageUnavailableError
+
+pytestmark = pytest.mark.wal
+
+
+def make_event(i: int, app_suffix: str = "") -> Event:
+    return Event(
+        event="rate", entity_type="user", entity_id=f"u{i}{app_suffix}",
+        target_entity_type="item", target_entity_id=f"i{i}",
+        properties=DataMap({"rating": i % 5}),
+    ).with_event_id(uuid.uuid4().hex)
+
+
+def fill(wal: WriteAheadLog, n: int, app_id: int = 1,
+         channel_id=None) -> list[Event]:
+    events = [make_event(i) for i in range(n)]
+    for e in events:
+        wal.append(encode_record(e, app_id, channel_id))
+    return events
+
+
+class Sink:
+    """An insert_batch spy with scriptable failures."""
+
+    def __init__(self):
+        self.inserted: list[tuple[Event, int, object]] = []
+        self.fail = None          # exception to raise, or callable(event)
+        self.calls = 0
+
+    def insert_batch(self, events, app_id, channel_id=None):
+        self.calls += 1
+        if self.fail is not None:
+            exc = self.fail(events) if callable(self.fail) else self.fail
+            if exc is not None:
+                raise exc
+        self.inserted.extend((e, app_id, channel_id) for e in events)
+        return [e.event_id for e in events]
+
+
+# ---------------------------------------------------------------------------
+# framing / recovery
+# ---------------------------------------------------------------------------
+
+class TestFraming:
+    def test_round_trip(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        events = fill(wal, 5, app_id=7, channel_id=3)
+        entries = wal.read_pending()
+        assert len(entries) == 5
+        for entry, original in zip(entries, events):
+            event, app_id, channel_id = decode_record(entry.payload)
+            assert app_id == 7 and channel_id == 3
+            assert event.event_id == original.event_id
+            assert event.event_time == original.event_time
+            assert event.properties.to_json() == original.properties.to_json()
+
+    def test_pre_assigned_id_required(self, tmp_path):
+        with pytest.raises(ValueError, match="pre-assigned"):
+            encode_record(Event(event="e", entity_type="t",
+                                entity_id="x"), 1, None)
+
+    def test_crc_corrupt_record_skipped_and_counted(self, tmp_path):
+        d = str(tmp_path / "wal")
+        wal = WriteAheadLog(d)
+        events = fill(wal, 3)
+        wal.close()
+        # flip one payload byte of the MIDDLE record on disk
+        path = os.path.join(d, "wal-00000001.seg")
+        entries_before = []
+        data = bytearray(open(path, "rb").read())
+        off = 0
+        while off + _HEADER.size <= len(data):
+            length, _ = _HEADER.unpack_from(data, off)
+            entries_before.append(off)
+            off += _HEADER.size + length
+        victim = entries_before[1] + _HEADER.size  # first payload byte
+        data[victim] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(data)
+
+        wal2 = WriteAheadLog(d)
+        assert wal2.corrupt_records == 1
+        assert wal2.pending_records() == 2
+        entries = wal2.read_pending()
+        replayed = [decode_record(e.payload)[0].event_id for e in entries]
+        assert replayed == [events[0].event_id, events[2].event_id]
+
+    def test_torn_tail_truncated_on_reopen(self, tmp_path):
+        d = str(tmp_path / "wal")
+        wal = WriteAheadLog(d)
+        fill(wal, 4)
+        wal.close()
+        path = os.path.join(d, "wal-00000001.seg")
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 7)  # mid-frame: kill -9 artifact
+        wal2 = WriteAheadLog(d)
+        assert wal2.torn_bytes_truncated > 0
+        assert wal2.pending_records() == 3
+        # the file itself was truncated back to a whole-frame boundary
+        assert os.path.getsize(path) < size - 7
+        # and appends continue cleanly after the truncate point
+        extra = make_event(99)
+        wal2.append(encode_record(extra, 1, None))
+        ids = [decode_record(e.payload)[0].event_id
+               for e in wal2.read_pending()]
+        assert ids[-1] == extra.event_id and len(ids) == 4
+
+    def test_insane_length_header_treated_as_torn(self, tmp_path):
+        d = str(tmp_path / "wal")
+        wal = WriteAheadLog(d)
+        fill(wal, 2)
+        wal.close()
+        path = os.path.join(d, "wal-00000001.seg")
+        with open(path, "ab") as f:
+            f.write(_HEADER.pack(1 << 30, 0) + b"garbage")
+        wal2 = WriteAheadLog(d)
+        assert wal2.pending_records() == 2
+        assert wal2.torn_bytes_truncated > 0
+
+    def test_scan_status_does_not_mutate(self, tmp_path):
+        d = str(tmp_path / "wal")
+        wal = WriteAheadLog(d)
+        fill(wal, 3)
+        wal.close()
+        path = os.path.join(d, "wal-00000001.seg")
+        with open(path, "ab") as f:
+            f.write(b"\x01\x02")  # torn tail
+        size = os.path.getsize(path)
+        doc = scan_status(d)
+        assert doc["depth"] == 3 and doc["tornTail"] is True
+        assert os.path.getsize(path) == size  # untouched
+
+
+# ---------------------------------------------------------------------------
+# rotation
+# ---------------------------------------------------------------------------
+
+class TestRotation:
+    def test_rotation_under_concurrent_append(self, tmp_path):
+        d = str(tmp_path / "wal")
+        wal = WriteAheadLog(d, segment_max_bytes=512)
+        n_threads, per_thread = 8, 25
+        ids = [[make_event(t * 1000 + i) for i in range(per_thread)]
+               for t in range(n_threads)]
+        errors = []
+
+        def writer(t):
+            try:
+                for e in ids[t]:
+                    wal.append(encode_record(e, 1, None))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        total = n_threads * per_thread
+        assert wal.pending_records() == total
+        # many segments, no record lost or torn across any boundary
+        assert wal.stats()["segments"] > 3
+        entries = wal.read_pending(max_records=total)
+        got = {decode_record(e.payload)[0].event_id for e in entries}
+        want = {e.event_id for group in ids for e in group}
+        assert got == want
+        # reopen sees the identical pending set (recovery counts match)
+        wal.close()
+        wal2 = WriteAheadLog(d)
+        assert wal2.pending_records() == total
+
+    def test_consumed_segments_reaped(self, tmp_path):
+        d = str(tmp_path / "wal")
+        wal = WriteAheadLog(d, segment_max_bytes=256)
+        fill(wal, 20)
+        assert wal.stats()["segments"] > 2
+        sink = Sink()
+        drainer = WalDrainer(wal, sink.insert_batch)
+        assert drainer.drain_once() == PROGRESS
+        assert wal.pending_records() == 0
+        # only the active segment remains
+        assert wal.stats()["segments"] == 1
+
+
+# ---------------------------------------------------------------------------
+# drainer semantics
+# ---------------------------------------------------------------------------
+
+class TestDrainer:
+    def test_batches_by_consecutive_app_channel_runs(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        e1, e2 = make_event(1), make_event(2)
+        e3 = make_event(3)
+        wal.append(encode_record(e1, 1, None))
+        wal.append(encode_record(e2, 1, None))
+        wal.append(encode_record(e3, 2, 5))
+        sink = Sink()
+        drainer = WalDrainer(wal, sink.insert_batch)
+        assert drainer.drain_once() == PROGRESS
+        # 2 runs -> 2 insert_batch calls, routing preserved
+        assert sink.calls == 2
+        assert [(a, c) for _, a, c in sink.inserted] == [
+            (1, None), (1, None), (2, 5)]
+
+    def test_unavailable_backs_off_and_preserves_order(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        events = fill(wal, 4)
+        sink = Sink()
+        sink.fail = StorageUnavailableError("dead", "down")
+        drainer = WalDrainer(wal, sink.insert_batch)
+        assert drainer.drain_once() == UNAVAILABLE
+        assert wal.pending_records() == 4
+        sink.fail = None
+        assert drainer.drain_once() == PROGRESS
+        assert [e.event_id for e, _, _ in sink.inserted] == [
+            e.event_id for e in events]
+
+    def test_poison_record_quarantined_after_n_attempts(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        good1, bad, good2 = make_event(1), make_event(2), make_event(3)
+        for e in (good1, bad, good2):
+            wal.append(encode_record(e, 1, None))
+        sink = Sink()
+
+        def fail_bad(events):
+            if any(e.event_id == bad.event_id for e in events):
+                return RuntimeError("constraint violation")
+            return None
+
+        sink.fail = fail_bad
+        drainer = WalDrainer(wal, sink.insert_batch, max_replay_attempts=3)
+        # pass 1: batch fails -> per-record: good1 lands, bad attempt 1
+        assert drainer.drain_once() == BLOCKED
+        assert [e.event_id for e, _, _ in sink.inserted] == [good1.event_id]
+        assert wal.pending_records() == 2
+        # passes 2..3: bad escalates to quarantine, good2 drains
+        assert drainer.drain_once() == BLOCKED
+        assert drainer.drain_once() == PROGRESS
+        assert wal.pending_records() == 0
+        assert [e.event_id for e, _, _ in sink.inserted] == [
+            good1.event_id, good2.event_id]
+        dead = list(wal.dead_letters())
+        assert len(dead) == 1
+        assert dead[0]["attempts"] == 3
+        assert "constraint violation" in dead[0]["reason"]
+        assert dead[0]["record"]["e"]["eventId"] == bad.event_id
+
+    def test_undecodable_record_quarantined_in_order(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        before = make_event(1)
+        wal.append(encode_record(before, 1, None))
+        wal.append(b"{not json")          # poison payload, valid CRC
+        after = make_event(2)
+        wal.append(encode_record(after, 1, None))
+        sink = Sink()
+        drainer = WalDrainer(wal, sink.insert_batch)
+        assert drainer.drain_once() == PROGRESS
+        assert wal.pending_records() == 0
+        assert [e.event_id for e, _, _ in sink.inserted] == [
+            before.event_id, after.event_id]
+        dead = list(wal.dead_letters())
+        assert len(dead) == 1 and "undecodable" in dead[0]["reason"]
+
+    def test_requeue_dead_letters(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        events = fill(wal, 2)
+        sink = Sink()
+        sink.fail = RuntimeError("always")
+        drainer = WalDrainer(wal, sink.insert_batch, max_replay_attempts=1)
+        while wal.pending_records():
+            drainer.drain_once()
+        assert wal.stats()["deadLetterTotal"] == 2
+        assert wal.requeue_dead_letters() == (2, 0)
+        assert wal.pending_records() == 2
+        assert list(wal.dead_letters()) == []
+        sink.fail = None
+        assert drainer.drain_once() == PROGRESS
+        assert {e.event_id for e, _, _ in sink.inserted} == {
+            e.event_id for e in events}
+
+    def test_requeue_preserves_undecodable_envelopes(self, tmp_path):
+        """--requeue must never destroy evidence: an envelope whose
+        record cannot be re-journaled (quarantined-as-undecodable)
+        stays in the dead-letter series instead of being reaped with
+        the segments."""
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        ok = make_event(1)
+        wal.append(encode_record(ok, 1, None))
+        wal.append(b"\x00garbage payload")   # valid CRC, undecodable
+        sink = Sink()
+        sink.fail = RuntimeError("always")
+        drainer = WalDrainer(wal, sink.insert_batch, max_replay_attempts=1)
+        while wal.pending_records():
+            drainer.drain_once()
+        assert wal.stats()["deadLetterTotal"] == 2
+        sink.fail = None
+        assert wal.requeue_dead_letters() == (1, 1)
+        # the decodable record is live again; the undecodable envelope
+        # survives for inspection
+        assert wal.pending_records() == 1
+        remaining = list(wal.dead_letters())
+        assert len(remaining) == 1
+        assert "undecodable" in remaining[0]["record"]
+
+    def test_replay_survives_restart_idempotently(self, tmp_path):
+        """Crash between insert and cursor commit replays the same
+        record again — upsert semantics make that invisible."""
+        d = str(tmp_path / "wal")
+        wal = WriteAheadLog(d)
+        events = fill(wal, 3)
+        sink = Sink()
+        drainer = WalDrainer(wal, sink.insert_batch)
+        assert drainer.drain_once() == PROGRESS
+        wal.close()
+        # simulate the crash: restore the PRE-drain cursor
+        with open(os.path.join(d, "wal.cursor"), "w") as f:
+            json.dump({"segment": 1, "offset": 0, "replayedTotal": 0,
+                       "deadLetterTotal": 0}, f)
+        # the reaped-segment case is separate; here the segment remains
+        wal2 = WriteAheadLog(d)
+        assert wal2.pending_records() == 3
+        drainer2 = WalDrainer(wal2, sink.insert_batch)
+        assert drainer2.drain_once() == PROGRESS
+        # re-inserted under the SAME pre-assigned ids
+        assert [e.event_id for e, _, _ in sink.inserted] == [
+            e.event_id for e in events] * 2
+
+
+# ---------------------------------------------------------------------------
+# disk budget / backpressure
+# ---------------------------------------------------------------------------
+
+class TestBudget:
+    def test_budget_flip_and_recovery(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"), max_bytes=1500)
+        appended = 0
+        with pytest.raises(WalFullError):
+            for i in range(1000):
+                wal.append(encode_record(make_event(i), 1, None))
+                appended += 1
+        assert 0 < appended < 1000
+        assert wal.is_full()
+        # draining frees budget: appends succeed again
+        sink = Sink()
+        drainer = WalDrainer(wal, sink.insert_batch)
+        assert drainer.drain_once() == PROGRESS
+        assert not wal.is_full()
+        wal.append(encode_record(make_event(5000), 1, None))
+        assert wal.pending_records() == 1
+
+    def test_backpressure_hint_shrinks_with_depth(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        fill(wal, 100)
+        sink = Sink()
+        drainer = WalDrainer(wal, sink.insert_batch, batch_max=10)
+        assert drainer.backpressure_hint() is None  # no rate observed yet
+        drainer.drain_once()
+        drainer.drain_once()
+        rate = drainer.drain_rate()
+        assert rate is not None and rate > 0
+        hint_deep = drainer.backpressure_hint()
+        # drain more: at a comparable rate the hint must shrink with
+        # depth (pin the formula's monotonicity, not the wall clock)
+        with drainer._lock:
+            drainer._rate_ewma = rate
+        depth_before = wal.pending_records()
+        while wal.pending_records() > depth_before // 4:
+            drainer.drain_once()
+        with drainer._lock:
+            drainer._rate_ewma = rate
+        hint_shallow = drainer.backpressure_hint()
+        assert hint_shallow is not None and hint_deep is not None
+        assert hint_shallow <= hint_deep
+
+    def test_mode_gauge_values(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"), max_bytes=1200)
+        sink = Sink()
+        drainer = WalDrainer(wal, sink.insert_batch)
+        assert drainer.mode() == 0                   # idle
+        appended = 0
+        try:
+            for i in range(100):
+                wal.append(encode_record(make_event(i), 1, None))
+                appended += 1
+        except WalFullError:
+            pass
+        assert drainer.mode() == 2                   # backpressure
+        drainer.drain_once()
+        assert wal.pending_records() == 0
+        assert drainer.mode() == 0
+
+    def test_pio_wal_cli_round_trip(self, tmp_path, capsys, monkeypatch):
+        """`pio wal status` (non-mutating) -> `replay` (drains into the
+        configured storage) -> `dead-letter` (empty) — the operator
+        surface over a real journal directory."""
+        from predictionio_tpu.cli.pio import main
+
+        d = str(tmp_path / "wal")
+        wal = WriteAheadLog(d)
+        events = fill(wal, 4)
+        wal.close()
+        for var, val in {
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }.items():
+            monkeypatch.setenv(var, val)
+        assert main(["wal", "status", "--wal-dir", d, "--format",
+                     "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["depth"] == 4 and doc["tornTail"] is False
+        assert main(["wal", "replay", "--wal-dir", d]) == 0
+        assert "replay complete" in capsys.readouterr().out
+        # drained into the env-configured store is proven by depth 0 +
+        # replayedTotal (the CLI builds its own Storage; the memory
+        # backend is per-process so contents are checked in the
+        # event-server suites)
+        assert main(["wal", "status", "--wal-dir", d, "--format",
+                     "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["depth"] == 0 and doc["replayedTotal"] == 4
+        assert main(["wal", "dead-letter", "--wal-dir", d]) == 0
+        assert "no dead-letter records" in capsys.readouterr().out
+        assert events  # silence the unused-variable lint
+
+    def test_zero_byte_crc_integrity(self, tmp_path):
+        """The frame CRC is over the payload — pin the actual zlib
+        polynomial so on-disk journals survive module refactors."""
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        e = make_event(1)
+        payload = encode_record(e, 1, None)
+        wal.append(payload)
+        wal.close()
+        raw = open(os.path.join(str(tmp_path / "wal"),
+                                "wal-00000001.seg"), "rb").read()
+        length, crc = _HEADER.unpack_from(raw, 0)
+        assert length == len(payload)
+        assert crc == zlib.crc32(payload)

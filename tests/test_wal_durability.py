@@ -1,0 +1,369 @@
+"""Chaos end-to-end pins for the durable-ingest WAL (ISSUE 13): a hard
+storage outage under live HTTP ingest loses ZERO events and serves
+ZERO 5xx while under the journal's disk budget; post-drain storage
+contents exactly equal the no-outage run (order and acknowledged ids);
+and a ``kill -9`` of the event server mid-journal recovers by
+truncating the torn tail and replaying every acknowledged record.
+
+Ride-through semantics proven here, WAL internals in tests/test_wal.py,
+batch per-event statuses in tests/test_event_server.py."""
+
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.storage.base import AccessKey, App, EventFilter
+from predictionio_tpu.storage.registry import Storage
+
+pytestmark = [pytest.mark.wal, pytest.mark.chaos]
+
+SEED = 20260804
+
+
+def chaos_storage(fault_rate: str = "0.0") -> Storage:
+    """All three repositories on a chaos-wrapped MEMORY backend with a
+    tight retry budget (outage flips must surface fast, not after 12
+    invisible retries)."""
+    return Storage({
+        "PIO_STORAGE_SOURCES_C_TYPE": "chaos",
+        "PIO_STORAGE_SOURCES_C_TARGET": "memory",
+        "PIO_STORAGE_SOURCES_C_FAULT_RATE": fault_rate,
+        "PIO_STORAGE_SOURCES_C_SEED": str(SEED),
+        "PIO_STORAGE_SOURCES_C_RETRY_MAX_ATTEMPTS": "2",
+        "PIO_STORAGE_SOURCES_C_RETRY_BASE_DELAY_MS": "1",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "C",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "C",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "C",
+    })
+
+
+def post_json(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def event_payload(client: int, j: int) -> dict:
+    t = (datetime.datetime(2024, 3, 1, tzinfo=datetime.timezone.utc)
+         + datetime.timedelta(seconds=j, milliseconds=client))
+    return {
+        "event": "rate", "entityType": "user",
+        "entityId": f"c{client}-u{j}",
+        "targetEntityType": "item", "targetEntityId": f"i{j % 7}",
+        "properties": {"rating": j % 5},
+        # explicit eventTime: the no-outage and outage runs must store
+        # IDENTICAL sequences, so nothing may default to arrival time
+        "eventTime": t.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z",
+    }
+
+
+def stored_sequence(storage: Storage, app_id: int):
+    """The find() ordering contract: (eventTime, then the backend's id
+    tiebreak). Compared between runs on the time-ordered payload keys."""
+    return [
+        (e.event, e.entity_id, e.target_entity_id, e.event_time,
+         e.properties.to_json())
+        for e in storage.get_events().find(app_id, None, EventFilter())
+    ]
+
+
+def wait_until(predicate, timeout=20.0, interval=0.05):
+    """Deadline-poll (never assert the first read — the drainer races
+    the HTTP response on a small host)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+class TestOutageRideThrough:
+    def test_hard_outage_zero_loss_zero_5xx_exact_contents(self, tmp_path):
+        """THE headline chaos pin: T seconds of total backend outage
+        under live multi-threaded HTTP ingest (singles + batches) →
+        every response 2xx, zero 5xx, and after recovery + drain the
+        stored sequence exactly equals a no-outage run of the same
+        traffic."""
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+
+        n_clients, per_client = 4, 30
+        # -- reference run: same traffic, healthy backend -------------
+        ref = Storage({
+            "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        })
+        ref_app = ref.get_meta_data_apps().insert(App(0, "RefApp"))
+        ref.get_events().init(ref_app)
+        from predictionio_tpu.core.json_codec import event_from_json
+
+        # insertion order is irrelevant to find()'s (eventTime, id)
+        # ordering and every payload's eventTime is distinct, so the
+        # reference sequence is deterministic
+        for c in range(n_clients):
+            for j in range(per_client):
+                ref.get_events().insert(
+                    event_from_json(event_payload(c, j)), ref_app)
+
+        # -- chaos run ------------------------------------------------
+        storage = chaos_storage("0.0")
+        app_id = storage.get_meta_data_apps().insert(App(0, "WalApp"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey("walkey", app_id, ()))
+        storage.get_events().init(app_id)
+        server = EventServer(storage, EventServerConfig(
+            ip="127.0.0.1", port=0, wal_dir=str(tmp_path / "wal")))
+        server.start()
+        chaos_client = storage.client_for_source("C")
+        statuses: list[tuple[int, dict]] = []
+        lock = threading.Lock()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            single_url = f"{base}/events.json?accessKey=walkey"
+            batch_url = f"{base}/batch/events.json?accessKey=walkey"
+
+            def client(c):
+                for j in range(per_client):
+                    if c == 0 and j % 3 == 2:
+                        s, b = post_json(batch_url, [event_payload(c, j)])
+                        result = (s if s >= 300 else b[0]["status"],
+                                  b[0] if s < 300 else b)
+                    else:
+                        result = post_json(single_url, event_payload(c, j))
+                    with lock:
+                        statuses.append(result)
+                    time.sleep(0.005)
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(n_clients)]
+            for t in threads:
+                t.start()
+            # a beat of healthy traffic (warms the auth cache), then a
+            # HARD outage window, then recovery
+            time.sleep(0.15)
+            chaos_client.injector.set_fault_rate(1.0)
+            time.sleep(0.6)
+            chaos_client.injector.set_fault_rate(0.0)
+            for t in threads:
+                t.join()
+
+            # zero loss, zero 5xx: every accepted answer is 201 or 202
+            codes = [s for s, _ in statuses]
+            assert len(codes) == n_clients * per_client
+            assert all(c in (201, 202) for c in codes), sorted(set(codes))
+            assert 202 in codes, "outage window produced no journaled acks"
+            assert 201 in codes, "healthy windows produced no direct acks"
+
+            # drain completes (deadline-poll; the drainer races us)
+            wal = server.service.wal
+            assert wait_until(lambda: wal.pending_records() == 0), \
+                wal.stats()
+            assert wal.stats()["deadLetterTotal"] == 0
+
+            # post-drain contents EXACTLY equal the no-outage run
+            got = stored_sequence(storage, app_id)
+            want = stored_sequence(ref, ref_app)
+            assert got == want
+
+            # every acknowledged id is the stored id (202s included)
+            acked_ids = {b["eventId"] for s, b in statuses}
+            stored_ids = {e.event_id for e in storage.get_events().find(
+                app_id, None, EventFilter())}
+            assert acked_ids == stored_ids
+
+            # the mode gauge saw the ride-through and returned to idle
+            metrics = urllib.request.urlopen(
+                f"{base}/metrics", timeout=10).read().decode()
+            assert "pio_ingest_wal_mode 0" in metrics
+            assert "pio_ingest_wal_replayed_total" in metrics
+        finally:
+            server.stop()
+            storage.close()
+
+    def test_disk_budget_flips_to_503_and_back(self, tmp_path):
+        """Bounded honestly: at the WAL disk budget ingest sheds 503 +
+        Retry-After again; once the backend recovers and the backlog
+        drains, 2xx resumes."""
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+
+        storage = chaos_storage("0.0")
+        app_id = storage.get_meta_data_apps().insert(App(0, "BudgetApp"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey("bk", app_id, ()))
+        storage.get_events().init(app_id)
+        server = EventServer(storage, EventServerConfig(
+            ip="127.0.0.1", port=0,
+            wal_dir=str(tmp_path / "wal"), wal_max_bytes=4000))
+        server.start()
+        chaos_client = storage.client_for_source("C")
+        try:
+            url = (f"http://127.0.0.1:{server.port}"
+                   "/events.json?accessKey=bk")
+            assert post_json(url, event_payload(9, 0))[0] == 201  # warm
+            chaos_client.injector.set_fault_rate(1.0)
+            saw_202 = saw_503 = False
+            retry_after = None
+            for j in range(1, 120):
+                s, body = post_json(url, event_payload(9, j))
+                assert s in (202, 503), (s, body)
+                saw_202 |= s == 202
+                if s == 503:
+                    saw_503 = True
+                    break
+            assert saw_202 and saw_503
+            assert server.service.wal.is_full()
+            # readyz is honest about a full journal during the outage
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/readyz", timeout=10)
+            assert e.value.code == 503
+
+            # recovery: drain empties the journal, acceptance resumes
+            chaos_client.injector.set_fault_rate(0.0)
+            wal = server.service.wal
+            assert wait_until(lambda: wal.pending_records() == 0), \
+                wal.stats()
+            s, _ = post_json(url, event_payload(9, 500))
+            assert s == 201
+            assert not wal.is_full()
+        finally:
+            server.stop()
+            storage.close()
+
+    def test_write_through_policy_always_journals(self, tmp_path):
+        """The top rung: every accepted event answers 202 and storage
+        is written exclusively by the drainer."""
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.utils.testing import memory_storage
+
+        storage = memory_storage()
+        app_id = storage.get_meta_data_apps().insert(App(0, "WtApp"))
+        storage.get_meta_data_access_keys().insert(
+            AccessKey("wt", app_id, ()))
+        storage.get_events().init(app_id)
+        server = EventServer(storage, EventServerConfig(
+            ip="127.0.0.1", port=0, wal_dir=str(tmp_path / "wal"),
+            wal_policy="write-through"))
+        server.start()
+        try:
+            url = (f"http://127.0.0.1:{server.port}"
+                   "/events.json?accessKey=wt")
+            burl = (f"http://127.0.0.1:{server.port}"
+                    "/batch/events.json?accessKey=wt")
+            s, body = post_json(url, event_payload(1, 0))
+            assert s == 202 and body["durability"] == "journaled"
+            s, results = post_json(burl, [event_payload(1, 1),
+                                          {"event": "x"},  # invalid
+                                          event_payload(1, 2)])
+            assert s == 200
+            assert [r["status"] for r in results] == [202, 400, 202]
+            assert wait_until(
+                lambda: server.service.wal.pending_records() == 0)
+            stored = list(storage.get_events().find(app_id))
+            assert {e.entity_id for e in stored} == {
+                "c1-u0", "c1-u1", "c1-u2"}
+        finally:
+            server.stop()
+            storage.close()
+
+
+class TestKill9Recovery:
+    def test_kill9_mid_journal_truncates_torn_tail_and_replays(
+            self, tmp_path):
+        """kill -9 the event server while clients stream journaled
+        writes; recovery truncates the torn tail (simulated on top of
+        whatever the kill left) and replays EVERY acknowledged event —
+        fsync=always means a 202 is a durability promise that must
+        survive SIGKILL."""
+        wal_dir = str(tmp_path / "wal")
+        db = str(tmp_path / "child.db")
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__),
+                          "wal_eventserver_child.py"),
+             "--db", db, "--wal-dir", wal_dir],
+            stdout=subprocess.PIPE, text=True)
+        acked: list[tuple[str, str]] = []   # (entityId, eventId)
+        try:
+            app_id = port = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and port is None:
+                line = proc.stdout.readline().strip()
+                if line.startswith("APP_ID="):
+                    app_id = int(line.split("=", 1)[1])
+                elif line.startswith("PORT="):
+                    port = int(line.split("=", 1)[1])
+            assert app_id is not None and port is not None, \
+                "child never became ready"
+            url = f"http://127.0.0.1:{port}/events.json?accessKey=walkey"
+            kill_after = 20
+            for j in range(200):
+                payload = event_payload(0, j)
+                try:
+                    s, body = post_json(url, payload)
+                except (ConnectionError, OSError):
+                    break  # the kill ripped this connection
+                if s == 202:
+                    acked.append((payload["entityId"], body["eventId"]))
+                if len(acked) == kill_after:
+                    # SIGKILL mid-stream: no flush, no atexit, nothing
+                    os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+            assert len(acked) >= kill_after
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+        # simulate the worst mid-append artifact on top of the real
+        # kill state: a partial frame at the tail of the last segment
+        segs = sorted(f for f in os.listdir(wal_dir)
+                      if f.startswith("wal-") and f.endswith(".seg"))
+        assert segs, "child journaled nothing"
+        with open(os.path.join(wal_dir, segs[-1]), "ab") as f:
+            f.write(b"\xde\xad\xbe")  # torn: shorter than a header
+
+        # recovery + replay into a fresh healthy store
+        from predictionio_tpu.data.wal import WalDrainer, WriteAheadLog
+        from predictionio_tpu.utils.testing import memory_storage
+
+        out = memory_storage()
+        out.get_events().init(app_id)
+        wal = WriteAheadLog(wal_dir)
+        assert wal.torn_bytes_truncated >= 3
+        drainer = WalDrainer(wal, out.get_events().insert_batch)
+        while wal.pending_records():
+            verdict = drainer.drain_once()
+            assert verdict in ("progress", "empty"), verdict
+        assert wal.stats()["deadLetterTotal"] == 0
+
+        stored = {(e.entity_id, e.event_id)
+                  for e in out.get_events().find(app_id)}
+        # every 202-acknowledged event survived the SIGKILL, under the
+        # exact id the client was given
+        assert set(acked) <= stored

@@ -1,0 +1,77 @@
+"""A real EventServer subprocess for the WAL durability suite: sqlite
+metadata (healthy — auth must work) over a chaos-wrapped EVENTDATA
+repository pinned at total outage, so every accepted event journals to
+the WAL (``fsync=always``: each 202 is crash-durable BEFORE it is
+acknowledged). The parent kill -9s this process mid-ingest and proves
+the journal replays every acknowledged event after a torn-tail
+recovery.
+
+Usage: python tests/wal_eventserver_child.py --db F --wal-dir D \
+           [--fault-rate 1.0]
+
+Prints ``APP_ID=<n>`` then ``PORT=<n>`` (the READY signal) on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# launched as `python tests/wal_eventserver_child.py`: sys.path[0] is
+# tests/, so the in-repo package needs the repo root added explicitly
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--db", required=True)
+    parser.add_argument("--wal-dir", required=True)
+    parser.add_argument("--fault-rate", type=float, default=1.0)
+    args = parser.parse_args()
+
+    from predictionio_tpu.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.storage.base import AccessKey, App
+    from predictionio_tpu.storage.registry import Storage
+
+    # setup runs against plain sqlite (the outage must not block it)
+    setup = Storage({
+        "PIO_STORAGE_SOURCES_S_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_S_PATH": args.db,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+    })
+    app_id = setup.get_meta_data_apps().insert(App(0, "WalChildApp"))
+    setup.get_meta_data_access_keys().insert(AccessKey("walkey", app_id, ()))
+    setup.get_events().init(app_id)
+    setup.close()
+    print(f"APP_ID={app_id}", flush=True)
+
+    # the server: healthy sqlite metadata, chaos-dead eventdata — every
+    # insert raises StorageUnavailableError and rides into the WAL
+    storage = Storage({
+        "PIO_STORAGE_SOURCES_S_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_S_PATH": args.db,
+        "PIO_STORAGE_SOURCES_C_TYPE": "chaos",
+        "PIO_STORAGE_SOURCES_C_TARGET": "sqlite",
+        "PIO_STORAGE_SOURCES_C_TARGET_PATH": args.db,
+        "PIO_STORAGE_SOURCES_C_FAULT_RATE": str(args.fault_rate),
+        "PIO_STORAGE_SOURCES_C_RETRY_MAX_ATTEMPTS": "2",
+        "PIO_STORAGE_SOURCES_C_RETRY_BASE_DELAY_MS": "1",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "C",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+    })
+    server = EventServer(storage, EventServerConfig(
+        ip="127.0.0.1", port=0, wal_dir=args.wal_dir, wal_fsync="always"))
+    print(f"PORT={server.port}", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()
