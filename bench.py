@@ -776,6 +776,17 @@ def bench_data_plane():
     return bench_ingest.bench_section()
 
 
+def bench_freshness_section(shrunk: bool = False):
+    """Real-time freshness plane (bench_freshness.py; committed
+    artifacts: BENCH_freshness_rNN.json): event→recommendation lag
+    distribution under live HTTP ingest+query load, fold-in throughput
+    in events/s, and the `--workers 2` spool-propagation variant. CPU +
+    storage bound — runs (shrunk) under --skip-heavy."""
+    import bench_freshness
+
+    return bench_freshness.bench_section(shrunk=shrunk)
+
+
 def bench_train_profile():
     """Tiny `pio train --profile` on the recommendation template — the
     device/compiler observability trajectory (PR 12,
@@ -1283,6 +1294,8 @@ def main() -> None:
          lambda: bench_ann_retrieval(shrunk=args.skip_heavy)),
         ("workers_scaling",
          lambda: bench_workers_scaling(shrunk=args.skip_heavy)),
+        ("freshness",
+         lambda: bench_freshness_section(shrunk=args.skip_heavy)),
         ("train_profile", bench_train_profile),
     ]
     failed = []
@@ -1293,8 +1306,10 @@ def main() -> None:
         # ann_retrieval runs SHRUNK (one small indexable catalog), and
         # workers_scaling SHRUNK (small catalog, no 1M ANN re-run);
         # train_profile is a seconds-scale tiny train either way
+        # freshness rides along shrunk: CPU + storage bound like
+        # data_plane, no device involvement
         keep = ("quality", "ingest", "data_plane", "ann_retrieval",
-                "workers_scaling", "train_profile")
+                "workers_scaling", "freshness", "train_profile")
         failed.extend(s[0] for s in sections if s[0] not in keep)
         sections = [s for s in sections if s[0] in keep]
     for section, fn in sections:

@@ -1,0 +1,345 @@
+"""bench_freshness — event→recommendation freshness under live load.
+
+Measures the speed layer (predictionio_tpu/online/; `pio deploy
+--online`) end to end over real HTTP: a rating POSTed to the event
+server must change that user's /queries.json answer without a retrain.
+
+Phases (BENCH_freshness_rNN.json):
+
+- **lag probe** — per round: read the probe user's top recommendation,
+  POST a 5-star rating for exactly that item through the event server,
+  and poll /queries.json until the item disappears (seen-exclusion is
+  the observable: deterministic, no score-threshold guesswork). The
+  event→serve lag distribution is reported as p50/p95/max. Rounds run
+  under LIVE background load — query threads + an HTTP ingest thread —
+  so the number includes real contention, and every response across
+  all threads is status-checked (``freshness_http_5xx`` must be 0).
+- **fold-in throughput** — bulk-insert a burst of ratings spread over
+  many users and time until the fold loop has applied them all:
+  events/s through tail→solve→publish (each touched user pays one
+  full-history read + one rank x rank solve per cycle).
+- **workers variant** — two engine servers share a spool
+  (`--workers 2` shape): the lag probe drives the NON-leading sibling,
+  so the number includes the leader's fold + spool snapshot
+  propagation + the sibling's adoption.
+
+In-process servers (threads, not subprocesses): the fold loop and the
+HTTP handlers GIL-couple exactly like a real single worker, and the
+1-core bench host (memory note bench-host-cores) cannot host a
+subprocess fleet without time-slicing noise swamping the signal.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+TAIL_INTERVAL_S = 0.2
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _seed_storage(tmp, n_users, n_items):
+    from predictionio_tpu.core.datamap import DataMap
+    from predictionio_tpu.core.event import Event
+    from predictionio_tpu.storage.base import AccessKey, App
+    from predictionio_tpu.storage.registry import Storage
+
+    storage = Storage({
+        "PIO_STORAGE_SOURCES_S_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_S_PATH": f"{tmp}/pio.db",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+    })
+    app_id = storage.get_meta_data_apps().insert(App(0, "FreshApp"))
+    storage.get_meta_data_access_keys().insert(
+        AccessKey("fresh-key", app_id, []))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(0)
+    batch = []
+    for u in range(n_users):
+        for i in range(n_items):
+            if i % 2 == u % 2 and rng.random() < 0.8:
+                batch.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5.0})))
+    events.insert_batch(batch, app_id)
+    return storage, app_id
+
+
+def _train(storage, tmp):
+    from predictionio_tpu.workflow.train import run_train
+
+    os.environ["PIO_MODEL_DIR"] = os.path.join(tmp, "models")
+    outcome = run_train(variant={
+        "id": "fresh",
+        "engineFactory":
+            "predictionio_tpu.templates.recommendation.engine_factory",
+        "datasource": {"params": {"app_name": "FreshApp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 8, "num_iterations": 6, "lambda_": 0.05,
+                        "seed": 1}}],
+    }, storage=storage)
+    assert outcome.status == "COMPLETED", outcome.status
+
+
+class _Counters:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.requests = 0
+        self.http_5xx = 0
+
+    def record(self, status):
+        with self.lock:
+            self.requests += 1
+            if status >= 500:
+                self.http_5xx += 1
+
+
+def _query(port, user, num, counters):
+    try:
+        status, body = _post(f"http://127.0.0.1:{port}/queries.json",
+                             {"user": user, "num": num})
+    except urllib.error.HTTPError as e:
+        counters.record(e.code)
+        raise
+    counters.record(status)
+    return [s["item"] for s in body["itemScores"]]
+
+
+def _probe_lag(engine_port, event_port, user, counters,
+               timeout_s=20.0):
+    """One probe round: rate the user's current favorite, return the
+    seconds until it disappears from their recommendations."""
+    recs = _query(engine_port, user, 6, counters)
+    if not recs:
+        return None
+    target = recs[0]
+    t0 = time.time()
+    status, _ = _post(
+        f"http://127.0.0.1:{event_port}/events.json?accessKey=fresh-key",
+        {"event": "rate", "entityType": "user", "entityId": user,
+         "targetEntityType": "item", "targetEntityId": target,
+         "properties": {"rating": 5.0}})
+    counters.record(status)
+    deadline = t0 + timeout_s
+    while time.time() < deadline:
+        if target not in _query(engine_port, user, 6, counters):
+            return time.time() - t0
+        time.sleep(0.02)
+    return None
+
+
+def _background_load(engine_port, event_port, counters, stop,
+                     n_users):
+    """Live load during the probes: two query clients + one HTTP
+    ingest client on non-probe users."""
+
+    def querier(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            try:
+                _query(engine_port, f"u{int(rng.integers(n_users))}",
+                       5, counters)
+            except Exception:
+                pass
+
+    def ingester():
+        rng = np.random.default_rng(99)
+        url = (f"http://127.0.0.1:{event_port}/batch/events.json"
+               f"?accessKey=fresh-key")
+        while not stop.is_set():
+            u = int(rng.integers(n_users))
+            payload = [{"event": "rate", "entityType": "user",
+                        "entityId": f"u{u}",
+                        "targetEntityType": "item",
+                        "targetEntityId": f"i{int(rng.integers(4))}",
+                        "properties": {"rating": float(rng.integers(1, 6))}}]
+            try:
+                status, _ = _post(url, payload)
+                counters.record(status)
+            except Exception:
+                pass
+            stop.wait(0.05)
+
+    threads = [threading.Thread(target=querier, args=(s,), daemon=True)
+               for s in (1, 2)]
+    threads.append(threading.Thread(target=ingester, daemon=True))
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _lag_stats(lags_s):
+    ms = sorted(1000.0 * v for v in lags_s)
+    return {
+        "p50": round(statistics.median(ms), 1),
+        "p95": round(ms[min(len(ms) - 1, int(0.95 * len(ms)))], 1),
+        "max": round(ms[-1], 1),
+    }
+
+
+def bench_freshness(n_users: int = 32, n_items: int = 16,
+                    probe_rounds: int = 10,
+                    foldin_events: int = 1500,
+                    workers_rounds: int = 6,
+                    interval_s: float = TAIL_INTERVAL_S) -> dict:
+    from predictionio_tpu.api.engine_server import create_engine_server
+    from predictionio_tpu.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.core.datamap import DataMap
+    from predictionio_tpu.core.event import Event
+    from predictionio_tpu.workflow.deploy import ServerConfig
+
+    out: dict = {
+        "freshness_tail_interval_ms": round(interval_s * 1000.0, 1),
+        "freshness_probe_rounds": probe_rounds,
+        "host_cores": os.cpu_count(),
+    }
+    counters = _Counters()
+    with tempfile.TemporaryDirectory() as tmp:
+        storage, app_id = _seed_storage(tmp, n_users, n_items)
+        _train(storage, tmp)
+        engine = create_engine_server(storage=storage, config=ServerConfig(
+            ip="127.0.0.1", port=0, online=True,
+            online_interval_s=interval_s))
+        engine.start()
+        eventsrv = EventServer(
+            storage, EventServerConfig(ip="127.0.0.1", port=0))
+        eventsrv.start()
+        stop = threading.Event()
+        try:
+            # warm both serving paths (base + overlay merge) so the
+            # probes never time an XLA compile
+            _probe_lag(engine.port, eventsrv.port, "u1", counters)
+            load = _background_load(engine.port, eventsrv.port,
+                                    counters, stop, n_users)
+            lags = []
+            for r in range(probe_rounds):
+                lag = _probe_lag(engine.port, eventsrv.port,
+                                 f"u{2 + (r % (n_users - 2))}", counters)
+                if lag is not None:
+                    lags.append(lag)
+            stop.set()
+            for t in load:
+                t.join(timeout=5)
+            if lags:
+                stats = _lag_stats(lags)
+                out["freshness_lag_p50_ms"] = stats["p50"]
+                out["freshness_lag_p95_ms"] = stats["p95"]
+                out["freshness_lag_max_ms"] = stats["max"]
+            # fold-in throughput: a burst across many users, timed
+            # until the loop has folded every event
+            svc = engine.service.online
+            before = svc.metrics()["foldedEventsTotal"]
+            rng = np.random.default_rng(7)
+            burst = [Event(
+                event="rate", entity_type="user",
+                entity_id=f"u{int(rng.integers(n_users))}",
+                target_entity_type="item",
+                target_entity_id=f"i{int(rng.integers(n_items))}",
+                properties=DataMap({"rating": float(rng.integers(1, 6))}))
+                for _ in range(foldin_events)]
+            t0 = time.perf_counter()
+            storage.get_events().insert_batch(burst, app_id)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if svc.metrics()["foldedEventsTotal"] - before \
+                        >= foldin_events:
+                    break
+                time.sleep(0.02)
+            folded = svc.metrics()["foldedEventsTotal"] - before
+            dt = time.perf_counter() - t0
+            out["freshness_foldin_events_per_sec"] = round(folded / dt, 1)
+            out["freshness_foldin_burst_events"] = folded
+        finally:
+            stop.set()
+            eventsrv.stop()
+            engine.stop()
+
+    # --workers 2 variant: the probe drives the NON-leading sibling, so
+    # the lag includes fold + spool snapshot propagation + adoption
+    with tempfile.TemporaryDirectory() as tmp:
+        storage, app_id = _seed_storage(tmp, n_users, n_items)
+        _train(storage, tmp)
+        spool = os.path.join(tmp, "spool")
+        servers = []
+        eventsrv = None
+        try:
+            for _ in range(2):
+                s = create_engine_server(
+                    storage=storage,
+                    config=ServerConfig(
+                        ip="127.0.0.1", port=0, online=True,
+                        online_interval_s=interval_s,
+                        worker_spool_dir=spool,
+                        admin_sync_interval_s=interval_s))
+                s.start()
+                servers.append(s)
+            eventsrv = EventServer(
+                storage, EventServerConfig(ip="127.0.0.1", port=0))
+            eventsrv.start()
+            deadline = time.time() + 10
+            follower = None
+            while time.time() < deadline and follower is None:
+                for s in servers:
+                    m = s.service.online.metrics()
+                    if s.service.online._lease is not None \
+                            and not m["leader"]:
+                        follower = s
+                time.sleep(0.05)
+            probe_port = (follower or servers[-1]).port
+            _probe_lag(probe_port, eventsrv.port, "u1", counters)
+            lags = []
+            for r in range(workers_rounds):
+                lag = _probe_lag(probe_port, eventsrv.port,
+                                 f"u{2 + (r % (n_users - 2))}", counters)
+                if lag is not None:
+                    lags.append(lag)
+            if lags:
+                out["freshness_workers_lag_p50_ms"] = \
+                    _lag_stats(lags)["p50"]
+        finally:
+            if eventsrv is not None:
+                eventsrv.stop()
+            for s in servers:
+                s.stop()
+    out["freshness_http_requests"] = counters.requests
+    out["freshness_http_5xx"] = counters.http_5xx
+    return out
+
+
+def bench_section(shrunk: bool = False) -> dict:
+    """The bench.py ``freshness`` section (CPU + storage bound, runs
+    under --skip-heavy too; full artifacts: BENCH_freshness_rNN.json)."""
+    if shrunk:
+        return bench_freshness(n_users=16, n_items=12, probe_rounds=4,
+                               foldin_events=300, workers_rounds=2)
+    return bench_freshness()
+
+
+if __name__ == "__main__":
+    result = bench_section()
+    print(json.dumps(result, indent=2))
+    with open("BENCH_freshness_r01.json", "w") as f:
+        json.dump(result, f, indent=2)

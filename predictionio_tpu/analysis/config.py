@@ -97,8 +97,12 @@ COMPUTE_PATHS = ("ops/", "models/", "e2/")
 #: retrieval kernels (ops/ann.py — PR 8), whose probe/rescore path
 #: answers every sublinear query (build/quality helpers are host-side
 #: by design and carry justified suppressions)
+#: online/ rides along (PR 14): the overlay reads sit INSIDE every
+#: recommendation query once --online is live, and the fold loop's
+#: deliberate host syncs (per-generation constants, per-user gathers on
+#: the background tail thread) carry justified suppressions
 HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/", "obs/",
-             "fleet/", "ops/ann.py")
+             "fleet/", "ops/ann.py", "online/")
 
 
 def default_config() -> LintConfig:
@@ -119,7 +123,13 @@ def default_config() -> LintConfig:
                 # transport's connect, declared below; everything else
                 # in the fleet tier must reach replicas only through
                 # resilient()-routed exchanges
+                # online/ (PR 14): the freshness plane reaches storage
+                # only through the DAO layer's resilient() wrappers
+                # (the tail reads and per-user history fetches) and
+                # does no network I/O of its own — the spool plane is
+                # files, the overlay is memory
                 paths=("storage/", "serving/", "data/", "obs/", "fleet/",
+                       "online/",
                        "api/event_server.py", "api/router_server.py"),
                 options={
                     # raw-network callables we police
@@ -201,9 +211,13 @@ def default_config() -> LintConfig:
             # drainer's retry loop must ride clock.sleep/Event.wait —
             # a bare time.sleep there is unstoppable during shutdown
             # and untestable on a ManualClock
+            # online/ (PR 14): the fold loop must ride Event.wait (a
+            # bare time.sleep is unstoppable during shutdown and
+            # untestable on a ManualClock), and any cross-process
+            # fetch growing there must carry a timeout
             "untimed-blocking-io": RuleConfig(
                 paths=("api/", "storage/", "fleet/", "obs/", "cli/",
-                       "serving/", "data/wal.py"),
+                       "serving/", "data/wal.py", "online/"),
                 options={
                     "policed_calls": {
                         "urlopen": 2, "create_connection": 1,
@@ -224,7 +238,8 @@ def default_config() -> LintConfig:
                     # Event.wait (PR 9; docs/static-analysis.md)
                     "banned_sleep_paths": ["fleet/",
                                            "serving/workers.py",
-                                           "data/wal.py"],
+                                           "data/wal.py",
+                                           "online/"],
                 },
             ),
             "lock-discipline": RuleConfig(paths=("",)),

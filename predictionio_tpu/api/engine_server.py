@@ -112,6 +112,7 @@ from predictionio_tpu.obs.registry import (
     HistogramFamily,
     Metric,
     MetricRegistry,
+    online_collector,
     resilience_collector,
     server_info_collector,
     serving_collector,
@@ -390,6 +391,13 @@ class EngineService:
         #: admin.state document
         self.worker_hub = None
         self.coherence: WorkerCoherence | None = None
+        #: base-model generation: bumped on every successful /reload
+        #: (to the pool's shared reload sequence under --workers, so
+        #: generations are comparable across siblings). The online
+        #: fold-in plane fences on it: a delta computed against
+        #: generation G is discarded, never applied, once a reload
+        #: lands G+1 (online/overlay.py; docs/freshness.md)
+        self.model_generation = 0
         if config.worker_spool_dir:
             from predictionio_tpu.fleet.workers import WorkerHub
 
@@ -412,6 +420,7 @@ class EngineService:
             # the drain latch and retrieval config apply for real
             if self.cache is not None and adopted["reloadSeq"] > 0:
                 self.cache.invalidate(generation=adopted["reloadSeq"])
+            self.model_generation = adopted["reloadSeq"]
             if adopted["draining"]:
                 with self._reload_lock:
                     self._draining = True
@@ -429,6 +438,41 @@ class EngineService:
                         "apply; serving %s retrieval",
                         adopted["retrieval"], self.config.retrieval)
             self.coherence.start()
+        #: real-time freshness plane (`pio deploy --online`; online/,
+        #: docs/freshness.md): tails the event store, folds touched
+        #: users' ALS vectors closed-form between retrains, publishes
+        #: generation-fenced deltas into the serving overlay with
+        #: per-user result-cache invalidation, and propagates across
+        #: `--workers` siblings over the spool plane
+        self.online = None
+        if config.online:
+            from predictionio_tpu.online.service import OnlineFoldIn
+
+            self.online = OnlineFoldIn(
+                storage=storage,
+                deployed_fn=lambda: self.deployed,
+                generation_fn=lambda: self.model_generation,
+                interval_s=config.online_interval_s,
+                overlay_max=config.online_overlay_max,
+                state_dir=config.online_state_dir or None,
+                invalidate_user=self._invalidate_user_results,
+                trace_log=self.trace_log,
+                tracing=self.tracing,
+                worker_hub=self.worker_hub,
+            )
+            self.online.start()
+            self.registry.register(online_collector(self.online))
+
+    def _invalidate_user_results(self, user_id: str) -> None:
+        """Drop exactly one user's result-cache entries after their
+        vector was re-folded — targeted, instead of the pool-wide
+        generation bump a /reload takes (every OTHER user's warm
+        entries stay warm; the whole point of a speed layer is that
+        freshness does not cost the fleet its cache)."""
+        if self.cache is not None:
+            from predictionio_tpu.online.service import user_key_fragment
+
+            self.cache.invalidate_matching(user_key_fragment(user_id))
 
     @property
     def worker_id(self) -> str | None:
@@ -931,6 +975,10 @@ class EngineService:
             "cache": (
                 {"enabled": True, **self.cache.snapshot()}
                 if self.cache is not None else {"enabled": False}),
+            # the freshness plane's view (docs/freshness.md): overlay
+            # occupancy, fold counters, event→serving lag, tail cursor
+            **({"online": self.online.stats_doc()}
+               if self.online is not None else {}),
             **({"resilience": snap} if (snap := resilience_snapshot()) else {}),
         }
 
@@ -1125,6 +1173,14 @@ class EngineService:
                 # FAILED reload never reaches here, so last-known-good
                 # keeps its warm cache
                 self.cache.invalidate(generation=generation)
+            # the generation fence: advance BEFORE the online plane
+            # hears about the swap, so any fold-in racing this reload
+            # publishes against a generation that no longer exists and
+            # is discarded (overlay.put_* returns False)
+            self.model_generation = (generation if generation is not None
+                                     else self.model_generation + 1)
+            if self.online is not None:
+                self.online.on_model_swapped(self.model_generation)
             logger.info("reloaded: instance %s -> %s", old_id, new.instance.id)
         finally:
             with self._reload_lock:
@@ -1403,6 +1459,8 @@ class EngineServer(RestServer):
             undeploy(ip, port, self.config.server_key)
 
     def _on_close(self) -> None:
+        if self.service.online is not None:
+            self.service.online.close()
         if self.service.coherence is not None:
             self.service.coherence.close()
         if self.service.worker_hub is not None:

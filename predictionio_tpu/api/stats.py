@@ -48,6 +48,7 @@ class ServingStats:
         "dispatches", "batched_queries", "deduped", "expired",
         "cache_hits", "cache_misses", "cache_evictions",
         "cache_expirations", "cache_invalidations",
+        "cache_user_invalidations",
         "ann_queries", "ann_rescored",
     )
 

@@ -150,12 +150,20 @@ class ALSModel:
     #: installs to count ANN dispatches (api/stats.ServingStats)
     _ann_observer: object = dataclasses.field(default=None, repr=False,
                                               compare=False)
+    #: real-time freshness overlay (online/overlay.OnlineOverlay),
+    #: installed by the fold-in service under ``pio deploy --online``
+    #: — per-user vector deltas + brand-new-item vectors consulted by
+    #: the serving paths below (docs/freshness.md); serving wiring,
+    #: never serialized
+    online_overlay: object = dataclasses.field(default=None, repr=False,
+                                               compare=False)
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_default_allow"] = None
         # the observer is serving wiring (holds the stats lock), not model
         state["_ann_observer"] = None
+        state["online_overlay"] = None
         return state
 
     def _allow_or_default(self, allow):
@@ -197,6 +205,31 @@ class ALSModel:
         self.ann_rescore = max(0, int(rescore))
         self._ann_observer = observer
 
+    # ---- real-time freshness overlay (online/; docs/freshness.md) -------
+    def set_online_overlay(self, overlay) -> None:
+        """Install the fold-in service's delta overlay. Queries for
+        users with a delta (and, while overlay ITEMS exist, every
+        recommendation query — the new items must be mergeable for
+        everyone) take the overlay-aware path below."""
+        self.online_overlay = overlay
+
+    def online_delta(self, user_id: str):
+        """The user's fold-in delta, or None (no overlay / not folded)."""
+        overlay = self.online_overlay
+        return overlay.user(user_id) if overlay is not None else None
+
+    def needs_online_path(self, user_id: str) -> bool:
+        """True when a query for ``user_id`` must take the single-query
+        overlay-aware path instead of the batched kernel — the routing
+        hook the template ``batch_predict`` implementations use. True
+        for folded users, and for EVERYONE while overlay items exist
+        (the batched kernel scores only the base catalog; a cold-start
+        item would be invisible to batch-path users)."""
+        overlay = self.online_overlay
+        if overlay is None:
+            return False
+        return overlay.has_items() or overlay.user(user_id) is not None
+
     def set_ann_observer(self, observer) -> None:
         """Install the serving layer's ANN dispatch counter
         (callable(shortlist_width, queries) — e.g.
@@ -236,7 +269,15 @@ class ALSModel:
         exclude_seen: bool = True,
     ) -> list[tuple[str, float]]:
         """Top-``num`` unseen items for one user; [] for unknown users
-        (the reference template's behavior for users absent from training)."""
+        (the reference template's behavior for users absent from
+        training — unless the online overlay folded a vector for them:
+        cold-start-to-served, docs/freshness.md)."""
+        overlay = self.online_overlay
+        delta = overlay.user(user_id) if overlay is not None else None
+        if delta is not None or (overlay is not None
+                                 and overlay.has_items()):
+            return self._recommend_online(user_id, delta, num, allow,
+                                          exclude_seen)
         uix = self.user_ids.get(user_id)
         if uix is None:
             return []
@@ -286,6 +327,87 @@ class ALSModel:
             allow_v, k,
         ))
         return self._gather_results(out[:k].view(np.float32), out[k:], num)
+
+    def _recommend_online(self, user_id: str, delta, num: int,
+                          allow: np.ndarray | None,
+                          exclude_seen: bool) -> list[tuple[str, float]]:
+        """The overlay-aware recommendation path (docs/freshness.md):
+        the query vector is the FOLDED one when a delta exists (falling
+        back to the base row), seen-exclusion unions the base history
+        with the post-training item indices the fold recorded, and —
+        for unfiltered queries — the overlay's brand-new items are
+        brute-scored on the host (a tiny ``(m, K) @ (K,)`` product)
+        and merged into the device top-k. The base catalog is still
+        ranked by the configured retrieval (brute or ANN), so the IVF
+        index is never rebuilt online and unchanged items rank
+        identically (the recall-neutrality pin in tests/test_ann.py)."""
+        uix = self.user_ids.get(user_id)
+        if delta is not None:
+            uv = np.asarray(delta.vector, dtype=np.float32)
+        elif uix is not None:
+            # one K-float host read of the base row — the overlay-items
+            # window's cost for non-folded users
+            uv = np.asarray(self.user_factors[uix], dtype=np.float32)
+        else:
+            return []
+        # captured BEFORE any overflow fold below: delta items bypass
+        # the catalog-indexed allow vector, so business-rule-filtered
+        # queries serve the base catalog only (documented caveat)
+        caller_filtered = allow is not None
+        seen = np.empty(0, dtype=np.int32)
+        if exclude_seen:
+            parts = [self.seen_by_user.get(uix, np.empty(0, dtype=np.int32))
+                     ] if uix is not None else []
+            if delta is not None and delta.extra_seen:
+                parts.append(np.asarray(delta.extra_seen, dtype=np.int32))
+            if parts:
+                seen = np.unique(np.concatenate(parts)).astype(np.int32)
+        if len(seen) > _SEEN_PAD:
+            # same overflow contract as the base path: beyond the
+            # packed width the exclusion folds into the allow vector
+            if allow is None:
+                allow = np.ones((self.item_factors.shape[0],),
+                                dtype=np.float32)
+            else:
+                allow = np.asarray(allow, dtype=np.float32).copy()
+            allow[seen[_SEEN_PAD:]] = 0.0
+            seen = seen[:_SEEN_PAD]
+        allow_v = self._allow_or_default(allow)
+        k = min(_serving_k(num), self.item_factors.shape[0])
+        cols = np.zeros((1, _SEEN_PAD), dtype=np.int32)
+        mask = np.zeros((1, _SEEN_PAD), dtype=np.float32)
+        cols[0, : len(seen)] = seen
+        mask[0, : len(seen)] = 1.0
+        uvj = jnp.asarray(uv[None, :])
+        if self._ann_active():
+            centroids, flat_items, flat_vecs, cell_offset, nprobe, \
+                rescore = self._ann_args()
+            vals, idxs = ann_ops.ann_topk(
+                uvj, self.item_factors, centroids, flat_items,
+                flat_vecs, cell_offset, jnp.asarray(cols),
+                jnp.asarray(mask), allow_v, k, nprobe, rescore)
+            self._record_ann(
+                self.ann_index.shortlist_width(nprobe, rescore), 1)
+        else:
+            vals, idxs = topk_ops.recommend_topk(
+                uvj, self.item_factors, jnp.asarray(cols),
+                jnp.asarray(mask), allow_v, k)
+        base = self._gather_results(
+            np.asarray(vals)[0], np.asarray(idxs)[0], num)
+        if caller_filtered:
+            return base[:num]
+        overlay = self.online_overlay
+        snap = overlay.delta_matrix() if overlay is not None else None
+        if snap is None:
+            return base[:num]
+        ids, matrix = snap
+        scores = matrix @ uv
+        hidden = (set(delta.delta_seen)
+                  if (delta is not None and exclude_seen) else ())
+        merged = base + [(iid, float(s)) for iid, s in zip(ids, scores)
+                         if iid not in hidden]
+        merged.sort(key=lambda kv: kv[1], reverse=True)
+        return merged[:num]
 
     def similar(
         self,

@@ -296,6 +296,66 @@ def wal_collector(wal: Any, drainer: Any) -> Collector:
     return collect
 
 
+def online_collector(svc: Any) -> Collector:
+    """Adopt an :class:`~predictionio_tpu.online.service.OnlineFoldIn`
+    (duck-typed like the other adapters): the freshness plane's
+    operator view — event→serving lag, fold throughput counters, and
+    overlay occupancy (docs/freshness.md has the runbook keyed on
+    these families)."""
+
+    def collect() -> list[Metric]:
+        m = svc.metrics()
+        out = [
+            Metric(
+                name="pio_online_folded_events_total", kind="counter",
+                help="Events folded into the deployed model between "
+                     "retrains (online/service.py)",
+                samples=[({}, float(m["foldedEventsTotal"]))],
+            ),
+            Metric(
+                name="pio_online_fold_cycles_total", kind="counter",
+                help="Completed fold-in cycles (tail→solve→publish)",
+                samples=[({}, float(m["foldCycles"]))],
+            ),
+            Metric(
+                name="pio_online_fenced_total", kind="counter",
+                help="Deltas discarded by the model-generation fence "
+                     "(computed pre-/reload, never applied)",
+                samples=[({}, float(m["fenced"]))],
+            ),
+            Metric(
+                name="pio_online_overlay_evictions_total", kind="counter",
+                help="Overlay LRU evictions (user falls back to the "
+                     "base vector; grow PIO_ONLINE_OVERLAY_MAX if "
+                     "this churns)",
+                samples=[({}, float(m["evictions"]))],
+            ),
+            Metric(
+                name="pio_online_overlay_size", kind="gauge",
+                help="Live overlay entries (folded users + delta items)",
+                samples=[({}, float(m["overlaySize"]))],
+            ),
+            Metric(
+                name="pio_online_enabled", kind="gauge",
+                help="1 when the fold-in loop is running (0: --online "
+                     "requested but the deployment cannot fold in)",
+                samples=[({}, 1.0 if m["enabled"] else 0.0)],
+            ),
+        ]
+        if m["lagSeconds"] is not None:
+            # absent until the first fold: a gauge of "no data" must
+            # not masquerade as zero lag
+            out.append(Metric(
+                name="pio_online_freshness_lag_seconds", kind="gauge",
+                help="Event time → applied-to-serving time of the "
+                     "latest fold-in cycle (worst event in the batch)",
+                samples=[({}, float(m["lagSeconds"]))],
+            ))
+        return out
+
+    return collect
+
+
 #: breaker state encoding for the gauge (strings are not a sample value)
 _BREAKER_STATES = {"closed": 0.0, "half-open": 1.0, "half_open": 1.0,
                    "open": 2.0}

@@ -1,0 +1,43 @@
+"""The real-time freshness plane: online ALS fold-in between retrains.
+
+The reference is a Lambda architecture — model freshness is bounded by
+the ``pio train`` cadence, so a user's session-start events cannot
+influence recommendations until the next full retrain. This package is
+the speed layer that closes the loop WITHOUT a retrain
+(docs/freshness.md):
+
+- :mod:`~predictionio_tpu.online.follower` — tails the event store
+  through ``Events.find_columnar`` from a durable ``(eventTime, id)``
+  cursor, exactly-once across batch boundaries (the ordering the PR 4
+  conformance suite pins on every backend);
+- :mod:`~predictionio_tpu.online.foldin` — recomputes an affected ALS
+  user vector with the closed-form rank x rank normal-equation solve
+  over the user's FULL interaction set (idempotent by construction:
+  re-folding a user is a recomputation, not an accumulation), and gives
+  brand-new items a popularity/content prior vector;
+- :mod:`~predictionio_tpu.online.overlay` — the bounded LRU delta table
+  the serving path consults per query, generation-FENCED against the
+  deployed base model: a delta computed against model generation G is
+  discarded, never applied, once ``/reload`` lands G+1;
+- :mod:`~predictionio_tpu.online.service` — the per-server loop wiring
+  the three together (``pio deploy --online``), with worker-pool
+  propagation over the PR 10 spool plane and per-user result-cache
+  invalidation instead of pool-wide generation bumps.
+"""
+
+from predictionio_tpu.online.follower import (  # noqa: F401
+    CursorStore,
+    EventTailFollower,
+    TailCursor,
+    resume_columnar,
+)
+from predictionio_tpu.online.foldin import (  # noqa: F401
+    popularity_prior,
+    solve_item,
+    solve_user,
+)
+from predictionio_tpu.online.overlay import (  # noqa: F401
+    ItemDelta,
+    OnlineOverlay,
+    UserDelta,
+)

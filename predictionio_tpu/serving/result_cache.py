@@ -115,6 +115,30 @@ class ResultCache:
                 self._generation += 1
             self.stats.bump("cache_invalidations")
 
+    def invalidate_matching(self, fragment: str) -> int:
+        """Drop only the entries whose canonical key contains
+        ``fragment`` — the online freshness plane's TARGETED
+        invalidation (online/service.user_key_fragment): when one
+        user's vector is re-folded, that user's cached predictions die
+        and everyone else's stay warm (entries are NOT cleared
+        pool-wide the way a ``/reload`` clears them). The generation
+        still advances: a query for the SAME user already in flight
+        when the fold landed would otherwise ``put()`` its pre-fold
+        result right back (the stale-generation guard protects only
+        puts, so every OTHER user's existing entries keep serving —
+        the in-flight computations across the bump merely become
+        uncacheable, the small price of correctness)."""
+        with self._lock:
+            doomed = [k for k in self._entries if fragment in k]
+            for k in doomed:
+                del self._entries[k]
+            # unconditional: the racing in-flight query may not have an
+            # entry to doom YET — its put is the thing being fenced
+            self._generation += 1
+            if doomed:
+                self.stats.bump("cache_user_invalidations", len(doomed))
+        return len(doomed)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

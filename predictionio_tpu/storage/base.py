@@ -214,6 +214,16 @@ class Events(abc.ABC):
     #: small enough that a batch stays cache- and memory-friendly
     COLUMNAR_BATCH_SIZE = 4096
 
+    #: the granularity (in µs) of this backend's ``(eventTime, id)``
+    #: total order — the tail-cursor comparison key
+    #: (online/follower.resume_columnar) must mirror the backend's OWN
+    #: sort, not invent a finer one that would mis-split equal-time
+    #: ties. µs for stores that order on exact instants (memory, the
+    #: SQL text format); the binary event log overrides to 1000 (its
+    #: payload order is the ms-truncated wire spelling). Conformance:
+    #: tests/test_storage_conformance.py::TestColumnarCursorResume.
+    CURSOR_TIME_RESOLUTION_US = 1
+
     def find_columnar(
         self,
         app_id: int,

@@ -194,6 +194,13 @@ def _py_scan(path: str, flt: EventFilter) -> list[bytes]:
 # ---------------------------------------------------------------------------
 
 class BinEvents(base.Events):
+    #: ordering granularity for the tail-cursor contract
+    #: (base.Events.CURSOR_TIME_RESOLUTION_US): find()/find_columnar
+    #: order by the PAYLOAD's ms-truncated eventTime (+ id tiebreak),
+    #: so the cursor comparison must truncate to ms too — a µs-exact
+    #: key would mis-split sub-millisecond ties ordered by id here
+    CURSOR_TIME_RESOLUTION_US = 1000
+
     def __init__(self, path: str, use_native: bool = True):
         from predictionio_tpu import native
 

@@ -28,7 +28,13 @@ from predictionio_tpu.storage.base import (
 
 
 def _sort_and_limit(events: list[Event], filter: EventFilter) -> list[Event]:
-    events.sort(key=lambda e: e.event_time, reverse=filter.reversed)
+    # id tiebreak: equal-timestamp order must be a property of the DATA,
+    # not of dict insertion order — the (eventTime, id) total order every
+    # other backend pins (sqlite ORDER BY, binevents/fileevents sort
+    # keys) is what the online tail's cursor resume stands on
+    # (TestColumnarCursorResume)
+    events.sort(key=lambda e: (e.event_time, e.event_id or ""),
+                reverse=filter.reversed)
     if filter.limit is not None and filter.limit >= 0:
         events = events[: filter.limit]
     return events

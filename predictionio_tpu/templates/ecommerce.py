@@ -253,7 +253,8 @@ class ECommAlgorithm(ShardedAlgorithm):
         except Exception:
             return []
 
-    def _allow_vector(self, model: ECommModel, query: Query) -> np.ndarray:
+    def _allow_vector(self, model: ECommModel,
+                      query: Query) -> np.ndarray | None:
         item_ids = model.als.item_ids
         n = len(item_ids)
         allow = build_allow_vector(
@@ -263,9 +264,17 @@ class ECommAlgorithm(ShardedAlgorithm):
             white_list=query.white_list,
             black_list=query.black_list,
         )
-        if allow is None:  # no query rules; availability applies below
+        unavailable = self._unavailable_items()
+        if allow is None:
+            if not unavailable:
+                # genuinely unrestricted: None (not an all-ones array)
+                # keeps the fast default-allow path AND lets the online
+                # overlay's cold-start items merge — an allow vector is
+                # catalog-indexed and would force catalog-only serving
+                # (models/als._recommend_online; docs/freshness.md)
+                return None
             allow = np.ones(n, dtype=np.float32)
-        for item_id in self._unavailable_items():
+        for item_id in unavailable:
             ix = item_ids.get(item_id)
             if ix is not None:
                 allow[ix] = 0.0
@@ -280,7 +289,10 @@ class ECommAlgorithm(ShardedAlgorithm):
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         allow = self._allow_vector(model, query)
-        if query.user in model.als.user_ids:
+        # an online-folded user has a served vector even when absent
+        # from training (cold-start-to-served; docs/freshness.md)
+        if (query.user in model.als.user_ids
+                or model.als.online_delta(query.user) is not None):
             recs = model.als.recommend(
                 query.user, query.num, allow=allow,
                 exclude_seen=self.params.unseen_only,

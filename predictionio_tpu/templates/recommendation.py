@@ -336,10 +336,17 @@ class ALSAlgorithm(ShardedAlgorithm):
         they take the single-query path; the unfiltered rest batch."""
         if not queries:
             return []
+
+        def single_path(q: Query) -> bool:
+            # per-query eligibility vectors AND online-overlay users
+            # (folded vector / cold-start items — the batched kernel
+            # scores only the base tables; models/als.needs_online_path)
+            return (q.white_list is not None or bool(q.black_list)
+                    or model.needs_online_path(q.user))
+
         out = [(qi, self.predict(model, q)) for qi, q in queries
-               if q.white_list is not None or q.black_list]
-        queries = [(qi, q) for qi, q in queries
-                   if not (q.white_list is not None or q.black_list)]
+               if single_path(q)]
+        queries = [(qi, q) for qi, q in queries if not single_path(q)]
         known = [
             (qi, model.user_ids[q.user], q.num)
             for qi, q in queries

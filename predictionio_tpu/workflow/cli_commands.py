@@ -298,6 +298,29 @@ def _configure_deploy(sub) -> None:
                    dest="ann_rescore",
                    help="cap on shortlist candidates exact-rescored per "
                         "query (0 = all probed candidates)")
+    # real-time freshness plane (online/; docs/freshness.md): None
+    # defers to the PIO_ONLINE_* env-aware ServerConfig defaults
+    p.add_argument("--online", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="fold new events into the deployed ALS model "
+                        "between retrains: tail the event store and "
+                        "recompute touched users' vectors closed-form "
+                        "— event→recommendation freshness in seconds, "
+                        "no retrain, no restart")
+    p.add_argument("--online-interval-s", type=float, default=None,
+                   dest="online_interval_s",
+                   help="tail polling interval (the freshness lag "
+                        "floor; default 1.0)")
+    p.add_argument("--online-overlay-max", type=int, default=None,
+                   dest="online_overlay_max",
+                   help="max folded users held in the serving overlay "
+                        "(LRU; evicted users fall back to their base "
+                        "vector until the next retrain)")
+    p.add_argument("--online-state-dir", default=None,
+                   dest="online_state_dir",
+                   help="directory for the durable tail cursor "
+                        "(restart resumes exactly-once; default: "
+                        "in-memory, re-tails from deploy time)")
     # observability (docs/observability.md): None defers to the
     # PIO_TRACE / PIO_ACCESS_LOG env vars; the boolean pairs let the
     # CLI force either state over a fleet-wide env setting
@@ -369,6 +392,10 @@ def _cmd_deploy(args, storage) -> int:
             "tracing": args.tracing,
             "access_log": args.access_log,
             "workers": args.workers,
+            "online": args.online,
+            "online_interval_s": args.online_interval_s,
+            "online_overlay_max": args.online_overlay_max,
+            "online_state_dir": args.online_state_dir,
         }.items() if v is not None},
     )
     workers = max(1, config.workers)

@@ -55,6 +55,15 @@ def _cast_bool(raw: str) -> bool:
     return raw.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _online_field(key: str, default: Any, cast: Callable[[str], Any]):
+    """``PIO_ONLINE_<KEY>``-overridable defaults for the freshness
+    plane's knobs (docs/freshness.md), same degrade-don't-die contract
+    as the serving fields."""
+    from predictionio_tpu.utils.envcfg import env_field
+
+    return env_field("PIO_ONLINE_", key, default, cast)
+
+
 def _cast_policy(raw: str) -> str:
     # validated HERE so a typo'd env value degrades to the default with
     # a warning (the _env_field contract) instead of killing the server
@@ -182,6 +191,25 @@ class ServerConfig:
     #: sibling within about this many seconds
     admin_sync_interval_s: float = _env_field("ADMIN_SYNC_INTERVAL_S",
                                               0.5, float)
+    #: real-time freshness plane (`pio deploy --online`; online/,
+    #: docs/freshness.md): tail the event store between retrains and
+    #: fold touched users' ALS vectors into the deployed model with the
+    #: closed-form rank x rank solve — event→recommendation freshness
+    #: in seconds instead of a retrain cadence. ALS-family engines
+    #: only; others log a warning and serve batch-only.
+    online: bool = _online_field("ENABLED", False, _cast_bool)
+    #: tail polling interval: the upper bound the speed layer adds on
+    #: top of ingest latency (freshness lag ≈ interval + solve time)
+    online_interval_s: float = _online_field("INTERVAL_S", 1.0, float)
+    #: bounded overlay: at most this many folded USERS held between
+    #: retrains (items cap at a quarter of it); LRU-evicted users fall
+    #: back to their base vector — the pre-online behavior
+    online_overlay_max: int = _online_field("OVERLAY_MAX", 4096, int)
+    #: directory for the durable tail cursor (exactly-once resume
+    #: across restarts); empty = in-memory cursor, re-tailed from
+    #: deploy time after a restart (correct — fold-in is idempotent —
+    #: just fresh-start)
+    online_state_dir: str = _online_field("STATE_DIR", "", str)
 
 
 class DeployedEngine:

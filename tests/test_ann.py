@@ -214,6 +214,114 @@ class TestQualityParity:
 
 
 # ---------------------------------------------------------------------------
+# Online delta overlay x ANN (PR 14 satellite): delta/cold-start items
+# are brute-scored on the host and merged with the IVF shortlist — the
+# index is never rebuilt online, so retrieval for unchanged items must
+# stay bit-identical (docs/serving-performance.md has the
+# overlay-size-vs-latency tradeoff)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.online
+class TestOnlineOverlayNeutrality:
+    def _overlay(self, model, items=None, users=None):
+        from predictionio_tpu.online.overlay import (
+            ItemDelta,
+            OnlineOverlay,
+            UserDelta,
+        )
+
+        overlay = OnlineOverlay(generation=0)
+        for iid, vec in (items or {}).items():
+            assert overlay.put_item(iid, ItemDelta(vector=vec),
+                                    generation=0)
+        for uid, delta in (users or {}).items():
+            assert overlay.put_user(uid, delta, generation=0)
+        model.set_online_overlay(overlay)
+        return overlay
+
+    def test_unchanged_items_rank_identically_under_overlay(self):
+        """Recall-neutrality: with overlay ITEMS present, the base-
+        catalog portion of an ANN answer is exactly the no-overlay ANN
+        answer — the overlay merge may only INSERT delta items, never
+        reorder or drop catalog items."""
+        m = _als_model(seed=31)
+        m.configure_retrieval("ann")
+        baseline = m.recommend("u1", 10)
+        # a delta item with a tiny vector: scores ~0, never competitive
+        cold = np.full((K,), 1e-6, dtype=np.float32)
+        self._overlay(m, items={"fresh1": cold})
+        with_overlay = m.recommend("u1", 10)
+        catalog_part = [r for r in with_overlay if r[0] != "fresh1"]
+        assert [r[0] for r in catalog_part[:len(baseline) - 1]] == \
+            [r[0] for r in baseline[:len(baseline) - 1]]
+        for (got_id, got_s), (want_id, want_s) in zip(catalog_part,
+                                                      baseline):
+            assert got_id == want_id
+            assert got_s == pytest.approx(want_s, rel=1e-5)
+
+    def test_competitive_delta_item_merges_into_topk(self):
+        m = _als_model(seed=32)
+        m.configure_retrieval("ann")
+        uix = m.user_ids.get("u2")
+        uv = np.asarray(m.user_factors[uix])
+        # a delta item aligned with the user's taste: must win rank 1
+        self._overlay(m, items={"hot": (uv * 10.0).astype(np.float32)})
+        recs = m.recommend("u2", 10)
+        assert recs[0][0] == "hot"
+        # and the catalog items that follow are the baseline ones
+        m.set_online_overlay(None)
+        baseline = m.recommend("u2", 10)
+        assert [r[0] for r in recs[1:]] == \
+            [r[0] for r in baseline[:len(recs) - 1]]
+
+    def test_filtered_queries_serve_catalog_only(self):
+        """Business-rule-filtered queries (allow vector present) skip
+        the overlay merge — the allow vector is indexed by catalog
+        position and cannot vouch for overlay items (documented
+        caveat, docs/freshness.md)."""
+        m = _als_model(seed=33)
+        m.configure_retrieval("ann")
+        uix = m.user_ids.get("u3")
+        uv = np.asarray(m.user_factors[uix])
+        self._overlay(m, items={"hot": (uv * 10.0).astype(np.float32)})
+        allow = np.ones((m.item_factors.shape[0],), dtype=np.float32)
+        recs = m.recommend("u3", 10, allow=allow)
+        assert all(r[0] != "hot" for r in recs)
+
+    def test_folded_user_vector_drives_ann_ranking(self):
+        """A folded user's ANN answer equals the answer the BASE path
+        would give for that exact vector — the overlay changes the
+        query vector, never the retrieval behavior."""
+        from predictionio_tpu.online.overlay import UserDelta
+
+        m = _als_model(seed=34)
+        m.configure_retrieval("ann")
+        donor = m.recommend("u4", 10)
+        vec = np.asarray(m.user_factors[m.user_ids.get("u4")])
+        self._overlay(m, users={
+            "brand-new": UserDelta(vector=vec.astype(np.float32))})
+        folded = m.recommend("brand-new", 10)
+        assert [r[0] for r in folded] == [r[0] for r in donor]
+
+    def test_delta_seen_items_are_excluded_for_their_user(self):
+        from predictionio_tpu.online.overlay import UserDelta
+
+        m = _als_model(seed=35)
+        uix = m.user_ids.get("u5")
+        uv = np.asarray(m.user_factors[uix]).astype(np.float32)
+        hot = (uv * 10.0).astype(np.float32)
+        self._overlay(
+            m, items={"hot": hot},
+            users={"u5": UserDelta(vector=uv, delta_seen=("hot",))})
+        # u5 already interacted with "hot": excluded for them...
+        assert all(r[0] != "hot" for r in m.recommend("u5", 10))
+        # ...but still recommendable to a taste-adjacent other user
+        m6 = m.recommend("u5", 10, exclude_seen=False)
+        assert m6[0][0] == "hot"
+
+
+# ---------------------------------------------------------------------------
 # ALSModel integration + persistence
 # ---------------------------------------------------------------------------
 

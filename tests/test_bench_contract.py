@@ -66,6 +66,13 @@ def tiny_bench(monkeypatch):
                               "workers_qps_2w": 160.0,
                               "workers_host_cores": 2,
                               "workers_reported_in_merged_metrics": 2.0})
+    # freshness trains + deploys a live server fleet (bench_freshness.py)
+    # — stubbed here; the real tiny harness is the perf test below
+    monkeypatch.setattr(
+        bench, "bench_freshness_section",
+        lambda shrunk=False: {"freshness_lag_p50_ms": 300.0,
+                              "freshness_foldin_events_per_sec": 100.0,
+                              "freshness_http_5xx": 0})
     # keep calibration real but tiny (2048^3 bf16 chains are for the chip)
     real_calib = bench.bench_calibration
     monkeypatch.setattr(bench, "bench_calibration",
@@ -91,6 +98,8 @@ def test_single_json_line_with_primary_contract(tiny_bench, capsys, monkeypatch)
                 "calibration_matmul_ms", "scan_speedup_x_sqlite",
                 "ingest_tx_speedup_x", "ann_speedup_100k_x",
                 "workers_scaling_2w_vs_1w_x", "workers_host_cores",
+                "freshness_lag_p50_ms",
+                "freshness_foldin_events_per_sec",
                 # train_profile runs REAL (tiny train, seconds): the
                 # device/compiler observability trajectory keys
                 "train_profile_mfu", "train_profile_compile_seconds",
@@ -136,6 +145,8 @@ def test_skip_heavy_lists_skipped_sections(tiny_bench, capsys, monkeypatch):
     assert "ann_speedup_16k_x" in line       # ann_retrieval runs SHRUNK
     # workers_scaling runs SHRUNK under --skip-heavy too
     assert "workers_scaling_2w_vs_1w_x" in line
+    # freshness runs SHRUNK under --skip-heavy too
+    assert "freshness_lag_p50_ms" in line
 
 
 @pytest.mark.perf
@@ -162,6 +173,28 @@ def test_data_plane_harness_contract_tiny():
         assert wal[f"wal_append_{policy}_events_per_sec"] > 0
         assert wal[f"wal_{policy}_vs_direct_x"] > 0
     assert wal["wal_direct_batch_events_per_sec"] > 0
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+@pytest.mark.online
+def test_freshness_harness_contract_tiny():
+    """bench_freshness.py's real harness at tiny scale: trains, deploys
+    --online single + 2-worker-spool fleets in process, probes the
+    event→serve lag, and must report the lag distribution, fold-in
+    throughput, the workers-variant lag, and ZERO 5xx (the keys
+    BENCH_freshness_rNN.json records). Slow-marked: one tiny train +
+    three live servers."""
+    import bench_freshness
+
+    r = bench_freshness.bench_freshness(
+        n_users=12, n_items=10, probe_rounds=2, foldin_events=60,
+        workers_rounds=1)
+    assert r["freshness_lag_p50_ms"] > 0
+    assert r["freshness_foldin_events_per_sec"] > 0
+    assert r["freshness_workers_lag_p50_ms"] > 0
+    assert r["freshness_http_5xx"] == 0
+    assert r["freshness_http_requests"] > 0
 
 
 @pytest.mark.perf

@@ -1,0 +1,557 @@
+"""Real-time freshness plane (predictionio_tpu/online/): closed-form
+fold-in units, overlay generation fencing, and the e2e pin — a rating
+POSTed to the event server changes that user's /queries.json
+recommendations within the tail interval, no retrain, zero 5xx
+(ISSUE 14 acceptance)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.online.foldin import (
+    item_gramian,
+    popularity_prior,
+    solve_user,
+)
+from predictionio_tpu.online.follower import CursorStore, TailCursor
+from predictionio_tpu.online.overlay import ItemDelta, OnlineOverlay, UserDelta
+from predictionio_tpu.online.service import user_key_fragment
+from predictionio_tpu.storage.base import AccessKey, App
+from predictionio_tpu.workflow.train import run_train
+
+pytestmark = pytest.mark.online
+
+RANK = 8
+LAM = 0.05
+
+REC_VARIANT = {
+    "id": "rec",
+    "engineFactory":
+        "predictionio_tpu.templates.recommendation.engine_factory",
+    "datasource": {"params": {"app_name": "RecApp"}},
+    "algorithms": [
+        {"name": "als",
+         "params": {"rank": RANK, "num_iterations": 8, "lambda_": LAM,
+                    "seed": 1}}
+    ],
+}
+
+
+def _event(event, user, item, props=None, **kw):
+    return Event(event=event, entity_type="user", entity_id=user,
+                 target_entity_type="item", target_entity_id=item,
+                 properties=DataMap(props or {}), **kw)
+
+
+def _seed_and_train(storage, monkeypatch, tmp_path):
+    app_id = storage.get_meta_data_apps().insert(App(0, "RecApp"))
+    storage.get_meta_data_access_keys().insert(
+        AccessKey("fresh-key", app_id, []))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(0)
+    for u in range(16):
+        for i in range(12):
+            if i % 2 == u % 2 and rng.random() < 0.8:
+                events.insert(
+                    _event("rate", f"u{u}", f"i{i}", {"rating": 5.0}),
+                    app_id)
+            elif rng.random() < 0.1:
+                events.insert(
+                    _event("rate", f"u{u}", f"i{i}", {"rating": 1.0}),
+                    app_id)
+    monkeypatch.setenv("PIO_MODEL_DIR", str(tmp_path))
+    outcome = run_train(variant=REC_VARIANT, storage=storage)
+    assert outcome.status == "COMPLETED"
+    return app_id
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _query(port, user, num=5):
+    status, body = _post(f"http://127.0.0.1:{port}/queries.json",
+                         {"user": user, "num": num})
+    assert status == 200
+    return [s["item"] for s in body["itemScores"]], body
+
+
+# ---------------------------------------------------------------------------
+# units: closed-form solves
+# ---------------------------------------------------------------------------
+
+class TestFoldInMath:
+    def test_explicit_matches_normal_equations(self):
+        rng = np.random.default_rng(3)
+        Y = rng.normal(size=(7, RANK)).astype(np.float32)
+        r = rng.uniform(1, 5, size=7).astype(np.float32)
+        u = solve_user(Y, r, lam=LAM)
+        # independent reference: ALS-WR normal equations
+        A = Y.T @ Y + LAM * 7 * np.eye(RANK, dtype=np.float32)
+        np.testing.assert_allclose(A @ u, r @ Y, rtol=1e-4, atol=1e-4)
+
+    def test_implicit_matches_hu_koren(self):
+        rng = np.random.default_rng(4)
+        Y = rng.normal(size=(64, RANK)).astype(np.float32)
+        obs = Y[:5]
+        r = np.asarray([1, 1, 2, -1, 0], dtype=np.float32)
+        gram = item_gramian(Y)
+        u = solve_user(obs, r, lam=LAM, implicit=True, alpha=2.0,
+                       gram=gram)
+        w = 2.0 * np.abs(r)
+        A = gram + (obs * w[:, None]).T @ obs + LAM * np.eye(RANK)
+        b = np.where(r > 0, 1.0 + 2.0 * r, 0.0) @ obs
+        np.testing.assert_allclose(A @ u, b, rtol=1e-4, atol=1e-4)
+
+    def test_implicit_requires_gramian(self):
+        with pytest.raises(ValueError):
+            solve_user(np.ones((2, RANK), np.float32),
+                       np.ones(2, np.float32), lam=LAM, implicit=True)
+
+    def test_empty_interactions_solve_to_none(self):
+        assert solve_user(np.zeros((0, RANK), np.float32),
+                          np.zeros(0, np.float32), lam=LAM) is None
+
+    def test_popularity_prior_is_weighted_centroid(self):
+        table = np.asarray([[1.0, 0.0], [0.0, 1.0]], dtype=np.float32)
+        np.testing.assert_allclose(popularity_prior(table), [0.5, 0.5])
+        np.testing.assert_allclose(
+            popularity_prior(table, weights=np.asarray([3.0, 1.0])),
+            [0.75, 0.25])
+
+
+# ---------------------------------------------------------------------------
+# units: overlay fencing + bounds, cursor store
+# ---------------------------------------------------------------------------
+
+class TestOverlay:
+    def _delta(self, seed=0):
+        return UserDelta(vector=np.full((RANK,), float(seed),
+                                        dtype=np.float32))
+
+    def test_generation_fencing_discards_stale_puts(self):
+        ov = OnlineOverlay(generation=5)
+        assert ov.put_user("u1", self._delta(), generation=5)
+        ov.advance_generation(6)
+        assert ov.user("u1") is None            # cleared with the swap
+        assert not ov.put_user("u2", self._delta(), generation=5)
+        assert ov.user("u2") is None
+        assert ov.counters()["fenced"] == 1
+        assert ov.put_user("u2", self._delta(), generation=6)
+
+    def test_generation_only_moves_forward(self):
+        ov = OnlineOverlay(generation=9)
+        ov.advance_generation(3)                # lagging doc can't rewind
+        assert ov.generation == 10
+
+    def test_lru_bound_and_eviction_count(self):
+        ov = OnlineOverlay(max_users=2)
+        for i in range(4):
+            assert ov.put_user(f"u{i}", self._delta(i), generation=0)
+        assert ov.counters() == {
+            "users": 2, "items": 0, "evictions": 2, "fenced": 0,
+            "generation": 0}
+        assert ov.user("u0") is None and ov.user("u3") is not None
+
+    def test_delta_matrix_caches_and_rebuilds(self):
+        ov = OnlineOverlay()
+        assert ov.delta_matrix() is None
+        ov.put_item("a", ItemDelta(np.ones(RANK, np.float32)),
+                    generation=0)
+        ids, m1 = ov.delta_matrix()
+        assert ids == ("a",) and m1.shape == (1, RANK)
+        assert ov.delta_matrix()[1] is m1       # cached
+        ov.put_item("b", ItemDelta(np.zeros(RANK, np.float32)),
+                    generation=0)
+        ids2, m2 = ov.delta_matrix()
+        assert ids2 == ("a", "b") and m2.shape == (2, RANK)
+
+    def test_follower_backlog_is_paged_not_materialized(self):
+        """A poll against a deep backlog stops at max_rows with the
+        cursor on the last row CONSUMED — the next poll continues
+        exactly there (paged, still exactly-once; the post-outage
+        resume must not materialize a whole weekend in one pass)."""
+        from predictionio_tpu.online.follower import EventTailFollower
+        from predictionio_tpu.storage.memory import MemoryStorageClient
+
+        events = MemoryStorageClient().events()
+        events.init(1)
+        events.insert_batch(
+            [_event("rate", f"u{i % 5}", f"i{i % 7}", {"rating": 1.0})
+             for i in range(25)], 1)
+        follower = EventTailFollower(events, 1, batch_size=4, max_rows=10)
+        seen = []
+        for _ in range(5):
+            rows, cursor = follower.poll_once()
+            assert len(rows) <= 10
+            seen.extend(r.event_id for r in rows)
+            follower.commit(cursor)
+            if not rows:
+                break
+        full = [e.event_id for e in events.find(1)]
+        assert seen == full            # no skip, no duplicate, all pages
+
+    def test_cursor_store_round_trip_and_junk(self, tmp_path):
+        path = str(tmp_path / "cursor.json")
+        store = CursorStore(path)
+        assert store.load() is None
+        store.save(TailCursor(12345, "abc"))
+        assert CursorStore(path).load() == TailCursor(12345, "abc")
+        with open(path, "w") as f:
+            f.write("{not json")
+        assert CursorStore(path).load() is None
+
+    def test_user_key_fragment_matches_cache_keys(self):
+        from predictionio_tpu.core.json_codec import canonical_json
+
+        key = canonical_json({"num": 5, "user": "u1"})
+        assert user_key_fragment("u1") in key
+        assert user_key_fragment("u11") not in key
+
+    def test_result_cache_invalidate_matching_is_targeted(self):
+        from predictionio_tpu.serving.result_cache import ResultCache
+
+        cache = ResultCache()
+        cache.put('{"num":5,"user":"u1"}', 1)
+        cache.put('{"num":9,"user":"u1"}', 2)
+        cache.put('{"num":5,"user":"u2"}', 3)
+        gen = cache.generation
+        assert cache.invalidate_matching(user_key_fragment("u1")) == 2
+        assert len(cache) == 1
+        # other users' ENTRIES survive (nothing cleared pool-wide)...
+        assert cache.lookup('{"num":5,"user":"u2"}')[0]
+        # ...but the generation advances so a pre-fold in-flight
+        # computation (even for a user with no entry yet) cannot put()
+        # its stale result back
+        assert cache.generation > gen
+        assert not cache.put('{"num":5,"user":"u1"}', "stale",
+                             generation=gen)
+        assert cache.stats.count("cache_user_invalidations") == 2
+
+
+# ---------------------------------------------------------------------------
+# e2e: event server POST -> fold -> /queries.json freshness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def deployed(storage, monkeypatch, tmp_path):
+    from predictionio_tpu.api.engine_server import create_engine_server
+    from predictionio_tpu.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.workflow.deploy import ServerConfig
+
+    _seed_and_train(storage, monkeypatch, tmp_path)
+    engine = create_engine_server(storage=storage, config=ServerConfig(
+        ip="127.0.0.1", port=0, online=True, online_interval_s=0.05,
+        cache_enabled=True, tracing=True))
+    engine.start()
+    eventsrv = EventServer(
+        storage, EventServerConfig(ip="127.0.0.1", port=0))
+    eventsrv.start()
+    yield engine, eventsrv, storage
+    eventsrv.stop()
+    engine.stop()
+
+
+class TestFreshnessE2E:
+    def test_rating_posted_changes_recommendations_no_retrain(
+            self, deployed):
+        engine, eventsrv, storage = deployed
+        svc = engine.service
+        assert svc.online is not None and svc.online.enabled
+        before, _ = _query(engine.port, "u0", 6)
+        assert before, "trained user must be served"
+        target = before[0]                      # the current favorite
+        # POST the rating through the event server front door
+        status, body = _post(
+            f"http://127.0.0.1:{eventsrv.port}/events.json"
+            "?accessKey=fresh-key",
+            {"event": "rate", "entityType": "user", "entityId": "u0",
+             "targetEntityType": "item", "targetEntityId": target,
+             "properties": {"rating": 5.0}})
+        assert status == 201
+        # deadline-poll (never assert the first read): the fold lands
+        # within a few tail intervals; every poll must be a 200
+        deadline = time.time() + 15
+        after = before
+        while time.time() < deadline:
+            after, _ = _query(engine.port, "u0", 6)
+            if after != before:
+                break
+            time.sleep(0.05)
+        assert after != before, "fold-in never reached serving"
+        # the just-rated item is now SEEN: excluded from the answer
+        assert target not in after
+        # no retrain happened: same engine instance is serving
+        assert svc.deployed.instance.id
+        metrics = svc.online.metrics()
+        assert metrics["foldedEventsTotal"] >= 1
+        assert metrics["usersFoldedTotal"] >= 1
+        assert metrics["lagSeconds"] is not None
+
+    def test_folded_vector_matches_reference_solve(self, deployed):
+        engine, eventsrv, storage = deployed
+        svc = engine.service
+        app = storage.get_meta_data_apps().get_by_name("RecApp")
+        storage.get_events().insert(
+            _event("rate", "u1", "i0", {"rating": 4.0}), app.id)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if svc.online.overlay.user("u1") is not None:
+                break
+            time.sleep(0.05)
+        delta = svc.online.overlay.user("u1")
+        assert delta is not None
+        # from-scratch reference: the user's FULL history against the
+        # deployed item table, solved with plain numpy ALS-WR normal
+        # equations (independent of the service's code path)
+        model = svc.online._binding.model
+        Y = np.asarray(model.item_factors)
+        ixs, ratings = [], []
+        for e in storage.get_events().find(app.id):
+            if e.entity_id != "u1" or e.target_entity_id is None:
+                continue
+            if e.event == "rate":
+                ratings.append(float(e.properties.fields["rating"]))
+            else:
+                ratings.append(4.0)
+            ixs.append(model.item_ids.get(e.target_entity_id))
+        obs = Y[np.asarray(ixs)]
+        n = len(ixs)
+        A = obs.T @ obs + LAM * n * np.eye(RANK, dtype=np.float32)
+        ref = np.linalg.solve(A, np.asarray(ratings, np.float32) @ obs)
+        np.testing.assert_allclose(delta.vector, ref, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_cold_start_user_and_item_are_served(self, deployed):
+        engine, eventsrv, storage = deployed
+        # unknown user before: empty answer (reference behavior)
+        empty, _ = _query(engine.port, "brand-new-user", 5)
+        assert empty == []
+        for iid in ("i0", "i2", "i4"):
+            status, _ = _post(
+                f"http://127.0.0.1:{eventsrv.port}/events.json"
+                "?accessKey=fresh-key",
+                {"event": "rate", "entityType": "user",
+                 "entityId": "brand-new-user", "targetEntityType": "item",
+                 "targetEntityId": iid, "properties": {"rating": 5.0}})
+            assert status == 201
+        # ...and a brand-new ITEM rated by a known even-taste user
+        status, _ = _post(
+            f"http://127.0.0.1:{eventsrv.port}/events.json"
+            "?accessKey=fresh-key",
+            {"event": "rate", "entityType": "user", "entityId": "u2",
+             "targetEntityType": "item", "targetEntityId": "fresh-item",
+             "properties": {"rating": 5.0}})
+        assert status == 201
+        deadline = time.time() + 15
+        served: list = []
+        while time.time() < deadline:
+            served, _ = _query(engine.port, "brand-new-user", 5)
+            if served:
+                break
+            time.sleep(0.05)
+        assert served, "cold-start user never served"
+        # the new user liked EVEN items; the folded vector must rank
+        # unseen even items above odd ones
+        evens = [i for i in served if i.startswith("i")
+                 and int(i[1:]) % 2 == 0]
+        assert len(evens) >= len(served) // 2
+        # the overlay item is servable to OTHER users (merged into
+        # the top-k without an index rebuild)
+        deadline = time.time() + 15
+        got_fresh = False
+        while time.time() < deadline:
+            recs, _ = _query(engine.port, "u0", 12)
+            if "fresh-item" in recs:
+                got_fresh = True
+                break
+            time.sleep(0.05)
+        assert got_fresh, "overlay item never merged into serving"
+
+    def test_observability_stats_metrics_and_spans(self, deployed):
+        engine, eventsrv, storage = deployed
+        app = storage.get_meta_data_apps().get_by_name("RecApp")
+        storage.get_events().insert(
+            _event("rate", "u3", "i1", {"rating": 5.0}), app.id)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if engine.service.online.metrics()["foldedEventsTotal"] >= 1:
+                break
+            time.sleep(0.05)
+        doc = json.loads(_get(
+            f"http://127.0.0.1:{engine.port}/stats.json"))
+        online = doc["online"]
+        assert online["enabled"] is True
+        assert online["foldedEventsTotal"] >= 1
+        assert online["overlayUsers"] >= 1
+        assert online["lagSeconds"] > 0
+        assert online["cursor"] is not None
+        text = _get(
+            f"http://127.0.0.1:{engine.port}/metrics").decode()
+        for family in ("pio_online_folded_events_total",
+                       "pio_online_fold_cycles_total",
+                       "pio_online_overlay_size",
+                       "pio_online_freshness_lag_seconds",
+                       "pio_online_enabled"):
+            assert family in text, f"{family} missing from /metrics"
+        traces = json.loads(_get(
+            f"http://127.0.0.1:{engine.port}/traces.json"))["traces"]
+        folds = [t for t in traces if t["name"] == "online.foldin"]
+        assert folds, "fold cycle left no trace in the ring"
+        span_names = {s["name"] for s in folds[0]["spans"]}
+        assert {"tail", "solve", "publish"} <= span_names
+
+    def test_generation_fencing_on_reload(self, deployed):
+        """An overlay computed against model generation G is discarded,
+        never applied, after /reload lands G+1 (ISSUE 14 acceptance)."""
+        engine, eventsrv, storage = deployed
+        svc = engine.service
+        app = storage.get_meta_data_apps().get_by_name("RecApp")
+        storage.get_events().insert(
+            _event("rate", "u4", "i2", {"rating": 5.0}), app.id)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if svc.online.overlay.user("u4") is not None:
+                break
+            time.sleep(0.05)
+        assert svc.online.overlay.user("u4") is not None
+        stale_gen = svc.model_generation
+        stale = UserDelta(vector=np.ones((RANK,), dtype=np.float32))
+        # /reload: the generation fence advances and clears the overlay
+        status, _ = _post(
+            f"http://127.0.0.1:{engine.port}/reload", {})
+        assert status == 200
+        assert svc.model_generation == stale_gen + 1
+        assert svc.online.overlay.user("u4") is None
+        # the pre-reload fold can never land on the new model
+        assert not svc.online.overlay.put_user(
+            "u4", stale, generation=stale_gen)
+        assert svc.online.metrics()["fenced"] >= 1
+        # ...but the refold queue re-solves u4 against the NEW model
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if svc.online.overlay.user("u4") is not None:
+                break
+            time.sleep(0.05)
+        refolded = svc.online.overlay.user("u4")
+        assert refolded is not None
+        assert not np.allclose(refolded.vector, stale.vector)
+
+    def test_per_user_cache_invalidation_not_pool_wide(self, deployed):
+        engine, eventsrv, storage = deployed
+        svc = engine.service
+        # warm two users' cache entries
+        _query(engine.port, "u5", 5)
+        _query(engine.port, "u6", 5)
+        app = storage.get_meta_data_apps().get_by_name("RecApp")
+        storage.get_events().insert(
+            _event("rate", "u5", "i3", {"rating": 5.0}), app.id)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if svc.online.overlay.user("u5") is not None:
+                break
+            time.sleep(0.05)
+        assert svc.online.overlay.user("u5") is not None
+        # u5's entry died, u6's survived the fold (entries are never
+        # cleared pool-wide by the targeted path)
+        assert svc.serving_stats.count("cache_user_invalidations") >= 1
+        keys = list(svc.cache._entries)
+        assert any(user_key_fragment("u6") in k for k in keys)
+        assert not any(user_key_fragment("u5") in k for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# e2e: --workers 2 propagation over the spool plane
+# ---------------------------------------------------------------------------
+
+class TestWorkersPropagation:
+    def test_fold_reaches_every_sibling(self, storage, monkeypatch,
+                                        tmp_path):
+        from predictionio_tpu.api.engine_server import (
+            create_engine_server,
+        )
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        _seed_and_train(storage, monkeypatch, tmp_path)
+        spool = str(tmp_path / "spool")
+        servers = []
+        try:
+            for _ in range(2):
+                s = create_engine_server(
+                    storage=storage,
+                    config=ServerConfig(
+                        ip="127.0.0.1", port=0, online=True,
+                        online_interval_s=0.05, worker_spool_dir=spool,
+                        admin_sync_interval_s=0.05))
+                s.start()
+                servers.append(s)
+            # exactly one lease-holding leader folds; the sibling syncs
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                leaders = [s.service.online.metrics()["leader"]
+                           for s in servers]
+                if sum(leaders) == 1:
+                    break
+                time.sleep(0.05)
+            assert sum(s.service.online.metrics()["leader"]
+                       for s in servers) == 1
+            app = storage.get_meta_data_apps().get_by_name("RecApp")
+            storage.get_events().insert(
+                _event("rate", "u0", "i1", {"rating": 5.0}), app.id)
+            # the fold must reach BOTH workers' overlays (leader folds,
+            # sibling adopts the published snapshot)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if all(s.service.online.overlay.user("u0") is not None
+                       for s in servers):
+                    break
+                time.sleep(0.05)
+            vectors = []
+            for s in servers:
+                delta = s.service.online.overlay.user("u0")
+                assert delta is not None, "sibling never adopted the fold"
+                vectors.append(delta.vector)
+            np.testing.assert_allclose(vectors[0], vectors[1])
+            # and BOTH workers' query paths serve the folded state:
+            # i1 is now seen for u0 on either port
+            for s in servers:
+                recs, _ = _query(s.port, "u0", 6)
+                assert "i1" not in recs
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_dead_leader_lease_is_reclaimed(self, tmp_path):
+        from predictionio_tpu.online.service import TailLease
+
+        spool = str(tmp_path)
+        a = TailLease(spool, "worker-a")
+        assert a.try_hold() and a.try_hold()     # idempotent
+        b = TailLease(spool, "worker-b")
+        assert not b.try_hold()                  # live holder elsewhere
+        # fake the holder's death: rewrite the lease with a dead pid
+        with open(a.path, "w") as f:
+            json.dump({"worker": "worker-a", "pid": 2 ** 22 + 12345}, f)
+        assert b.try_hold()                      # reaped + claimed
+        assert not a.try_hold()

@@ -385,6 +385,128 @@ class TestColumnarRowEquivalence:
 
 
 # ---------------------------------------------------------------------------
+# Cursor-resume conformance (PR 14: the online tail follower's contract)
+# ---------------------------------------------------------------------------
+
+def _cursor_of(e, events_dao):
+    """The (eventTime, id) cursor a consumer saves after row ``e``."""
+    from predictionio_tpu.core.columns import datetime_to_us
+    from predictionio_tpu.online.follower import TailCursor
+
+    return TailCursor(datetime_to_us(e.event_time), e.event_id or "")
+
+
+def _assert_exactly_once_resume(events_dao, flt=EventFilter(), app_id=1,
+                                batch_size=2):
+    """Cut the full find() sequence at EVERY position (so every batch
+    boundary and every equal-timestamp tie is a cut point at
+    batch_size=2) and pin that the resumed read yields exactly the
+    remaining suffix — no skipped event, no duplicate."""
+    from predictionio_tpu.online.follower import resume_columnar
+
+    full = list(events_dao.find(app_id, None, flt))
+    assert len(full) >= 6, "seed must exercise batch boundaries"
+    for cut, row in enumerate(full):
+        cursor = _cursor_of(row, events_dao)
+        got = []
+        for cols, idx in resume_columnar(events_dao, app_id, None, flt,
+                                         cursor=cursor,
+                                         batch_size=batch_size):
+            sub = cols.to_events()
+            got.extend(sub[int(i)] for i in idx)
+        assert got == full[cut + 1:], (
+            f"resume after row {cut} ({row.event_id}) diverged: "
+            f"got {[e.event_id for e in got]}, want "
+            f"{[e.event_id for e in full[cut + 1:]]}")
+
+
+@pytest.mark.online
+class TestColumnarCursorResume:
+    """``find_columnar`` reads resumed from a saved ``(eventTime, id)``
+    cursor must be exactly-once across batch boundaries on every
+    backend — the online follower's correctness contract: a skipped
+    event is a rating that never reaches the model, a duplicate breaks
+    the exactly-once ordering PR 4 pinned (ISSUE 14 satellite)."""
+
+    def test_resume_is_exactly_once_everywhere(self, events_client):
+        events = events_client.events()
+        events.init(1)
+        events.insert_batch(_columnar_seed_events(), 1)
+        _assert_exactly_once_resume(events)
+
+    def test_resume_with_filter(self, events_client):
+        events = events_client.events()
+        events.init(1)
+        events.insert_batch(_columnar_seed_events(), 1)
+        _assert_exactly_once_resume(
+            events, EventFilter(entity_type="user"))
+
+    def test_resume_from_none_reads_everything(self, events_client):
+        from predictionio_tpu.online.follower import resume_columnar
+
+        events = events_client.events()
+        events.init(1)
+        events.insert_batch(_columnar_seed_events(), 1)
+        full = list(events.find(1))
+        got = []
+        for cols, idx in resume_columnar(events, 1, batch_size=3):
+            sub = cols.to_events()
+            got.extend(sub[int(i)] for i in idx)
+        assert got == full
+
+    def test_resume_rejects_limited_and_reversed_filters(
+            self, events_client):
+        from predictionio_tpu.online.follower import resume_columnar
+
+        events = events_client.events()
+        events.init(1)
+        with pytest.raises(ValueError):
+            list(resume_columnar(events, 1,
+                                 filter=EventFilter(reversed=True)))
+        with pytest.raises(ValueError):
+            list(resume_columnar(events, 1, filter=EventFilter(limit=3)))
+
+    def test_new_rows_behind_cursor_time_are_picked_up(
+            self, events_client):
+        """An event landing AFTER the cursor was saved but sorting
+        inside the cursor's timestamp tie (greater id) must still be
+        returned — the tie-resume half of the contract."""
+        from predictionio_tpu.online.follower import resume_columnar
+
+        events = events_client.events()
+        events.init(1)
+        ids = events.insert_batch(_columnar_seed_events(), 1)
+        full = list(events.find(1))
+        cursor = _cursor_of(full[-1], events)
+        # same timestamp as the last row, id forced greater
+        late = Event(event="view", entity_type="user", entity_id="u9",
+                     event_time=full[-1].event_time,
+                     event_id="z" * 32)
+        assert "z" * 32 > max(i or "" for i in ids)
+        events.insert(late, 1)
+        got = []
+        for cols, idx in resume_columnar(events, 1, cursor=cursor,
+                                         batch_size=2):
+            sub = cols.to_events()
+            got.extend(sub[int(i)] for i in idx)
+        assert [e.event_id for e in got] == ["z" * 32]
+
+    @pytest.mark.chaos
+    def test_chaos_backend_cursor_resume(self):
+        """Same contract through the chaos-wrapped DAO: injected faults
+        are absorbed by the retry layer and the resume stays
+        exactly-once."""
+        from predictionio_tpu.storage.chaos import ChaosStorageClient
+
+        inner = MemoryStorageClient()
+        client = ChaosStorageClient.wrap(inner, fault_rate=0.3, seed=7)
+        events = client.events()
+        events.init(1)
+        events.insert_batch(_columnar_seed_events(), 1)
+        _assert_exactly_once_resume(events)
+
+
+# ---------------------------------------------------------------------------
 # Metadata DAOs
 # ---------------------------------------------------------------------------
 
