@@ -765,6 +765,18 @@ def bench_workers_scaling(shrunk: bool = False):
     return bench_serving.bench_workers_section(shrunk=shrunk)
 
 
+def bench_gateway_phase(shrunk: bool = False):
+    """Multi-tenant gateway: 1 vs 2 engines behind one router + the
+    quota-isolation pin (a tenant driven past its qps quota is 429'd
+    while the sibling's p99 holds) — the PR 15 trajectory. Standalone
+    harness: bench_serving.py --gateway-only (committed artifacts:
+    BENCH_gateway_rNN.json); under --skip-heavy it runs shrunk (fewer
+    clients/rounds, same contract)."""
+    import bench_serving
+
+    return bench_serving.bench_gateway_section(shrunk=shrunk)
+
+
 def bench_data_plane():
     """Columnar scan vs row iterator + transactional batch ingest — the
     PR 4 data-plane trajectory. Standalone harness: bench_ingest.py
@@ -1294,6 +1306,8 @@ def main() -> None:
          lambda: bench_ann_retrieval(shrunk=args.skip_heavy)),
         ("workers_scaling",
          lambda: bench_workers_scaling(shrunk=args.skip_heavy)),
+        ("gateway",
+         lambda: bench_gateway_phase(shrunk=args.skip_heavy)),
         ("freshness",
          lambda: bench_freshness_section(shrunk=args.skip_heavy)),
         ("train_profile", bench_train_profile),
@@ -1308,8 +1322,11 @@ def main() -> None:
         # train_profile is a seconds-scale tiny train either way
         # freshness rides along shrunk: CPU + storage bound like
         # data_plane, no device involvement
+        # gateway rides along shrunk: CPU + loopback HTTP bound, no
+        # device involvement
         keep = ("quality", "ingest", "data_plane", "ann_retrieval",
-                "workers_scaling", "freshness", "train_profile")
+                "workers_scaling", "freshness", "train_profile",
+                "gateway")
         failed.extend(s[0] for s in sections if s[0] not in keep)
         sections = [s for s in sections if s[0] in keep]
     for section, fn in sections:

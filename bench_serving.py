@@ -165,6 +165,14 @@ def _client_main(argv: list[str]) -> None:
                     help="first global client id (seeds each client's "
                          "independent RNG over the shared pool)")
     ap.add_argument("--pool-size", type=int, required=True)
+    ap.add_argument("--path", default="/queries.json",
+                    help="request target (the gateway phase drives "
+                         "/engines/<name>/queries.json per tenant)")
+    ap.add_argument("--throttle-backoff", action="store_true",
+                    help="honor 429 Retry-After hints (sleep the hint "
+                         "before retrying) — the COMPLIANT over-quota "
+                         "tenant; without it the client hammers, the "
+                         "abusive one")
     args = ap.parse_args(argv)
 
     import random
@@ -177,20 +185,26 @@ def _client_main(argv: list[str]) -> None:
     # the load generator's CPU comes out of the server's budget —
     # a benchmark client must be cheaper than the thing it measures.
     requests = []
+    target = args.path.encode()
     for i in range(args.pool_size):
         body = json.dumps({"user": f"u{i}", "num": 10}).encode()
         requests.append(
-            b"POST /queries.json HTTP/1.1\r\n"
+            b"POST " + target + b" HTTP/1.1\r\n"
             b"Host: 127.0.0.1\r\n"
             b"Content-Type: application/json\r\n"
             b"Content-Length: " + str(len(body)).encode() + b"\r\n"
             b"\r\n" + body)
     lat: list[list[float]] = [[] for _ in range(args.threads)]
     errors = [0] * args.threads
+    statuses: list[dict[int, int]] = [{} for _ in range(args.threads)]
 
-    def read_response(sock: socket.socket, buf: bytearray) -> None:
+    def read_response(sock: socket.socket,
+                      buf: bytearray) -> tuple[int, float | None]:
         # headers, then exactly Content-Length body bytes (the server
-        # always sends Content-Length — engine_server._respond)
+        # always sends Content-Length — engine_server._respond);
+        # returns (status, retry_after_hint) so the gateway phase can
+        # count a quota-throttled tenant's 429s apart from served 200s
+        # and honor the hint under --throttle-backoff
         while True:
             head_end = buf.find(b"\r\n\r\n")
             if head_end >= 0:
@@ -200,6 +214,17 @@ def _client_main(argv: list[str]) -> None:
                 raise ConnectionError("closed mid-headers")
             buf += chunk
         head = bytes(buf[:head_end]).lower()
+        status = int(head[9:12])        # b"http/1.1 NNN ..."
+        retry_after = None
+        if status == 429:               # off the 200 path entirely
+            at_ra = head.find(b"retry-after:")
+            if at_ra >= 0:
+                end_ra = head.find(b"\r\n", at_ra)
+                try:
+                    retry_after = float(
+                        head[at_ra + 12:end_ra if end_ra >= 0 else None])
+                except ValueError:
+                    retry_after = None
         marker = b"content-length:"
         at = head.find(marker)
         if at < 0:
@@ -215,6 +240,7 @@ def _client_main(argv: list[str]) -> None:
                 raise ConnectionError("closed mid-body")
             buf += chunk
         del buf[:need]
+        return status, retry_after
 
     def client(tid: int, count: int, record: bool) -> None:
         cid = args.cid0 + tid
@@ -237,7 +263,7 @@ def _client_main(argv: list[str]) -> None:
                                         socket.TCP_NODELAY, 1)
                         buf.clear()
                     sock.sendall(req)
-                    read_response(sock, buf)
+                    status, retry_after = read_response(sock, buf)
                 except OSError:
                     errors[tid] += 1
                     if sock is not None:
@@ -245,7 +271,15 @@ def _client_main(argv: list[str]) -> None:
                     sock = None        # reconnects on next request
                     continue
                 if record:
-                    lat[tid].append(time.perf_counter() - t0)
+                    statuses[tid][status] = \
+                        statuses[tid].get(status, 0) + 1
+                    # only SERVED requests feed the latency
+                    # distribution: a 429 answers in microseconds and
+                    # would flatter a throttled tenant's percentiles
+                    if status == 200:
+                        lat[tid].append(time.perf_counter() - t0)
+                if status == 429 and args.throttle_backoff:
+                    time.sleep(min(retry_after or 0.05, 1.0))
         finally:
             if sock is not None:
                 sock.close()
@@ -264,36 +298,39 @@ def _client_main(argv: list[str]) -> None:
     print("READY", flush=True)
     sys.stdin.readline()            # GO
     run(args.count, record=True)
+    merged_status: dict[int, int] = {}
+    for per in statuses:
+        for code, n in per.items():
+            merged_status[code] = merged_status.get(code, 0) + n
     print(json.dumps({
         "lat": [x for per in lat for x in per],
         "errors": int(sum(errors)),
+        "status": {str(k): v for k, v in sorted(merged_status.items())},
     }), flush=True)
 
 
-def _run_round(port: int | list[int], pool_size: int, clients: int,
-               per_client: int, warmup: int, procs: int) -> dict:
-    """One synchronized multi-process load round against ``port`` — or
-    several ports: a LIST splits the client processes round-robin
-    across them (client-side load balancing, the router bench's
-    direct-to-replicas baseline)."""
+def _spawn_client(port: int, threads: int, count: int, warmup: int,
+                  cid0: int, pool_size: int,
+                  path: str = "/queries.json", backoff: bool = False):
+    """One load-generator child on the shared _client_main protocol
+    (READY after warmup → GO on stdin → one JSON result line) — the
+    ONE place the child argv is assembled, shared by every phase."""
     import subprocess
     import sys
 
-    ports = [port] if isinstance(port, int) else list(port)
-    procs = max(len(ports), min(procs, clients))
-    per_proc = [clients // procs + (1 if i < clients % procs else 0)
-                for i in range(procs)]
-    children = []
-    cid0 = 0
-    for i, n_threads in enumerate(per_proc):
-        children.append(subprocess.Popen(
-            [sys.executable, __file__, "--client",
-             "--port", str(ports[i % len(ports)]),
-             "--threads", str(n_threads),
-             "--count", str(per_client), "--warmup", str(warmup),
-             "--cid0", str(cid0), "--pool-size", str(pool_size)],
-            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True))
-        cid0 += n_threads
+    return subprocess.Popen(
+        [sys.executable, __file__, "--client",
+         "--port", str(port), "--threads", str(threads),
+         "--count", str(count), "--warmup", str(warmup),
+         "--cid0", str(cid0), "--pool-size", str(pool_size),
+         "--path", path,
+         *(["--throttle-backoff"] if backoff else [])],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+
+
+def _go(children: list) -> tuple[list[dict], float]:
+    """READY-handshake every child, broadcast GO, collect each child's
+    result line; returns (outputs, wall seconds of the timed window)."""
     for child in children:
         assert child.stdout.readline().strip() == "READY"
     t0 = time.perf_counter()
@@ -304,15 +341,44 @@ def _run_round(port: int | list[int], pool_size: int, clients: int,
     dt = time.perf_counter() - t0
     for child in children:
         child.wait(timeout=30)
+    return outs, dt
+
+
+def _run_round(port: int | list[int], pool_size: int, clients: int,
+               per_client: int, warmup: int, procs: int) -> dict:
+    """One synchronized multi-process load round against ``port`` — or
+    several ports: a LIST splits the client processes round-robin
+    across them (client-side load balancing, the router bench's
+    direct-to-replicas baseline)."""
+    ports = [port] if isinstance(port, int) else list(port)
+    procs = max(len(ports), min(procs, clients))
+    per_proc = [clients // procs + (1 if i < clients % procs else 0)
+                for i in range(procs)]
+    children = []
+    cid0 = 0
+    for i, n_threads in enumerate(per_proc):
+        children.append(_spawn_client(
+            ports[i % len(ports)], n_threads, per_client, warmup,
+            cid0, pool_size))
+        cid0 += n_threads
+    outs, dt = _go(children)
     flat = np.asarray([x for o in outs for x in o["lat"]])
     done = int(flat.size)
+    status_counts: dict[str, int] = {}
+    for o in outs:
+        for code, n in (o.get("status") or {}).items():
+            status_counts[code] = status_counts.get(code, 0) + n
     return {
         "qps": round(done / dt, 1),
-        "p50_ms": round(float(np.percentile(flat, 50)) * 1e3, 2),
-        "p95_ms": round(float(np.percentile(flat, 95)) * 1e3, 2),
-        "p99_ms": round(float(np.percentile(flat, 99)) * 1e3, 2),
+        "p50_ms": (round(float(np.percentile(flat, 50)) * 1e3, 2)
+                   if done else None),
+        "p95_ms": (round(float(np.percentile(flat, 95)) * 1e3, 2)
+                   if done else None),
+        "p99_ms": (round(float(np.percentile(flat, 99)) * 1e3, 2)
+                   if done else None),
         "queries": done,
         "errors": int(sum(o["errors"] for o in outs)),
+        "status_counts": status_counts,
     }
 
 
@@ -788,26 +854,50 @@ def _router_main(argv: list[str]) -> None:
 
     sys.setswitchinterval(0.0005)
     ap = argparse.ArgumentParser()
-    ap.add_argument("--backend", action="append", required=True)
+    ap.add_argument("--backend", action="append", default=None)
+    ap.add_argument("--engine", action="append", default=None,
+                    help="gateway phase: name=rec,backend=h:p[,qps=N]"
+                         " (fleet/gateway.py flag grammar)")
+    ap.add_argument("--default-engine", default=None)
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--reuse-port", action="store_true")
     args = ap.parse_args(argv)
 
     from predictionio_tpu.api.router_server import RouterServer
+    from predictionio_tpu.fleet.gateway import (
+        EngineSpec,
+        parse_engine_flag,
+    )
     from predictionio_tpu.fleet.router import RouterConfig
 
+    engines = ()
+    if args.engine:
+        engines = tuple(
+            EngineSpec(name=f["name"], backends=f["backends"],
+                       quota_qps=f["qps"], quota_burst=f["burst"],
+                       max_inflight=f["max_inflight"])
+            for f in (parse_engine_flag(t) for t in args.engine))
     # generous probe budget: a GIL-saturated CPython replica can sit on
     # a /healthz answer for over a second at full load, and a bench
     # round that marks a healthy-but-busy replica down measures the
     # mark-down, not the router hop
     server = RouterServer(RouterConfig(
-        ip="127.0.0.1", port=args.port, backends=tuple(args.backend),
+        ip="127.0.0.1", port=args.port,
+        backends=tuple(args.backend or ()),
+        engines=engines,
+        **({"default_engine": args.default_engine}
+           if args.default_engine else {}),
         reuse_port=args.reuse_port,
         probe_timeout_s=5.0, down_after=3))
     server.start()
     print(f"PORT {server.port}", flush=True)
     sys.stdin.readline()
-    stats = server.router.stats.raw_counts()
+    if engines:
+        stats = {"per_engine": {
+            g.name: g.router.stats.raw_counts()
+            for g in server.gateway.groups()}}
+    else:
+        stats = server.router.stats.raw_counts()
     server.stop()
     print(json.dumps(stats), flush=True)
 
@@ -929,6 +1019,299 @@ def bench_router(items: int = DEF_ITEMS, rank: int = DEF_RANK,
         "router_no_backend": router_stats.get("no_backend", 0),
         "router_group_spills": router_stats.get("group_spills", 0),
         "clients": clients,
+    }
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant gateway: 1 vs 2 engines behind one router + quota isolation
+# ---------------------------------------------------------------------------
+
+def _run_tenant_round(port: int, tenants: list[dict],
+                      warmup: int = DEF_WARMUP) -> dict:
+    """One synchronized round with SEVERAL tenants hitting one router
+    CONCURRENTLY, each tenant its own client subprocess driving its own
+    engine path (``--path``). Returns per-tenant stats keyed by tag —
+    the layout the quota-isolation pin needs: tenant A being throttled
+    while tenant B's latency is measured in the same instant."""
+    children: list = []
+    tags: list[str] = []
+    cid0 = 0
+    for t in tenants:
+        children.append(_spawn_client(
+            port, t["clients"], t["per_client"], warmup, cid0,
+            t["pool_size"], path=t["path"],
+            backoff=bool(t.get("backoff"))))
+        tags.append(t["tag"])
+        cid0 += t["clients"]
+    raw, dt = _go(children)
+    per_tag: dict[str, dict] = {}
+    for tag, out in zip(tags, raw):
+        flat = np.asarray(out["lat"])
+        served = int(flat.size)
+        per_tag[tag] = {
+            "qps": round(served / dt, 1),
+            "p50_ms": (round(float(np.percentile(flat, 50)) * 1e3, 2)
+                       if served else None),
+            "p99_ms": (round(float(np.percentile(flat, 99)) * 1e3, 2)
+                       if served else None),
+            "served": served,
+            "errors": int(out["errors"]),
+            "status": out.get("status") or {},
+        }
+    per_tag["wall_s"] = round(dt, 3)
+    return per_tag
+
+
+def bench_gateway(items: int = DEF_ITEMS, rank: int = DEF_RANK,
+                  clients: int = DEF_CLIENTS, per_client: int = 50,
+                  batch_max: int = 32, rounds: int = 4,
+                  quota_qps: float = 25.0) -> dict:
+    """The multi-tenant gateway's two pins (docs/fleet.md
+    "Multi-engine routing"; BENCH_gateway_rNN.json):
+
+    1. **table cost** — the SAME two replica processes driven through
+       one router configured one-engine (both replicas in the default
+       group, bare ``/queries.json``) vs two-engine (one replica per
+       engine, per-tenant ``/engines/<name>/queries.json`` paths,
+       clients split evenly). The only difference is the engine-table
+       resolution + per-engine quota hop, so the qps delta is the
+       gateway's cost — expected ≈0: route resolution is one dict hit.
+    2. **quota isolation** — on the two-engine router, tenant ``rec``
+       is driven against a qps quota (runtime ``POST /fleet/engines``
+       re-quota, no restart) while tenant ``ecom`` runs the identical
+       load as in the unthrottled rounds: ``rec`` must throttle with
+       429s and ``ecom``'s p99 must stay within session noise of its
+       unthrottled baseline — one tenant's burst spends its own
+       budget, never the sibling's.
+
+    Paired order-alternated rounds, steady-state means, every server
+    its own process (the bench_router discipline)."""
+    replica_args = ["--items", str(items), "--rank", str(rank),
+                    "--batch-max", str(batch_max)]
+    pool = [f"u{i}" for i in range(DEF_POOL)]
+    per_tenant_clients = max(2, clients // 2)
+    single_rounds: list[float] = []
+    multi_rounds: list[float] = []
+    unthrottled_b_p99: list[float] = []
+    compliant_b_p99: list[float] = []
+    abusive_b_p99: list[float] = []
+    throttled_429 = 0
+    throttled_a_served = 0
+    status_totals: dict[str, int] = {}
+    children: list = []
+    routers: list = []
+    try:
+        for _ in range(2):
+            children.append(_spawn("replica", replica_args))
+        r0, r1 = [port for _, port in children]
+        single_proc, single_port = _spawn(
+            "router", ["--backend", f"127.0.0.1:{r0}",
+                       "--backend", f"127.0.0.1:{r1}"])
+        routers.append(single_proc)
+        multi_proc, multi_port = _spawn(
+            "router", ["--engine", f"name=rec,backend=127.0.0.1:{r0}",
+                       "--engine", f"name=ecom,backend=127.0.0.1:{r1}",
+                       "--default-engine", "rec"])
+        routers.append(multi_proc)
+
+        def tenants(rec_per_client: int, ecom_per_client: int,
+                    rec_backoff: bool = False) -> list[dict]:
+            return [
+                {"tag": "rec", "path": "/engines/rec/queries.json",
+                 "clients": per_tenant_clients,
+                 "per_client": rec_per_client, "pool_size": len(pool),
+                 "backoff": rec_backoff},
+                {"tag": "ecom", "path": "/engines/ecom/queries.json",
+                 "clients": per_tenant_clients,
+                 "per_client": ecom_per_client, "pool_size": len(pool)},
+            ]
+
+        def fold_status(doc: dict) -> None:
+            for code, n in doc.items():
+                status_totals[code] = status_totals.get(code, 0) + n
+
+        # phase 1+2 interleaved: single vs multi, order-alternated
+        for i in range(rounds):
+            pair = [("s", None), ("m", None)]
+            if i % 2:
+                pair.reverse()
+            for tag, _ in pair:
+                if tag == "s":
+                    # TWO client processes, matching the two-engine
+                    # phase's one-proc-per-tenant layout exactly — on a
+                    # small host the client process count shifts
+                    # closed-loop throughput, and the table-cost delta
+                    # must not fold that in
+                    r = _drive([single_port], pool, clients, per_client,
+                               rounds=1, procs=2)
+                    single_rounds.append(r["qps"])
+                    fold_status(r.get("status_counts") or {})
+                else:
+                    per = _run_tenant_round(
+                        multi_port, tenants(per_client, per_client))
+                    multi_rounds.append(per["rec"]["qps"]
+                                        + per["ecom"]["qps"])
+                    if per["ecom"]["p99_ms"]:
+                        unthrottled_b_p99.append(per["ecom"]["p99_ms"])
+                    fold_status(per["rec"]["status"])
+                    fold_status(per["ecom"]["status"])
+
+        # phase 3: throttle tenant rec AT RUNTIME, same layout. The
+        # quota toggles PER ROUND through the runtime admin endpoint
+        # (no restart — the re-quota satellite exercised for real), so
+        # every throttled round has an adjacent unthrottled baseline
+        # and the p99 ratio never compares across host-drift blocks.
+        #
+        # Two over-quota tenant profiles, both sized to stay active
+        # through the neighbor's whole measured window (a fixed
+        # closed-loop count would otherwise burn through its budget in
+        # milliseconds of 429s and leave the window unpressured):
+        # - COMPLIANT: honors Retry-After — the isolation pin. Its
+        #   request rate collapses to ~quota, so on any host the
+        #   neighbor's p99 must hold.
+        # - ABUSIVE: ignores Retry-After and hammers. The gateway still
+        #   keeps its EXCESS off the replicas (served stays ~quota×wall,
+        #   zero 5xx) — but on a 1-core host the spin-looping client
+        #   processes themselves steal the shared CPU, so the
+        #   neighbor-p99 ratio is reported, not pinned (host_cores
+        #   recorded; the distortion is the load generator's, not the
+        #   gateway's — see docs/fleet.md).
+        # A's qps/wall numbers in these rounds are not comparable to
+        # the unthrottled rounds; only its 429/served split is.
+        import urllib.request
+
+        def set_quota(qps: float) -> None:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{multi_port}/fleet/engines",
+                data=json.dumps({"action": "quota", "name": "rec",
+                                 "quotaQps": qps,
+                                 "quotaBurst": qps}).encode(),
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+
+        def throttled_block(rec_per_client: int, rec_backoff: bool,
+                            sink: list[float]) -> list[float]:
+            nonlocal throttled_429, throttled_a_served
+            baselines: list[float] = []
+            for i in range(max(2, rounds // 2)):
+                pair = ["base", "thr"]
+                if i % 2:
+                    pair.reverse()
+                for tag in pair:
+                    if tag == "base":
+                        set_quota(0.0)          # explicit unlimited
+                        per = _run_tenant_round(
+                            multi_port, tenants(per_client, per_client))
+                        if per["ecom"]["p99_ms"]:
+                            baselines.append(per["ecom"]["p99_ms"])
+                    else:
+                        set_quota(quota_qps)
+                        per = _run_tenant_round(
+                            multi_port,
+                            tenants(rec_per_client, per_client,
+                                    rec_backoff=rec_backoff))
+                        if per["ecom"]["p99_ms"]:
+                            sink.append(per["ecom"]["p99_ms"])
+                        throttled_429 += per["rec"]["status"].get(
+                            "429", 0)
+                        throttled_a_served += per["rec"]["served"]
+                    fold_status(per["rec"]["status"])
+                    fold_status(per["ecom"]["status"])
+            return baselines
+
+        compliant_base = throttled_block(max(4, per_client // 2), True,
+                                         compliant_b_p99)
+        abusive_base = throttled_block(per_client * 20, False,
+                                       abusive_b_p99)
+
+        gateway_stats: dict = {}
+        for proc in routers:
+            proc.stdin.close()
+            doc = json.loads(proc.stdout.readline())
+            for engine, counts in (doc.get("per_engine") or {}).items():
+                for field, value in counts.items():
+                    key = f"{engine}_{field}"
+                    gateway_stats[key] = gateway_stats.get(key, 0) + value
+    finally:
+        for proc in [p for p, _ in children] + routers:
+            try:
+                if proc.stdin and not proc.stdin.closed:
+                    proc.stdin.close()
+                proc.wait(timeout=15)
+            except Exception:
+                proc.kill()
+
+    import os
+
+    def _mean(values: list[float]) -> float | None:
+        return sum(values) / len(values) if values else None
+
+    # each block's ratio uses its OWN interleaved baselines — the
+    # throttled rounds alternate with unthrottled ones on the same
+    # layout, so host drift between blocks never enters the ratio
+    b_base = _mean(unthrottled_b_p99)
+    b_compliant = _mean(compliant_b_p99)
+    b_abusive = _mean(abusive_b_p99)
+    base_c = _mean(compliant_base)
+    base_a = _mean(abusive_base)
+    http_5xx = sum(n for code, n in status_totals.items()
+                   if code.startswith("5"))
+    return {
+        "metric": f"gateway_quota_neighbor_p99_ratio_{clients}c",
+        # the isolation pin: the unthrottled tenant's p99 while its
+        # neighbor is being 429'd (Retry-After honored), over its own
+        # unthrottled baseline from the ADJACENT interleaved rounds
+        "value": (round(b_compliant / base_c, 3)
+                  if base_c and b_compliant else None),
+        "unit": "x",
+        "abusive_neighbor_p99_ratio_x": (
+            round(b_abusive / base_a, 3)
+            if base_a and b_abusive else None),
+        "two_engine_overhead_pct": round(
+            (1.0 - _steady_mean(multi_rounds)
+             / _steady_mean(single_rounds)) * 100.0, 2),
+        "single_engine_qps": round(_steady_mean(single_rounds), 1),
+        "two_engine_qps": round(_steady_mean(multi_rounds), 1),
+        "single_round_qps": single_rounds,
+        "two_engine_round_qps": multi_rounds,
+        "b_p99_unthrottled_ms": round(b_base, 2) if b_base else None,
+        "b_p99_compliant_base_ms": round(base_c, 2) if base_c else None,
+        "b_p99_compliant_throttle_ms": (
+            round(b_compliant, 2) if b_compliant else None),
+        "b_p99_abusive_base_ms": round(base_a, 2) if base_a else None,
+        "b_p99_abusive_throttle_ms": (
+            round(b_abusive, 2) if b_abusive else None),
+        "quota_qps": quota_qps,
+        "throttled_429": throttled_429,
+        "throttled_tenant_served": throttled_a_served,
+        "status_totals": status_totals,
+        "http_5xx": http_5xx,
+        "rec_quota_throttled_total": gateway_stats.get(
+            "rec_quota_throttled", 0),
+        "ecom_quota_throttled_total": gateway_stats.get(
+            "ecom_quota_throttled", 0),
+        "clients": clients,
+        "host_cores": os.cpu_count(),
+    }
+
+
+def bench_gateway_section(shrunk: bool = False) -> dict:
+    """The ``gateway`` section for bench.py's round artifact. Shrunk
+    (--skip-heavy): fewer clients/rounds, same harness contract."""
+    if shrunk:
+        r = bench_gateway(clients=8, per_client=12, rounds=2,
+                          quota_qps=10.0)
+    else:
+        r = bench_gateway(per_client=24)
+    return {
+        "gateway_quota_neighbor_p99_ratio_x": r["value"],
+        "gateway_abusive_neighbor_p99_ratio_x":
+            r["abusive_neighbor_p99_ratio_x"],
+        "gateway_two_engine_overhead_pct": r["two_engine_overhead_pct"],
+        "gateway_throttled_429": r["throttled_429"],
+        "gateway_http_5xx": r["http_5xx"],
+        "gateway_host_cores": r["host_cores"],
     }
 
 
@@ -1254,6 +1637,12 @@ def main() -> None:
     parser.add_argument("--client-procs", type=int, default=DEF_CLIENT_PROCS)
     parser.add_argument("--router-only", action="store_true",
                         help="run only the fleet-router overhead phase")
+    parser.add_argument("--gateway-only", action="store_true",
+                        help="run only the multi-tenant gateway phase "
+                             "(1 vs 2 engines + quota isolation; "
+                             "BENCH_gateway_rNN.json)")
+    parser.add_argument("--gateway-rounds", type=int, default=4)
+    parser.add_argument("--gateway-quota-qps", type=float, default=25.0)
     parser.add_argument("--ann-only", action="store_true",
                         help="run only the ANN catalog-size sweep")
     parser.add_argument("--ann-sizes", type=int, nargs="+", default=None,
@@ -1266,6 +1655,17 @@ def main() -> None:
                              "workers (0 skips it)")
     parser.add_argument("--workers-rounds", type=int, default=6)
     args = parser.parse_args()
+    if args.gateway_only:
+        # --client-procs deliberately NOT forwarded: both arms of the
+        # table-cost comparison pin the client layout at one process
+        # per tenant (two total) so the paired ratio never folds a
+        # client-topology difference in
+        print(json.dumps(bench_gateway(
+            items=args.items, rank=args.rank, clients=args.clients,
+            per_client=args.per_client, batch_max=args.batch_max,
+            rounds=args.gateway_rounds,
+            quota_qps=args.gateway_quota_qps)))
+        return
     if args.workers_only:
         print(json.dumps(bench_workers(
             items=args.items, rank=args.rank, clients=args.clients,

@@ -101,6 +101,12 @@ COMPUTE_PATHS = ("ops/", "models/", "e2/")
 #: recommendation query once --online is live, and the fold loop's
 #: deliberate host syncs (per-generation constants, per-user gathers on
 #: the background tail thread) carry justified suppressions
+#: fleet/gateway.py (PR 15) is covered by the fleet/ prefix here and in
+#: every fleet-scoped rule below (resilience-bypass,
+#: untimed-blocking-io incl. banned_sleep_paths): the engine-table
+#: resolution runs on EVERY gateway query, the gateway itself does no
+#: I/O (routing + token buckets only), and its table-mutation paths
+#: must never grow a bare sleep or an untimed fetch
 HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/", "obs/",
              "fleet/", "ops/ann.py", "online/")
 

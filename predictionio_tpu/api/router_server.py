@@ -2,11 +2,23 @@
 
 A thin HTTP process fronting N engine-server replicas. Routes:
 
-- ``POST /queries.json``   forwarded to a healthy replica (retry on a
-                           different one, optional hedging, canary
-                           split) — body bytes pass through untouched
-                           in BOTH directions: the router never pays a
-                           JSON parse on the hot path
+- ``POST /queries.json``   forwarded to a healthy replica of the
+                           DEFAULT engine (retry on a different one,
+                           optional hedging, canary split) — body bytes
+                           pass through untouched in BOTH directions:
+                           the router never pays a JSON parse on the
+                           hot path. ``X-PIO-Engine: <name>`` selects a
+                           named engine instead
+- ``POST /engines/<name>/queries.json``
+                           the same, path-addressed per engine — each
+                           engine is an independent backend group with
+                           its own membership/breakers/canary/quota
+                           (fleet/gateway.py, docs/fleet.md
+                           "Multi-engine routing")
+- ``GET|POST /fleet/engines`` the EngineTable: status JSON, and
+                           key-authed register/retire/quota/weight
+                           mutations propagated across --workers
+                           siblings via the admin spool
 - ``GET /``, ``GET /fleet`` fleet status document: per-backend state,
                            breaker, in-flight, canary, router counters
 - ``GET /fleet/metrics``   every replica's /metrics scraped (bounded),
@@ -61,12 +73,12 @@ from predictionio_tpu.api.http_base import (
     retry_after_header,
 )
 from predictionio_tpu.fleet.canary import GuardrailConfig
+from predictionio_tpu.fleet.gateway import EngineGateway
 from predictionio_tpu.fleet.router import (
     FleetRouter,
     RouterConfig,
     RouterResponse,
 )
-from predictionio_tpu.fleet.stats import router_collector
 from predictionio_tpu.fleet.transport import fan_out
 from predictionio_tpu.fleet.workers import WorkerHub
 from predictionio_tpu.obs.aggregate import (
@@ -109,11 +121,15 @@ class _Reject(Exception):
 
 
 class RouterService:
-    """Transport-free request logic over a :class:`FleetRouter`."""
+    """Transport-free request logic over an :class:`EngineGateway` —
+    one router process, N independent engine groups (fleet/gateway.py).
+    ``self.router`` stays the DEFAULT engine's FleetRouter, so every
+    single-engine consumer (tests, the supervisor/controller wiring,
+    operator muscle memory) is untouched."""
 
-    def __init__(self, router: FleetRouter):
-        self.router = router
-        self.config = router.config
+    def __init__(self, gateway: EngineGateway):
+        self.gateway = gateway
+        self.config = gateway.config
         self.on_stop = lambda: None
         self.access_log = access_log_enabled(self.config.access_log)
         if self.access_log:
@@ -136,8 +152,10 @@ class RouterService:
             "route", ("queries", "fleet", "metrics", "status", "traces"))
         self.registry = MetricRegistry()
         self.registry.register(self.request_latency.collect)
-        self.registry.register(router_collector(
-            router.stats, router.membership, router.canary))
+        #: per-engine router families (single implicit engine renders
+        #: exactly the pre-gateway exposition; multi-engine adds the
+        #: engine label + quota/burn families — fleet/gateway.py)
+        self.registry.register(gateway.collector())
         self.registry.register(resilience_collector())
         self.registry.register(server_info_collector("router"))
         self.registry.register(self.slo.collector())
@@ -164,12 +182,30 @@ class RouterService:
         self.supervisor = None
         self.controller = None
         if self.worker_hub is not None:
-            self.router.on_canary_abort = self._publish_canary_abort
+            self._wire_abort_hooks()
             self._sync_admin_once()     # respawn adoption
             self._admin_thread = threading.Thread(
                 target=self._admin_sync_loop,
                 name="pio-router-admin-sync", daemon=True)
             self._admin_thread.start()
+
+    @property
+    def router(self) -> FleetRouter:
+        """The CURRENT default engine's FleetRouter — resolved per
+        access, not captured at construction: a runtime
+        ``{"action": "default"}`` table mutation must repoint
+        /stats.json, the /fleet doc and the probe reporting too, or an
+        operator would watch a retired engine's frozen counters while
+        believing they see the default tenant."""
+        return self.gateway.default_group.router
+
+    def _wire_abort_hooks(self) -> None:
+        """Every engine group's guardrail verdict publishes to the
+        admin spool — idempotent, re-run after table mutations so
+        runtime-registered engines latch their siblings too."""
+        for group in self.gateway.groups():
+            if group.router.on_canary_abort is None:
+                group.router.on_canary_abort = self._publish_canary_abort
 
     def attach_supervisor(self, supervisor) -> None:
         from predictionio_tpu.fleet.supervisor import supervisor_collector
@@ -215,7 +251,29 @@ class RouterService:
         self._apply_admin(doc)
 
     def _apply_admin(self, doc: dict) -> None:
+        # cumulative engine-table documents (fleet/gateway.py): every
+        # publish carries the WHOLE table (specs + per-engine canary
+        # state), so a respawned worker adopts everything from the one
+        # latest document — register/retire/quota/weight/abort all ride
+        # the same diff-apply. The legacy action fields remain for
+        # operator readability (and the pinned abort-doc shape).
+        fleet = doc.get("fleet")
+        if isinstance(fleet, dict):
+            try:
+                changed = self.gateway.adopt_table(fleet)
+            except Exception:  # noqa: BLE001 — a bad doc must not kill the sync loop
+                logger.exception("adopting shared engine table failed "
+                                 "(seq %s)", doc.get("seq"))
+                return
+            self._wire_abort_hooks()
+            if changed:
+                logger.info("adopted shared engine table (seq %d): %s",
+                            doc["seq"], doc.get("action"))
+            return
         action = doc.get("action")
+        target = self.gateway.get(
+            str(doc.get("engine") or self.gateway.default_engine))
+        canary = (target or self.gateway.default_group).router.canary
         if action == "set_weight":
             try:
                 weight = float(doc["weight"])
@@ -233,11 +291,11 @@ class RouterService:
                         window=int(g["window"]))
                 except (KeyError, TypeError, ValueError):
                     guardrail = None
-            self.router.canary.set_weight(weight, guardrail=guardrail)
+            canary.set_weight(weight, guardrail=guardrail)
             logger.info("adopted shared canary weight %.1f%% (seq %d)",
                         weight, doc["seq"])
         elif action == "abort":
-            self.router.canary.abort(
+            canary.abort(
                 str(doc.get("reason") or "sibling abort"))
             logger.warning("adopted sibling canary abort (seq %d): %s",
                            doc["seq"], doc.get("reason"))
@@ -249,6 +307,11 @@ class RouterService:
         hub = self.worker_hub
         if hub is None:
             return
+        # every publish is CUMULATIVE: the whole engine table (specs +
+        # per-engine canary state) rides along, so the LATEST document
+        # alone is sufficient for a respawned sibling — an action log
+        # would strand whichever mutation was published second-to-last
+        doc = {**doc, "fleet": self.gateway.table_doc()}
         # publish AND advance _admin_seq under the one lock: the sync
         # loop compares seq under the same lock, so it can never read
         # the freshly-committed document in a gap before the seq
@@ -266,11 +329,22 @@ class RouterService:
         """FleetRouter.on_canary_abort hook: a guardrail verdict on
         THIS worker latches every sibling too — one worker's window
         seeing the breach first must not leave the others happily
-        routing canary traffic."""
-        snap = self.router.canary.snapshot()
+        routing canary traffic. Shared by every engine group's hook:
+        the published table carries EVERY canary's state, the legacy
+        reason field names the (most recently) aborted one."""
+        reason = None
+        engine = None
+        for group in self.gateway.groups():
+            snap = group.router.canary.snapshot()
+            if snap["aborted"] and snap.get("abortReason"):
+                reason = snap["abortReason"]
+                engine = group.name
+                if group.name == self.gateway.default_engine:
+                    break
         self._publish_admin({
             "action": "abort",
-            "reason": snap.get("abortReason") or "guardrail abort",
+            "reason": reason or "guardrail abort",
+            **({"engine": engine} if engine else {}),
         })
 
     # -- auth ---------------------------------------------------------------
@@ -287,13 +361,25 @@ class RouterService:
         """Returns a RouterResponse (raw passthrough) or the engine
         server's ``(status, payload[, headers])`` tuple shape."""
         try:
-            if method == "POST" and path == "/queries.json":
-                return self.router.route(body, headers, request_id)
+            if method == "POST" and self.gateway.is_query_path(path):
+                # O(1) engine resolution on the path (bare
+                # /queries.json → default engine or X-PIO-Engine
+                # header), per-engine quota, then the engine's own
+                # pick/forward/retry/hedge (fleet/gateway.py)
+                return self.gateway.route(path, body, headers,
+                                          request_id)
             if method == "GET" and path in ("/", "/fleet"):
                 return (200, self.fleet_doc())
             if method == "GET" and path == "/stats.json":
                 return (200, {"router": self.router.stats.snapshot(),
-                              "canary": self.router.canary.snapshot()})
+                              "canary": self.router.canary.snapshot(),
+                              "engines": self.gateway.snapshot()})
+            if path == "/fleet/engines":
+                if method == "GET":
+                    return (200, self.gateway.snapshot())
+                if method == "POST":
+                    self._check_router_key(params)
+                    return self.engines_admin(body)
             if method == "GET" and path == "/metrics":
                 return (200, PlainTextPayload(
                     self.metrics_text(), PROMETHEUS_CONTENT_TYPE))
@@ -359,21 +445,31 @@ class RouterService:
 
     def fleet_metrics_families(self) -> list[Metric]:
         """Scrape every replica's ``/metrics`` (bounded per replica by
-        ``scrape_timeout_s``) and re-export with ``replica``/``group``
-        labels, plus the fleet-wide ``pio_fleet_pressure`` gauge
-        derived from the bucket-merged queue-wait/device-dispatch
-        histograms. Scrapes bypass the data-path breakers on purpose: a
-        failed scrape must not mark a replica down for traffic, it just
+        ``scrape_timeout_s``) across EVERY engine group and re-export
+        with ``replica``/``group`` labels — plus ``engine=<name>`` when
+        the deployment is explicitly multi-engine (the single implicit
+        engine keeps the pre-gateway label set; obs/aggregate.relabel
+        never overwrites a label a replica already exports, so a
+        replica's own ``engine`` label survives the annotation). The
+        fleet-wide ``pio_fleet_pressure`` gauge derives from the
+        bucket-merged queue-wait/device-dispatch histograms, with a
+        per-engine sample per group in multi-engine mode (the signal
+        the ScaleController needs to scale engines independently).
+        Scrapes bypass the data-path breakers on purpose: a failed
+        scrape must not mark a replica down for traffic, it just
         reports ``pio_fleet_scrape_ok 0``. Returned as Metric families
         so the scale controller reads the same contract WITHOUT a
         render→reparse round-trip per tick (``GET /fleet/metrics``
         renders them)."""
+        labeled = self.gateway.labeled
         scrape_ok = Metric(
             name="pio_fleet_scrape_ok", kind="gauge",
             help="1 when the replica answered the fan-out scrape")
 
-        def scrape(backend) -> tuple[dict, list | None]:
-            labels = {"replica": backend.id, "group": backend.group}
+        def scrape(item) -> tuple[dict, list | None]:
+            engine, backend = item
+            labels = {"replica": backend.id, "group": backend.group,
+                      **({"engine": engine} if labeled else {})}
             try:
                 response = backend.transport.request(
                     "GET", "/metrics",
@@ -388,18 +484,24 @@ class RouterService:
                 return labels, None
 
         sources: list[tuple[str, list]] = []
-        queue_snaps: list = []
-        device_snaps: list = []
-        # ONE membership snapshot for both the fan-out and the zip:
-        # `backends` is a per-call copy and the scale controller
-        # mutates the underlying list at runtime — a second read could
-        # be shorter/shifted and attribute scrape results to the wrong
-        # replica
-        backends = self.router.membership.backends
+        # queue/device histograms accumulate per ENGINE (plus the
+        # fleet-wide merge across all of them)
+        queue_snaps: dict[str, list] = {}
+        device_snaps: dict[str, list] = {}
+        # ONE membership snapshot per group for both the fan-out and
+        # the zip: `backends` is a per-call copy and the scale
+        # controller mutates the underlying list at runtime — a second
+        # read could be shorter/shifted and attribute scrape results to
+        # the wrong replica
+        targets = [
+            (group.name, backend)
+            for group in self.gateway.groups()
+            for backend in group.router.membership.backends
+        ]
         # concurrent per replica (fan_out): the scrape pays the slowest
         # replica's timeout, not the sum over black-holed ones
-        scraped = fan_out(backends, scrape)
-        for backend, result in zip(backends, scraped):
+        scraped = fan_out(targets, scrape)
+        for (engine, backend), result in zip(targets, scraped):
             if result is None:
                 continue
             labels, families = result
@@ -409,16 +511,29 @@ class RouterService:
             scrape_ok.samples.append((labels, 1.0))
             for fam in families:
                 if fam.name == "pio_serving_queue_wait_seconds":
-                    queue_snaps.extend(s for _, s in fam.histograms)
+                    queue_snaps.setdefault(engine, []).extend(
+                        s for _, s in fam.histograms)
                 elif fam.name == "pio_serving_device_dispatch_seconds":
-                    device_snaps.extend(s for _, s in fam.histograms)
+                    device_snaps.setdefault(engine, []).extend(
+                        s for _, s in fam.histograms)
             sources.append((backend.id, relabel(families, labels)))
         merged = merge_sources(sources, source_label="replica")
         merged.append(scrape_ok)
-        if queue_snaps and device_snaps:
-            merged.append(pressure_metric(
-                merge_snapshots(queue_snaps),
-                merge_snapshots(device_snaps)))
+        all_queue = [s for snaps in queue_snaps.values() for s in snaps]
+        all_device = [s for snaps in device_snaps.values() for s in snaps]
+        if all_queue and all_device:
+            pressure = pressure_metric(
+                merge_snapshots(all_queue), merge_snapshots(all_device))
+            if labeled:
+                for engine in queue_snaps:
+                    if engine not in device_snaps:
+                        continue
+                    per = pressure_metric(
+                        merge_snapshots(queue_snaps[engine]),
+                        merge_snapshots(device_snaps[engine]),
+                        labels={"engine": engine})
+                    pressure.samples.extend(per.samples)
+            merged.append(pressure)
         return merged
 
     def stitched_trace(self, trace_id: str) -> tuple:
@@ -447,10 +562,15 @@ class RouterService:
                 return None
 
         scrape_errors = 0
-        # concurrent per replica: the merge pays the slowest replica's
-        # timeout, not the sum (fleet/transport.fan_out); one snapshot
-        # for fan-out AND zip — the backend list mutates at runtime
-        backends = self.router.membership.backends
+        # concurrent per replica ACROSS every engine group: the merge
+        # pays the slowest replica's timeout, not the sum
+        # (fleet/transport.fan_out); one snapshot for fan-out AND zip —
+        # the backend lists mutate at runtime
+        backends = [
+            backend
+            for group in self.gateway.groups()
+            for backend in group.router.membership.backends
+        ]
         rings = fan_out(backends, fetch_ring)
         for backend, docs in zip(backends, rings):
             if docs is None:
@@ -472,21 +592,42 @@ class RouterService:
                       "trace": tree})
 
     def readyz(self) -> tuple:
-        """Ready iff at least one replica is routable — a router with
-        no backends must drain from ITS OWN load balancer too."""
-        routable = len(self.router.membership.routable())
+        """Ready iff at least one replica is routable in ANY engine
+        group — a router with no serveable engine at all must drain
+        from ITS OWN load balancer too (one dark tenant does not; its
+        requests answer fast 503s while the siblings keep serving)."""
+        by_engine = {
+            group.name: len(group.router.membership.routable())
+            for group in self.gateway.groups()
+        }
+        routable = sum(by_engine.values())
+        extra = ({"routableByEngine": by_engine}
+                 if self.gateway.labeled else {})
         if routable > 0:
-            return (200, {"status": "ready", "routableBackends": routable})
-        return (503, {"status": "unavailable", "routableBackends": 0},
+            return (200, {"status": "ready",
+                          "routableBackends": routable, **extra})
+        return (503, {"status": "unavailable", "routableBackends": 0,
+                      **extra},
                 {"Retry-After": retry_after_header(
                     max(1.0, self.router.membership.probe_interval_s))})
 
     def fleet_doc(self) -> dict:
         return {
             "status": "alive",
-            "backends": self.router.membership.snapshot(),
+            # flattened across engine groups: identical to the
+            # pre-gateway doc for the single implicit engine (each
+            # backend snapshot carries its engine name when a gateway
+            # stamped one); canary/router keys stay the DEFAULT
+            # engine's — per-engine views live on /fleet/engines
+            "backends": [
+                doc
+                for group in self.gateway.groups()
+                for doc in group.router.membership.snapshot()
+            ],
             "canary": self.router.canary.snapshot(),
             "router": self.router.stats.snapshot(),
+            "defaultEngine": self.gateway.default_engine,
+            "engines": self.gateway.engine_names(),
             "inflight": self.router.inflight,
             "maxInflight": self.config.max_inflight,
             "hedge": self.config.hedge,
@@ -502,21 +643,72 @@ class RouterService:
                if self.controller is not None else {}),
         }
 
-    def canary_admin(self, body: bytes) -> tuple:
-        """POST /fleet/canary: ``{"weight": <0..100>[, "guardrail":
-        {...}]}`` starts/resizes a rollout (clearing any abort latch);
-        ``{"action": "abort"}`` kills it."""
+    def engines_admin(self, body: bytes) -> tuple:
+        """POST /fleet/engines (key-authed): mutate the engine table at
+        runtime — ``{"action": "register", "engine": {...}}``,
+        ``{"action": "retire"|"quota"|"weight"|"default",
+        "name": <engine>, ...}`` (fleet/gateway.py). Every mutation
+        publishes the cumulative table to the worker spool so siblings
+        and respawned workers adopt it."""
         try:
             doc = json.loads(body or b"{}")
         except json.JSONDecodeError:
             raise _Reject(400, "the request body is not valid JSON")
         if not isinstance(doc, dict):
             raise _Reject(400, "the request body must be a JSON object")
+        # adopt the latest sibling state BEFORE applying the local
+        # mutation: the publish below is CUMULATIVE (the whole table),
+        # so publishing from a stale view would silently erase a
+        # sibling's not-yet-synced mutation fleet-wide (e.g. a tenant
+        # registered through another worker inside the sync interval,
+        # retired everywhere by this publish). This shrinks the
+        # last-writer-wins window from admin_sync_interval_s to the
+        # mutation handling itself; truly simultaneous conflicting
+        # publishes remain last-writer-wins — the documented contract
+        # for human-speed admin (fleet/workers.py)
+        self._sync_admin_once()
+        try:
+            snap = self.gateway.admin_mutate(doc)
+        except ValueError as exc:
+            raise _Reject(400, str(exc))
+        self._wire_abort_hooks()
+        self._publish_admin(
+            {"action": f"engines_{doc.get('action')}"})
+        logger.info("engine table mutated: %s", doc.get("action"))
+        return (200, snap)
+
+    def canary_admin(self, body: bytes) -> tuple:
+        """POST /fleet/canary: ``{"weight": <0..100>[, "guardrail":
+        {...}]}`` starts/resizes a rollout (clearing any abort latch);
+        ``{"action": "abort"}`` kills it. An optional ``"engine"`` key
+        targets a named engine's canary; absent, the DEFAULT engine —
+        the single-engine contract unchanged."""
+        try:
+            doc = json.loads(body or b"{}")
+        except json.JSONDecodeError:
+            raise _Reject(400, "the request body is not valid JSON")
+        if not isinstance(doc, dict):
+            raise _Reject(400, "the request body must be a JSON object")
+        # sync-before-mutate, same reason as engines_admin: this
+        # mutation's publish carries the WHOLE table
+        self._sync_admin_once()
+        engine = doc.get("engine")
+        if engine is None:
+            group = self.gateway.default_group
+        else:
+            group = self.gateway.get(str(engine))
+            if group is None:
+                raise _Reject(400, f"unknown engine {engine!r}")
+        canary = group.router.canary
+        engine_field = ({"engine": group.name}
+                        if group.name != self.gateway.default_engine
+                        else {})
         if doc.get("action") == "abort":
-            self.router.canary.abort()
+            canary.abort()
             self._publish_admin({"action": "abort",
-                                 "reason": "operator abort"})
-            return (200, self.router.canary.snapshot())
+                                 "reason": "operator abort",
+                                 **engine_field})
+            return (200, canary.snapshot())
         if "weight" not in doc:
             raise _Reject(400, 'expected {"weight": <0..100>} or '
                                '{"action": "abort"}')
@@ -529,7 +721,7 @@ class RouterService:
         guardrail = None
         if isinstance(doc.get("guardrail"), dict):
             g = doc["guardrail"]
-            current = self.router.canary.guardrail
+            current = canary.guardrail
             try:
                 guardrail = GuardrailConfig(
                     min_requests=int(g.get("minRequests",
@@ -541,8 +733,9 @@ class RouterService:
                 )
             except (TypeError, ValueError) as exc:
                 raise _Reject(400, f"invalid guardrail: {exc}")
-        self.router.canary.set_weight(weight, guardrail=guardrail)
-        admin_doc: dict = {"action": "set_weight", "weight": weight}
+        canary.set_weight(weight, guardrail=guardrail)
+        admin_doc: dict = {"action": "set_weight", "weight": weight,
+                          **engine_field}
         if guardrail is not None:
             admin_doc["guardrail"] = {
                 "minRequests": guardrail.min_requests,
@@ -551,14 +744,16 @@ class RouterService:
                 "window": guardrail.window,
             }
         self._publish_admin(admin_doc)
-        logger.info("canary weight set to %.1f%%", weight)
-        return (200, self.router.canary.snapshot())
+        logger.info("canary weight set to %.1f%% (engine %s)", weight,
+                    group.name)
+        return (200, canary.snapshot())
 
 
 #: canned reason phrases for the statuses the router emits (the full
 #: http.HTTPStatus table costs a lookup per response; this is a dict hit)
 _REASONS = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
             404: "Not Found", 411: "Length Required",
+            429: "Too Many Requests",
             500: "Internal Server Error", 502: "Bad Gateway",
             503: "Service Unavailable"}
 
@@ -641,6 +836,7 @@ class _Handler(socketserver.StreamRequestHandler):
         "/queries.json": "queries",
         "/fleet": "fleet",
         "/fleet/canary": "fleet",
+        "/fleet/engines": "fleet",
         "/metrics": "metrics",
         "/fleet/metrics": "metrics",
         "/traces.json": "traces",
@@ -681,7 +877,12 @@ class _Handler(socketserver.StreamRequestHandler):
         params = ({k: v[0] for k, v in parse_qs(query).items()}
                   if query else {})
         status = 500
-        routed = method == "POST" and path == "/queries.json"
+        # O(1) on the raw request path: one dict hit against the
+        # precompiled engine route table (bare /queries.json and every
+        # /engines/<name>/queries.json — fleet/gateway.py)
+        routed = method == "POST" \
+            and self.service.gateway.is_query_path(path)
+        engine: str | None = None
         trace = None
         if routed and self.service.tracing:
             inbound_id, inbound_parent = parse_trace_context(headers)
@@ -700,8 +901,11 @@ class _Handler(socketserver.StreamRequestHandler):
                     method, path, params, headers, body, request_id)
             if isinstance(result, RouterResponse):
                 status = result.status
+                engine = result.engine
                 if routed:
                     log_extra = {
+                        **({"engine": result.engine}
+                           if result.engine else {}),
                         **({"replica": result.backend_id}
                            if result.backend_id else {}),
                         **({"group": result.group}
@@ -736,12 +940,24 @@ class _Handler(socketserver.StreamRequestHandler):
         finally:
             dt = time.perf_counter() - t_start
             self.service.request_latency.observe(
-                self._ROUTE_LABELS.get(path, "other"), dt)
-            if routed:
+                "queries" if routed
+                else self._ROUTE_LABELS.get(path, "other"), dt)
+            if routed and status != 429:
                 # SLO truth at the router = what the CLIENT saw: any
                 # 5xx (shed, expired, all-replicas-failed included)
-                # spends error budget
+                # spends error budget — globally AND on the resolved
+                # engine's own ring (the per-tenant burn gauges).
+                # Quota 429s are EXCLUDED from both rings: a throttled
+                # request is the per-tenant contract working, not
+                # service failure — and recording it as a microsecond
+                # "success" would flatter a tenant's latency SLO
+                # exactly when it is both throttled and slow (the same
+                # reason the gateway bench keeps 429s out of its
+                # latency percentiles); the throttle volume has its own
+                # signal, pio_router_quota_throttled_total{engine}
                 self.service.slo.record(ok=status < 500, latency_s=dt)
+                self.service.gateway.record_outcome(
+                    engine, ok=status < 500, latency_s=dt)
             if trace is not None:
                 trace.finish(status=status, **{
                     k: v for k, v in log_extra.items() if v or k == "attempts"})
@@ -772,8 +988,11 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class RouterServer(RestServer):
-    """HTTP lifecycle around :class:`RouterService` — starts the
-    membership probe loop with the listener, stops both on shutdown."""
+    """HTTP lifecycle around :class:`RouterService` — starts every
+    engine group's membership probe loop with the listener, stops them
+    all on shutdown. ``router`` (when passed explicitly) becomes the
+    DEFAULT engine's FleetRouter; ``config.engines`` declares the rest
+    of the table (fleet/gateway.py)."""
 
     log_label = "Fleet Router"
     thread_name = "pio-routerserver"
@@ -781,20 +1000,26 @@ class RouterServer(RestServer):
     def __init__(self, config: RouterConfig,
                  router: FleetRouter | None = None):
         self.config = config
-        self.router = router or FleetRouter(config)
-        super().__init__(_Handler, RouterService(self.router),
+        self.gateway = EngineGateway(config, default_router=router)
+        super().__init__(_Handler, RouterService(self.gateway),
                          config.ip, config.port,
                          reuse_port=config.reuse_port)
         self.service.on_stop = self.stop
 
+    @property
+    def router(self) -> FleetRouter:
+        """The CURRENT default engine's router (see
+        RouterService.router)."""
+        return self.gateway.default_group.router
+
     def start(self) -> None:
-        self.router.start()
+        self.gateway.start()
         super().start()
 
     def serve_forever(self) -> None:
-        self.router.start()
+        self.gateway.start()
         super().serve_forever()
 
     def _on_close(self) -> None:
         self.service.close()
-        self.router.close()
+        self.gateway.close()

@@ -30,7 +30,13 @@ def _cmd_version(args, storage: Storage) -> int:
 
 
 def _cmd_status(args, storage: Storage) -> int:
-    """Parity: commands/Management.scala:99-181 (pio status)."""
+    """Parity: commands/Management.scala:99-181 (pio status). With
+    ``--router host:port`` it inspects a running fleet router instead:
+    the registered engine table (name, group sizes, up/down counts,
+    canary weight, quota) from ``GET /fleet/engines`` — storage-free,
+    like the router itself (docs/fleet.md "Multi-engine routing")."""
+    if getattr(args, "router", None):
+        return _status_router(args)
     print("[INFO] Inspecting predictionio_tpu...")
     try:
         storage.verify_all_data_objects()
@@ -46,6 +52,45 @@ def _cmd_status(args, storage: Storage) -> int:
     except Exception as exc:
         print(f"[WARN] JAX unavailable: {exc}")
     print("[INFO] Your system is all ready to go.")
+    return 0
+
+
+def _status_router(args) -> int:
+    """`pio status --router host:port` — print the router's registered
+    engines."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{args.router}/fleet/engines"
+    try:
+        with urllib.request.urlopen(
+                url, timeout=getattr(args, "timeout", None) or 10.0) as r:
+            doc = json.loads(r.read())
+    except (urllib.error.URLError, OSError, ValueError) as exc:
+        print(f"[ERROR] router {args.router} unreachable: {exc}")
+        return 1
+    engines = doc.get("engines", [])
+    default = doc.get("defaultEngine")
+    print(f"[INFO] Fleet router {args.router}: {len(engines)} engine(s)"
+          f" (default: {default})")
+    for eng in engines:
+        name = eng.get("name")
+        marker = "*" if name == default else " "
+        parts = []
+        for group, counts in sorted((eng.get("groups") or {}).items()):
+            parts.append(f"{group} {counts.get('up', 0)}/"
+                         f"{counts.get('size', 0)} up")
+        canary = eng.get("canary") or {}
+        weight = canary.get("weightPct", 0.0)
+        state = (f"canary {weight:g}%"
+                 + (" ABORTED" if canary.get("aborted") else ""))
+        quota = eng.get("quota") or {}
+        if quota.get("limited"):
+            state += (f" | quota qps={quota.get('qps') or 'inf'}"
+                      f" inflight<={quota.get('maxInflight') or 'inf'}")
+        print(f"[INFO]  {marker} {name}: "
+              f"{'; '.join(parts) or 'no backends'} | {state}")
     return 0
 
 
@@ -283,11 +328,72 @@ def _cmd_router(args, storage: Storage) -> int:
             else max(1, min_replicas)
         replica_specs = [next_replica_spec() for _ in range(initial)]
 
+    # named engine groups (docs/fleet.md "Multi-engine routing"):
+    # each --engine declares an independent backend group with its own
+    # membership/breakers/canary/quota; replicas=N spawns supervised
+    # engine replicas from the --replica-cmd template on ports from
+    # that engine's port-base
+    engine_specs = []
+    engine_replica_specs: list[tuple[str, object]] = []
+    if args.engine:
+        from predictionio_tpu.fleet.gateway import (
+            EngineSpec,
+            parse_engine_flag,
+        )
+
+        try:
+            flags = [parse_engine_flag(text) for text in args.engine]
+        except ValueError as exc:
+            print(f"[ERROR] {exc}")
+            return 1
+        for flag in flags:
+            spawned: list[str] = []
+            if flag["replicas"]:
+                if replica_cmd is None or not supervise:
+                    print(f"[ERROR] --engine {flag['name']}: replicas= "
+                          "requires --supervise --replica-cmd (the "
+                          "supervisor owns engine replicas).")
+                    return 1
+                if flag["port_base"] is None:
+                    print(f"[ERROR] --engine {flag['name']}: replicas= "
+                          "needs port-base= (each engine owns its own "
+                          "port range).")
+                    return 1
+                from predictionio_tpu.fleet.supervisor import (
+                    REPLICA,
+                    SpawnSpec,
+                )
+
+                for i in range(flag["replicas"]):
+                    port = flag["port_base"] + i
+                    argv = [a.format(port=port)
+                            for a in shlex.split(replica_cmd)]
+                    engine_replica_specs.append((flag["name"], SpawnSpec(
+                        id=f"replica:{flag['name']}:{port}",
+                        spawn=(lambda argv=argv:
+                               subprocess.Popen(argv)),
+                        role=REPLICA,
+                        address=f"127.0.0.1:{port}")))
+                    spawned.append(f"127.0.0.1:{port}")
+            try:
+                engine_specs.append(EngineSpec(
+                    name=flag["name"],
+                    backends=flag["backends"] + tuple(spawned),
+                    canary_backends=flag["canary_backends"],
+                    canary_weight_pct=flag["weight"] or 0.0,
+                    quota_qps=flag["qps"],
+                    quota_burst=flag["burst"],
+                    max_inflight=flag["max_inflight"]))
+            except ValueError as exc:
+                print(f"[ERROR] {exc}")
+                return 1
+
     backends = tuple(args.backend or ()) + tuple(
         s.address for s in replica_specs)
-    if not backends:
-        print("[ERROR] at least one --backend host:port (or --supervise "
-              "--replica-cmd) is required.")
+    if not backends and not engine_specs:
+        print("[ERROR] at least one --backend host:port, --engine "
+              "name=...,backend=..., or --supervise --replica-cmd is "
+              "required.")
         return 1
     workers = max(1, args.workers or 1)
     config = RouterConfig(
@@ -295,6 +401,7 @@ def _cmd_router(args, storage: Storage) -> int:
         port=args.port,
         backends=backends,
         canary_backends=tuple(args.canary_backend or ()),
+        engines=tuple(engine_specs),
         router_key=args.router_key,
         access_log=args.access_log,
         tracing=args.tracing,
@@ -308,6 +415,7 @@ def _cmd_router(args, storage: Storage) -> int:
             "request_deadline_ms": args.request_deadline_ms,
             "hedge": args.hedge,
             "canary_weight_pct": args.canary_weight,
+            "default_engine": args.default_engine,
         }.items() if v is not None},
     )
     worker_procs = []
@@ -358,19 +466,37 @@ def _cmd_router(args, storage: Storage) -> int:
         )
 
         supervisor = FleetSupervisor(
-            replica_specs + worker_specs,
+            replica_specs + [s for _, s in engine_replica_specs]
+            + worker_specs,
             SupervisorConfig(**({"drain_key": args.replica_key}
                                 if args.replica_key else {})))
         supervisor.start()
-    server = RouterServer(config)
+    try:
+        server = RouterServer(config)
+    except ValueError as exc:
+        # gateway-level validation (duplicate --engine name, a name
+        # colliding with the default engine built from --backend):
+        # a pointed error like every other flag check — and any
+        # already-spawned supervised children must not be orphaned
+        if supervisor is not None:
+            supervisor.shutdown()
+        print(f"[ERROR] {exc}")
+        return 1
     if supervisor is not None:
         server.service.attach_supervisor(supervisor)
-        for spec in replica_specs:
+        for engine_name, spec in (
+                [(None, s) for s in replica_specs]
+                + engine_replica_specs):
             # template replicas are still booting (importing jax):
             # join them DOWN so the probe loop gates traffic onto them
             # when they actually serve — the same invariant the
-            # scale-up actuator establishes for identical cold spawns
-            backend = server.router.membership.by_id(spec.address)
+            # scale-up actuator establishes for identical cold spawns.
+            # Engine replicas live in THEIR engine's membership
+            group = (server.gateway.get(engine_name)
+                     if engine_name else None)
+            membership = (group.router.membership if group is not None
+                          else server.router.membership)
+            backend = membership.by_id(spec.address)
             if backend is not None:
                 backend.mark_down("starting")
     if supervise and (scaling or replica_cmd is not None):
@@ -426,6 +552,9 @@ def _cmd_router(args, storage: Storage) -> int:
           f"({len(config.backends)} stable / "
           f"{len(config.canary_backends)} canary backend(s), "
           f"{workers} worker(s)"
+          + (f", {len(server.gateway.engine_names())} engines "
+             f"[default: {server.gateway.default_engine}]"
+             if engine_specs else "")
           + (", supervised" if supervise else "")
           + (", scale controller "
              + ("dry-run" if controller is not None
@@ -718,7 +847,14 @@ def build_parser() -> argparse.ArgumentParser:
     sub = parser.add_subparsers(dest="command")
 
     sub.add_parser("version", help="show version")
-    sub.add_parser("status", help="verify environment and storage")
+    p = sub.add_parser("status", help="verify environment and storage")
+    p.add_argument("--router", default=None, metavar="HOST:PORT",
+                   help="inspect a running fleet router instead: print "
+                        "its registered engine table (name, group "
+                        "sizes, up/down counts, canary weight, quota) "
+                        "from GET /fleet/engines — storage-free")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="HTTP timeout for the --router fetch")
 
     p = sub.add_parser("eventserver", help="launch the event server")
     p.add_argument("--ip", default="0.0.0.0")
@@ -804,6 +940,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "tops out on its GIL long before the fleet "
                         "does); each worker probes and holds canary "
                         "state independently — see docs/fleet.md")
+    p.add_argument("--engine", action="append", metavar="SPEC",
+                   help="a named engine group behind this router "
+                        "(repeatable; docs/fleet.md \"Multi-engine "
+                        "routing\"): comma-separated key=value pairs — "
+                        "name=rec,backend=h:p+h:p[,canary=h:p]"
+                        "[,weight=10][,qps=100][,burst=200]"
+                        "[,max-inflight=64][,replicas=2,port-base=8300]"
+                        " (replicas= spawns supervised engine replicas "
+                        "from --replica-cmd). Requests route by path "
+                        "/engines/<name>/queries.json or the "
+                        "X-PIO-Engine header; bare /queries.json keeps "
+                        "hitting the default engine")
+    p.add_argument("--default-engine", default=None, dest="default_engine",
+                   metavar="NAME",
+                   help="engine bare /queries.json routes to (default: "
+                        "the --backend group, else the first --engine; "
+                        "PIO_ROUTER_DEFAULT_ENGINE)")
     p.add_argument("--access-log", action=argparse.BooleanOptionalAction,
                    default=None, dest="access_log",
                    help="structured JSON access logs")
@@ -1032,7 +1185,11 @@ def main(argv: list[str] | None = None) -> int:
         from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
 
         maybe_initialize_distributed()
-    if args.command in STORAGE_FREE_COMMANDS:
+    if args.command in STORAGE_FREE_COMMANDS or (
+            args.command == "status" and getattr(args, "router", None)):
+        # `pio status --router` inspects a running router over HTTP —
+        # storage-free like the router itself, so it works from an
+        # operator box with no PIO_STORAGE_* configured
         return _COMMANDS[args.command](args, None)
     storage = Storage.default()
     return _COMMANDS[args.command](args, storage)

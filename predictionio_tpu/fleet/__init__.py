@@ -23,6 +23,12 @@ servers, built from the primitives the repo already proved out:
 """
 
 from predictionio_tpu.fleet.canary import CanaryController, GuardrailConfig
+from predictionio_tpu.fleet.gateway import (
+    EngineGateway,
+    EngineGroup,
+    EngineQuota,
+    EngineSpec,
+)
 from predictionio_tpu.fleet.membership import (
     DOWN,
     UP,
@@ -31,6 +37,7 @@ from predictionio_tpu.fleet.membership import (
     FleetMembership,
 )
 from predictionio_tpu.fleet.router import (
+    AdmissionGate,
     FleetRouter,
     HedgePolicy,
     RouterConfig,
@@ -39,10 +46,15 @@ from predictionio_tpu.fleet.router import (
 from predictionio_tpu.fleet.stats import RouterStats
 
 __all__ = [
+    "AdmissionGate",
     "Backend",
     "BackendSpec",
     "CanaryController",
     "DOWN",
+    "EngineGateway",
+    "EngineGroup",
+    "EngineQuota",
+    "EngineSpec",
     "FleetMembership",
     "FleetRouter",
     "GuardrailConfig",

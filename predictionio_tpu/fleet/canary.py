@@ -144,6 +144,68 @@ class CanaryController:
             self._abort_reason = reason
             self._aborts += 1
 
+    # -- shared-admin-state round-trip (fleet/gateway.py) --------------------
+    def state_doc(self) -> dict:
+        """The controller's rollout state as a JSON-able document for
+        the worker-pool admin spool: weight, abort latch (+reason), and
+        the guardrail — everything a sibling (or a respawned worker)
+        needs to adopt this controller's verdict."""
+        with self._lock:
+            g = self.guardrail
+            return {
+                "weight": self._weight_pct,
+                "aborted": self._aborted,
+                "abortReason": self._abort_reason,
+                "guardrail": {
+                    "minRequests": g.min_requests,
+                    "maxErrorRate": g.max_error_rate,
+                    "maxP99Ms": g.max_p99_ms,
+                    "window": g.window,
+                },
+            }
+
+    def adopt_state(self, doc: dict) -> bool:
+        """Diff-apply a sibling's :meth:`state_doc`: only an ACTUAL
+        difference mutates (``set_weight`` clears the guardrail outcome
+        window, so re-applying an identical document on every admin
+        sync pass would reset the window forever and the guardrail
+        could never accumulate a verdict). Returns True when something
+        changed. Malformed documents are ignored — a torn or hostile
+        spool entry must never take the canary down."""
+        try:
+            weight = float(doc["weight"])
+            aborted = bool(doc["aborted"])
+        except (KeyError, TypeError, ValueError):
+            logger.warning("ignoring malformed canary state doc: %r", doc)
+            return False
+        guardrail = None
+        g = doc.get("guardrail")
+        if isinstance(g, dict):
+            try:
+                guardrail = GuardrailConfig(
+                    min_requests=int(g["minRequests"]),
+                    max_error_rate=float(g["maxErrorRate"]),
+                    max_p99_ms=float(g["maxP99Ms"]),
+                    window=int(g["window"]))
+            except (KeyError, TypeError, ValueError):
+                guardrail = None
+        with self._lock:
+            same_guardrail = guardrail is None or guardrail == self.guardrail
+            if (self._aborted == aborted
+                    and self._weight_pct == weight and same_guardrail):
+                return False
+        if aborted:
+            if guardrail is not None:
+                with self._lock:
+                    if guardrail != self.guardrail:
+                        self.guardrail = guardrail
+                        self._window = deque(
+                            maxlen=max(1, guardrail.window))
+            self.abort(str(doc.get("abortReason") or "sibling abort"))
+        else:
+            self.set_weight(weight, guardrail=guardrail)
+        return True
+
     def snapshot(self) -> dict:
         with self._lock:
             n = len(self._window)

@@ -60,23 +60,30 @@ STABLE, CANARY = "stable", "canary"
 @dataclasses.dataclass(frozen=True)
 class BackendSpec:
     """One replica's address and rollout group, parsed from
-    ``host:port`` (stable) / ``pio router --canary-backend`` (canary)."""
+    ``host:port`` (stable) / ``pio router --canary-backend`` (canary).
+    Behind a multi-engine gateway (fleet/gateway.py) the spec also
+    names the ENGINE whose group this replica belongs to, so flattened
+    fleet snapshots and metric labels attribute every replica to its
+    tenant ("" for the classic single-engine router)."""
 
     host: str
     port: int
     group: str = STABLE
     id: str = ""
+    engine: str = ""
 
     def __post_init__(self):
         if not self.id:
             object.__setattr__(self, "id", f"{self.host}:{self.port}")
 
     @classmethod
-    def parse(cls, addr: str, group: str = STABLE) -> "BackendSpec":
+    def parse(cls, addr: str, group: str = STABLE,
+              engine: str = "") -> "BackendSpec":
         host, sep, port = addr.rpartition(":")
         if not sep or not port.isdigit():
             raise ValueError(f"backend address {addr!r} is not host:port")
-        return cls(host=host or "127.0.0.1", port=int(port), group=group)
+        return cls(host=host or "127.0.0.1", port=int(port), group=group,
+                   engine=engine)
 
 
 class Backend:
@@ -213,6 +220,11 @@ class Backend:
             doc = {
                 "id": self.spec.id,
                 "group": self.spec.group,
+                # the single-engine router's snapshot shape is pinned
+                # by the pre-gateway suite: the engine key appears only
+                # when a gateway stamped one
+                **({"engine": self.spec.engine} if self.spec.engine
+                   else {}),
                 "state": self._state,
                 "inflight": self._inflight,
                 "okStreak": self._ok_streak,

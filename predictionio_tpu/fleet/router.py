@@ -97,7 +97,9 @@ class RouterResponse:
     through, never re-encoded), content type, extra headers — plus the
     routing metadata the access log and traces report (which replica
     answered, how many attempts it took, whether the hedge/retry
-    machinery fired)."""
+    machinery fired, and — behind a multi-engine gateway — WHICH
+    deployment the request resolved to: before the ``engine`` field the
+    access log and root trace spans had nowhere to record that)."""
 
     status: int
     body: bytes
@@ -109,6 +111,9 @@ class RouterResponse:
     attempts: int = 0
     hedged: bool = False
     retried: bool = False
+    #: the engine (tenant) this request resolved to (fleet/gateway.py);
+    #: None on non-routed responses and on pre-resolution rejects
+    engine: str | None = None
 
     @classmethod
     def error(cls, status: int, message: str,
@@ -117,6 +122,36 @@ class RouterResponse:
 
         return cls(status, json.dumps({"message": message}).encode(),
                    headers=headers or {})
+
+
+class AdmissionGate:
+    """The bounded-admission in-flight counter, factored out of
+    :class:`FleetRouter` so a multi-engine gateway (fleet/gateway.py)
+    can share ONE gate across every engine group: the 503 shed is a
+    verdict about GLOBAL router pressure — per-engine budgets are the
+    quota layer's job (429, ``EngineQuota``), and an engine-local 503
+    would let one tenant's burst masquerade as fleet saturation."""
+
+    def __init__(self, max_inflight: int):
+        self.max_inflight = max_inflight
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    def admit(self) -> bool:
+        with self._lock:
+            if self._inflight >= self.max_inflight:
+                return False
+            self._inflight += 1
+            return True
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
 
 
 class HedgePolicy:
@@ -184,10 +219,31 @@ class RouterConfig:
 
     ip: str = "0.0.0.0"
     port: int = 8100
-    #: stable replica addresses, ``host:port``
+    #: stable replica addresses, ``host:port`` — these become the
+    #: DEFAULT engine's backend group (fleet/gateway.py)
     backends: tuple[str, ...] = ()
     #: canary replica addresses (the new model generation)
     canary_backends: tuple[str, ...] = ()
+    #: named engine groups behind this one router
+    #: (:class:`~predictionio_tpu.fleet.gateway.EngineSpec` instances;
+    #: `pio router --engine name=...,backend=...`): each engine gets
+    #: its OWN membership, breakers, canary controller, hedging state
+    #: and quota — blast-radius isolation per tenant (docs/fleet.md
+    #: "Multi-engine routing")
+    engines: tuple = ()
+    #: the engine bare ``/queries.json`` routes to — zero breakage for
+    #: single-engine clients. When ``backends`` above is non-empty it
+    #: names the engine built from them; otherwise it must name one of
+    #: ``engines`` (falls back to the first declared engine)
+    default_engine: str = _env_field("DEFAULT_ENGINE", "default", str)
+    #: per-engine admission defaults for engines that do not set their
+    #: own (PIO_ROUTER_ENGINE_*): token-bucket qps (0 = unlimited),
+    #: burst (0 = max(1, qps)), and per-engine in-flight cap (0 = only
+    #: the GLOBAL max_inflight applies). Over-quota requests answer
+    #: 429 + Retry-After; the 503 shed stays a global-pressure verdict
+    engine_quota_qps: float = _env_field("ENGINE_QPS", 0.0, float)
+    engine_quota_burst: float = _env_field("ENGINE_BURST", 0.0, float)
+    engine_max_inflight: int = _env_field("ENGINE_MAX_INFLIGHT", 0, int)
     #: membership probe loop (fleet/membership.py)
     probe_interval_s: float = _env_field("PROBE_INTERVAL_S", 1.0, float)
     probe_timeout_s: float = _env_field("PROBE_TIMEOUT_S", 1.0, float)
@@ -266,11 +322,16 @@ class FleetRouter:
                  canary: CanaryController | None = None,
                  stats: RouterStats | None = None,
                  hedge_policy: HedgePolicy | None = None,
+                 admission: AdmissionGate | None = None,
+                 engine: str = "",
                  clock: Clock = SYSTEM_CLOCK):
         self.config = config
+        #: which engine group this router serves, for snapshot/metric
+        #: attribution ("" for the classic single-engine router)
+        self.engine = engine
         if membership is None:
             backends = [
-                Backend(BackendSpec.parse(addr, group),
+                Backend(BackendSpec.parse(addr, group, engine=engine),
                         breaker_threshold=config.breaker_threshold,
                         breaker_reset_s=config.breaker_reset_s,
                         clock=clock)
@@ -303,8 +364,9 @@ class FleetRouter:
         self.hedge_policy = hedge_policy or HedgePolicy(
             min_delay_ms=config.hedge_min_delay_ms,
             max_delay_ms=config.hedge_max_delay_ms)
-        self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        #: bounded admission — shared across every engine group when a
+        #: gateway fronts several (class docstring on AdmissionGate)
+        self._admission = admission or AdmissionGate(config.max_inflight)
         #: fired (post-lock, best-effort) when the guardrail auto-abort
         #: latches — the HTTP layer publishes the verdict to the worker
         #: admin spool so every `--workers` sibling aborts too instead
@@ -331,20 +393,16 @@ class FleetRouter:
 
     # -- admission + deadline -----------------------------------------------
     def _admit(self) -> bool:
-        with self._inflight_lock:
-            if self._inflight >= self.config.max_inflight:
-                return False
-            self._inflight += 1
-            return True
+        return self._admission.admit()
 
     def _release(self) -> None:
-        with self._inflight_lock:
-            self._inflight -= 1
+        self._admission.release()
 
     @property
     def inflight(self) -> int:
-        with self._inflight_lock:
-            return self._inflight
+        """In-flight requests through the admission gate — the GLOBAL
+        count when the gate is shared across engine groups."""
+        return self._admission.inflight
 
     def _deadline_budget(self, headers: Mapping[str, str]) -> float | None:
         """Seconds of budget via the shared engine-server contract
@@ -469,14 +527,19 @@ class FleetRouter:
                 tried.add(backend.id)
                 continue
         # every routable replica failed: surface the most informative
-        # thing we have — a real upstream response when one exists,
-        # else a 502 naming the failure
+        # thing we have — a real upstream response when one exists.
+        # Pure TRANSPORT failure (no replica even answered — the
+        # whole-group-killed case) is a retryable 503 + Retry-After,
+        # not a 502: the client's correct move is to back off and
+        # retry once the group's replicas return, and a dead tenant
+        # must degrade to FAST bounded 503s behind the gateway
+        # (docs/fleet.md "Multi-engine routing")
         response = _embedded_response(last_failure)
         if response is not None:
             out = self._passthrough(response)
         else:
             out = RouterResponse.error(
-                502, f"all replicas failed: {last_failure}",
+                503, f"no replica reachable: {last_failure}",
                 {"Retry-After": retry_after_header(1.0)})
         # every exchanged replica is in `tried` on this path (the
         # except clause adds non-hedge failures, _forward adds both

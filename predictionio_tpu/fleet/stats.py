@@ -20,8 +20,10 @@ class RouterStats:
     """Counters for the fleet router's forward path."""
 
     COUNTER_FIELDS = (
-        # admission + outcomes
-        "requests", "sheds", "expired", "no_backend",
+        # admission + outcomes; quota_throttled = per-engine token
+        # bucket said no (429; fleet/gateway.py) — distinct from sheds,
+        # which is the GLOBAL-pressure 503
+        "requests", "sheds", "quota_throttled", "expired", "no_backend",
         # resilience events
         "retries", "upstream_errors",
         # hedging
@@ -52,6 +54,14 @@ class RouterStats:
         with self._lock:
             self._counts["requests"] += 1
             self._counts[f"{group}_requests"] += 1
+
+    def bump_throttled(self) -> None:
+        """A quota-throttled request (429, fleet/gateway.py): counted
+        as a request AND a throttle under one lock acquisition — it
+        never reaches the per-group admission path."""
+        with self._lock:
+            self._counts["requests"] += 1
+            self._counts["quota_throttled"] += 1
 
     def count(self, field: str) -> int:
         with self._lock:

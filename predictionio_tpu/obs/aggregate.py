@@ -223,9 +223,15 @@ def source_count_metric(name: str, help: str, count: int) -> Metric:
 
 def relabel(metrics: Iterable[Metric], extra: Mapping[str, str]) -> list[Metric]:
     """Copies with ``extra`` merged into every sample's label set (the
-    ``replica=...`` annotation on ``/fleet/metrics``). Existing keys
-    are not overwritten — a replica that already labels per worker
-    keeps its labels."""
+    ``replica=...``/``group=...`` annotation on ``/fleet/metrics``,
+    plus ``engine=...`` behind a multi-engine gateway). Existing keys
+    are not overwritten — a replica that already labels per worker (or
+    already exports its own ``engine`` label) keeps its labels, so the
+    gateway's annotation can never collide with a source's. Label
+    VALUES pass through untouched: escaping happens at render time and
+    unescaping at parse time, so a hostile engine name (quotes,
+    backslashes, newlines) round-trips exactly (pinned in
+    tests/test_fleet_obs.py)."""
     out = []
     for m in metrics:
         out.append(Metric(

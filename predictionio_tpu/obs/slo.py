@@ -216,6 +216,24 @@ class SLOEngine:
         return collect
 
 
+def labeled_burn_metric(engines: Sequence[tuple[dict, "SLOEngine"]],
+                        name: str = "pio_slo_burn_rate",
+                        help: str = "Error-budget burn rate per SLO "
+                                    "and window") -> Metric:
+    """Fold SEVERAL SLO engines into ONE burn-rate family, each
+    engine's samples stamped with its label set — the multi-tenant
+    gateway's per-engine burn gauges (fleet/gateway.py): N engines
+    cannot each register their own collector for the same family name
+    (the exporter would render N conflicting HELP/TYPE blocks), so the
+    gateway builds the merged family here at scrape time."""
+    metric = Metric(name=name, kind="gauge", help=help)
+    for labels, engine in engines:
+        for (slo, window), rate in sorted(engine.burn_rates().items()):
+            metric.samples.append(
+                ({**labels, "slo": slo, "window": window}, rate))
+    return metric
+
+
 # ---------------------------------------------------------------------------
 # fleet pressure (module docstring)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,35 @@
+"""Shared fleet-test plumbing: the free-port grab and the deadline
+poll. One definition — test_fleet_router, test_fleet_supervisor and
+test_serving_workers each carried their own identical copy before, so
+a fix (the SO_REUSEADDR race, the timeout semantics) had to land three
+times."""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_until(pred, timeout: float = 15.0, interval: float = 0.05,
+               message: str = "condition"):
+    deadline = time.time() + timeout
+    last: Exception | None = None
+    while time.time() < deadline:
+        try:
+            if pred():
+                return
+        except Exception as exc:  # noqa: BLE001 — condition not ready yet
+            last = exc
+        time.sleep(interval)
+    pytest.fail(f"timed out waiting for {message}"
+                + (f" (last error: {last})" if last else ""))

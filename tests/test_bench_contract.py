@@ -73,6 +73,16 @@ def tiny_bench(monkeypatch):
         lambda shrunk=False: {"freshness_lag_p50_ms": 300.0,
                               "freshness_foldin_events_per_sec": 100.0,
                               "freshness_http_5xx": 0})
+    # gateway spawns a replica fleet + two router subprocesses
+    # (bench_serving.py --gateway-only) — stubbed here; the real tiny
+    # harness is the slow-marked test below
+    monkeypatch.setattr(
+        bench, "bench_gateway_phase",
+        lambda shrunk=False: {"gateway_quota_neighbor_p99_ratio_x": 1.0,
+                              "gateway_two_engine_overhead_pct": 0.5,
+                              "gateway_throttled_429": 100,
+                              "gateway_http_5xx": 0,
+                              "gateway_host_cores": 2})
     # keep calibration real but tiny (2048^3 bf16 chains are for the chip)
     real_calib = bench.bench_calibration
     monkeypatch.setattr(bench, "bench_calibration",
@@ -100,6 +110,10 @@ def test_single_json_line_with_primary_contract(tiny_bench, capsys, monkeypatch)
                 "workers_scaling_2w_vs_1w_x", "workers_host_cores",
                 "freshness_lag_p50_ms",
                 "freshness_foldin_events_per_sec",
+                # the multi-tenant gateway trajectory keys (PR 15)
+                "gateway_quota_neighbor_p99_ratio_x",
+                "gateway_two_engine_overhead_pct",
+                "gateway_throttled_429", "gateway_http_5xx",
                 # train_profile runs REAL (tiny train, seconds): the
                 # device/compiler observability trajectory keys
                 "train_profile_mfu", "train_profile_compile_seconds",
@@ -147,6 +161,8 @@ def test_skip_heavy_lists_skipped_sections(tiny_bench, capsys, monkeypatch):
     assert "workers_scaling_2w_vs_1w_x" in line
     # freshness runs SHRUNK under --skip-heavy too
     assert "freshness_lag_p50_ms" in line
+    # gateway runs SHRUNK under --skip-heavy too
+    assert "gateway_quota_neighbor_p99_ratio_x" in line
 
 
 @pytest.mark.perf
@@ -195,6 +211,31 @@ def test_freshness_harness_contract_tiny():
     assert r["freshness_workers_lag_p50_ms"] > 0
     assert r["freshness_http_5xx"] == 0
     assert r["freshness_http_requests"] > 0
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+@pytest.mark.fleet
+def test_gateway_harness_contract_tiny():
+    """bench_serving.py's real gateway phase at tiny scale: spawns the
+    2-replica fleet plus the one-engine and two-engine router
+    subprocesses, drives both tenants concurrently, throttles tenant
+    ``rec`` at runtime, and must report the neighbor-p99 ratio, the
+    table-cost delta, a non-zero 429 count for the throttled tenant,
+    and ZERO 5xx (the keys BENCH_gateway_rNN.json records).
+    Slow-marked: three jax-importing child processes."""
+    import bench_serving
+
+    r = bench_serving.bench_gateway(
+        items=4096, clients=4, per_client=8, rounds=2,
+        quota_qps=5.0)
+    assert r["value"] is not None and r["value"] > 0
+    assert r["single_engine_qps"] > 0 and r["two_engine_qps"] > 0
+    assert r["throttled_429"] > 0
+    assert r["rec_quota_throttled_total"] > 0
+    assert r["ecom_quota_throttled_total"] == 0
+    assert r["http_5xx"] == 0
+    assert r["host_cores"] >= 1
 
 
 @pytest.mark.perf

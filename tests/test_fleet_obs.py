@@ -120,6 +120,39 @@ class TestEscapingRoundTrip:
         assert keys == set(NASTY_LABELS)
 
 
+class TestEngineRelabel:
+    """The multi-engine gateway's /fleet/metrics annotation
+    (fleet/gateway.py): relabel attaches ``engine`` without colliding
+    with the existing ``replica``/``group`` labels, and a hostile
+    engine label VALUE survives the full render→parse round trip."""
+
+    @pytest.mark.parametrize("engine", NASTY_LABELS)
+    def test_hostile_engine_label_round_trips(self, engine):
+        fams = [Metric("pio_demo_total", "counter", "c",
+                       samples=[({"replica": "127.0.0.1:1",
+                                  "group": "stable"}, 3.0)])]
+        annotated = relabel(fams, {"engine": engine})
+        back = {m.name: m
+                for m in parse_exposition(render_metrics(annotated))}
+        labels, value = back["pio_demo_total"].samples[0]
+        assert labels == {"replica": "127.0.0.1:1", "group": "stable",
+                          "engine": engine}
+        assert value == 3.0
+
+    def test_existing_labels_never_overwritten(self):
+        """A replica that already exports its own engine (or replica/
+        group) label keeps it — the gateway's annotation only fills
+        gaps."""
+        fams = [Metric("pio_demo_total", "counter", "c",
+                       samples=[({"engine": "inner", "k": "v"}, 1.0),
+                                ({"k": "w"}, 2.0)])]
+        out = relabel(fams, {"engine": "outer", "replica": "r1"})
+        assert out[0].samples[0][0] == {
+            "engine": "inner", "k": "v", "replica": "r1"}
+        assert out[0].samples[1][0] == {
+            "engine": "outer", "k": "w", "replica": "r1"}
+
+
 class TestMerge:
     def test_histogram_merge_same_and_union_ladders(self):
         a = LatencyHistogram(bounds=(0.001, 0.1))

@@ -96,27 +96,7 @@ WORKER_CHILD = os.path.join(HERE, "fleet_worker_child.py")
 # helpers
 # ---------------------------------------------------------------------------
 
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def wait_until(pred, timeout: float = 15.0, interval: float = 0.05,
-               message: str = "condition"):
-    deadline = time.time() + timeout
-    last: Exception | None = None
-    while time.time() < deadline:
-        try:
-            if pred():
-                return
-        except Exception as exc:  # noqa: BLE001 — condition not ready yet
-            last = exc
-        time.sleep(interval)
-    pytest.fail(f"timed out waiting for {message}"
-                + (f" (last error: {last})" if last else ""))
+from tests.netutil import free_port, wait_until  # noqa: E402
 
 
 def replica_spec(port: int, tag: str) -> SpawnSpec:

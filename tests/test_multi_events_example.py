@@ -44,29 +44,41 @@ def example_engine():
 @pytest.fixture
 def seeded_storage(storage):
     """Two view-taste clusters; every even user dislikes item 0; u2
-    likes then dislikes it (latest must win)."""
+    likes then dislikes it (latest must win).
+
+    Stability notes (the PR 13 no_set_user discipline — strengthen the
+    DATA, not the tolerance): 32 users instead of 20 so item 0's
+    dislike column carries 16 unanimous signals (rank-8 ALS left a
+    10-signal margin close enough to a tie that platform accumulation
+    order under suite load flipped the blend-rank assertion), and
+    every emitted event gets a UNIQUE, monotonically increasing
+    timestamp so the training read order is the (eventTime, id) order
+    by construction — never the random-uuid tiebreak among
+    equal-timestamp rows."""
     app_id = storage.get_meta_data_apps().insert(App(0, "MultiSimilarApp"))
     events = storage.get_events()
     events.init(app_id)
     rng = np.random.default_rng(11)
     t0 = datetime.now(timezone.utc)
+    seq = iter(range(10_000_000))
 
     def emit(event, u, i, minutes=0):
         events.insert(
             Event(event=event, entity_type="user", entity_id=f"u{u}",
                   target_entity_type="item", target_entity_id=f"i{i}",
                   properties=DataMap({}),
-                  event_time=t0 + timedelta(minutes=minutes)),
+                  event_time=t0 + timedelta(minutes=minutes,
+                                            milliseconds=next(seq))),
             app_id,
         )
 
-    for u in range(20):
+    for u in range(32):
         for i in range(16):
             if i % 2 == u % 2 and rng.random() < 0.85:
                 emit("view", u, i)
             if i % 2 == u % 2 and i != 0 and rng.random() < 0.5:
                 emit("like", u, i)
-    for u in range(0, 20, 2):
+    for u in range(0, 32, 2):
         emit("dislike", u, 0, minutes=5)
     emit("like", 2, 0, minutes=6)
     emit("dislike", 2, 0, minutes=7)
