@@ -788,6 +788,19 @@ def bench_data_plane():
     return bench_ingest.bench_section()
 
 
+def bench_elasticity_section(shrunk: bool = False):
+    """Per-tenant elasticity plane (bench_elasticity.py; committed
+    artifacts: BENCH_elasticity_rNN.json): compliant-tenant p99 ratio
+    while an abusive sibling is throttled, burst-credit admission vs a
+    credit-less control, and the deterministic ManualClock
+    scale-decision timeline under a shared replica budget. Router
+    threads + stdlib echo backends, no device — runs (shrunk) under
+    --skip-heavy."""
+    import bench_elasticity
+
+    return bench_elasticity.bench_section(shrunk=shrunk)
+
+
 def bench_freshness_section(shrunk: bool = False):
     """Real-time freshness plane (bench_freshness.py; committed
     artifacts: BENCH_freshness_rNN.json): event→recommendation lag
@@ -1310,6 +1323,8 @@ def main() -> None:
          lambda: bench_gateway_phase(shrunk=args.skip_heavy)),
         ("freshness",
          lambda: bench_freshness_section(shrunk=args.skip_heavy)),
+        ("elasticity",
+         lambda: bench_elasticity_section(shrunk=args.skip_heavy)),
         ("train_profile", bench_train_profile),
     ]
     failed = []
@@ -1324,9 +1339,11 @@ def main() -> None:
         # data_plane, no device involvement
         # gateway rides along shrunk: CPU + loopback HTTP bound, no
         # device involvement
+        # elasticity rides along shrunk: router threads + stdlib echo
+        # backends + a ManualClock timeline, no device involvement
         keep = ("quality", "ingest", "data_plane", "ann_retrieval",
                 "workers_scaling", "freshness", "train_profile",
-                "gateway")
+                "gateway", "elasticity")
         failed.extend(s[0] for s in sections if s[0] not in keep)
         sections = [s for s in sections if s[0] in keep]
     for section, fn in sections:

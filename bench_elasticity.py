@@ -1,0 +1,470 @@
+"""bench_elasticity — multi-tenant elasticity under adversarial load.
+
+Measures the per-tenant elasticity plane (docs/fleet.md "Per-tenant
+elasticity"): per-engine scale controllers under a shared
+CapacityArbiter budget, per-engine quota admission, and the
+weighted-fair burst-credit reservoir.
+
+Phases (BENCH_elasticity_r01.json):
+
+- **tenant isolation** — one router, two live tenants over real HTTP:
+  compliant tenant ``b`` (no quota) is driven at a steady cadence
+  while abusive tenant ``a`` spins far past its near-zero quota.
+  Interleaved quiet/contended rounds (same reasoning as the gateway
+  bench): the headline is b's p99 WHILE a is being 429'd over b's own
+  p99 from the adjacent quiet rounds. b must see zero 5xx; a's 429
+  count shows the throttle was actually exercised.
+- **burst credits** — a bursty tenant with a credit reservoir idles
+  under quota (refill overflow banks credits), then fires one burst
+  against a drained bucket while the fleet has admission headroom; a
+  credit-less control tenant with the IDENTICAL quota fires the same
+  burst. Admitted-vs-429 counts for both plus the spent-credit
+  counter: credits are capacity nobody else was using.
+- **decision timeline** — deterministic (ManualClock, scripted
+  signals): three tenants run adversarial pressure shapes — diurnal
+  ramp, spike train, abusive flat-out — through real per-engine
+  ScaleControllers arbitrated under a shared replica budget. The
+  artifact records the full per-engine decision timeline with reason
+  attribution plus the arbiter's preemption/denial ledger.
+
+The live phases run in-process (router threads + stdlib echo
+backends): on the 1-core bench host a subprocess fleet adds
+time-slicing noise without adding fidelity, and the quantity under
+test — admission and isolation, not model math — is router-side. The
+multi-thread contention that remains is exactly what
+``host_cores_caveat`` annotates (memory note bench-host-cores).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from bench_serving import host_core_ratio_caveat
+
+
+# ---------------------------------------------------------------------------
+# in-process echo backend (the fleet-replica surface the router probes)
+# ---------------------------------------------------------------------------
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    tag = ""
+
+    def _respond(self, status: int, payload: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):  # noqa: N802
+        if self.path in ("/healthz", "/readyz"):
+            self._respond(200, b'{"status": "ok"}')
+        elif self.path == "/metrics":
+            self._respond(200, b"")
+        else:
+            self._respond(404, b"{}")
+
+    def do_POST(self):  # noqa: N802
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        self._respond(200, json.dumps({"tag": self.tag}).encode())
+
+    def log_message(self, *args):
+        pass
+
+
+def _echo_server(tag: str):
+    handler = type("H", (_EchoHandler,), {"tag": tag})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def _post(port: int, path: str, payload: dict,
+          timeout: float = 10.0) -> int:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+
+
+def _p99_ms(samples_ms: list[float]) -> float:
+    ordered = sorted(samples_ms)
+    return round(ordered[min(len(ordered) - 1,
+                             int(0.99 * len(ordered)))], 2)
+
+
+def _wait_serving(port: int, engines: list[str]) -> None:
+    deadline = time.time() + 15
+    pending = list(engines)
+    while pending and time.time() < deadline:
+        if _post(port, f"/engines/{pending[0]}/queries.json",
+                 {"warm": 1}) == 200:
+            pending.pop(0)
+        else:
+            time.sleep(0.05)
+    assert not pending, f"engines never served: {pending}"
+
+
+# ---------------------------------------------------------------------------
+# phase 1: abusive-neighbor isolation over live HTTP
+# ---------------------------------------------------------------------------
+
+def bench_isolation(rounds: int = 4, b_requests: int = 80,
+                    abusive_threads: int = 2,
+                    quota_qps: float = 0.05,
+                    quota_burst: float = 2.0) -> dict:
+    from predictionio_tpu.api.router_server import RouterServer
+    from predictionio_tpu.fleet.gateway import EngineSpec
+    from predictionio_tpu.fleet.router import RouterConfig
+
+    echo_a, echo_b = _echo_server("a"), _echo_server("b")
+    router = RouterServer(RouterConfig(
+        ip="127.0.0.1", port=0,
+        engines=(
+            # near-zero refill: the abusive spin must stay throttled
+            # for whole rounds even on a slow host (the PR 15 gateway
+            # bench rationale)
+            EngineSpec(name="a",
+                       backends=(f"127.0.0.1:{echo_a.server_port}",),
+                       quota_qps=quota_qps, quota_burst=quota_burst),
+            EngineSpec(name="b",
+                       backends=(f"127.0.0.1:{echo_b.server_port}",)),
+        ),
+        default_engine="b", probe_interval_s=0.25, up_after=1))
+    router.start()
+    quiet_p99: list[float] = []
+    contended_p99: list[float] = []
+    a_statuses: list[int] = []
+    b_5xx = 0
+    try:
+        _wait_serving(router.port, ["a", "b"])
+
+        def b_round() -> float:
+            samples = []
+            nonlocal b_5xx
+            for i in range(b_requests):
+                t0 = time.perf_counter()
+                status = _post(router.port,
+                               "/engines/b/queries.json", {"i": i})
+                samples.append((time.perf_counter() - t0) * 1000.0)
+                if status >= 500:
+                    b_5xx += 1
+            return _p99_ms(samples)
+
+        def abusive_spin(stop: threading.Event):
+            i = 0
+            while not stop.is_set():
+                status = _post(router.port,
+                               "/engines/a/queries.json", {"i": i})
+                a_statuses.append(status)
+                i += 1
+
+        for r in range(rounds):
+            # interleaved quiet/contended pairs, order alternated so
+            # host drift never lands on one side of the ratio
+            pair = ["quiet", "contended"]
+            if r % 2:
+                pair.reverse()
+            for kind in pair:
+                if kind == "quiet":
+                    quiet_p99.append(b_round())
+                else:
+                    stop = threading.Event()
+                    spinners = [threading.Thread(target=abusive_spin,
+                                                 args=(stop,))
+                                for _ in range(abusive_threads)]
+                    for t in spinners:
+                        t.start()
+                    contended_p99.append(b_round())
+                    stop.set()
+                    for t in spinners:
+                        t.join(timeout=10)
+    finally:
+        router.stop()
+        echo_a.shutdown()
+        echo_b.shutdown()
+    quiet = statistics.mean(quiet_p99)
+    contended = statistics.mean(contended_p99)
+    return {
+        "b_p99_quiet_ms": round(quiet, 2),
+        "b_p99_contended_ms": round(contended, 2),
+        "b_p99_ratio_x": round(contended / quiet, 3),
+        "b_http_5xx": b_5xx,
+        "b_requests": rounds * 2 * b_requests,
+        "a_throttled_429": a_statuses.count(429),
+        "a_served_200": a_statuses.count(200),
+        "round_p99_quiet_ms": quiet_p99,
+        "round_p99_contended_ms": contended_p99,
+    }
+
+
+# ---------------------------------------------------------------------------
+# phase 2: burst credits vs an identical credit-less quota
+# ---------------------------------------------------------------------------
+
+def bench_burst_credits(qps: float = 5.0, burst: float = 5.0,
+                        credits: float = 20.0, idle_s: float = 3.0,
+                        burst_n: int = 30) -> dict:
+    from predictionio_tpu.api.router_server import RouterServer
+    from predictionio_tpu.fleet.gateway import EngineSpec
+    from predictionio_tpu.fleet.router import RouterConfig
+
+    echo_c, echo_d = _echo_server("c"), _echo_server("d")
+    router = RouterServer(RouterConfig(
+        ip="127.0.0.1", port=0,
+        engines=(
+            EngineSpec(name="bursty",
+                       backends=(f"127.0.0.1:{echo_c.server_port}",),
+                       quota_qps=qps, quota_burst=burst,
+                       burst_credits=credits),
+            EngineSpec(name="control",
+                       backends=(f"127.0.0.1:{echo_d.server_port}",),
+                       quota_qps=qps, quota_burst=burst),
+        ),
+        default_engine="control", probe_interval_s=0.25, up_after=1))
+    router.start()
+    try:
+        _wait_serving(router.port, ["bursty", "control"])
+        # both tenants idle under quota; the bursty tenant's refill
+        # overflow banks credits, the control's evaporates
+        time.sleep(idle_s)
+
+        def fire(engine: str) -> list[int]:
+            return [_post(router.port,
+                          f"/engines/{engine}/queries.json", {"i": i})
+                    for i in range(burst_n)]
+
+        bursty = fire("bursty")
+        control = fire("control")
+        spends = router.gateway.get(
+            "bursty").quota.snapshot()["creditSpends"]
+    finally:
+        router.stop()
+        echo_c.shutdown()
+        echo_d.shutdown()
+    return {
+        "burst_size": burst_n,
+        "burst_quota_qps": qps,
+        "burst_idle_s": idle_s,
+        "burst_credits_configured": credits,
+        "burst_admitted_with_credits": bursty.count(200),
+        "burst_429_with_credits": bursty.count(429),
+        "burst_admitted_control": control.count(200),
+        "burst_429_control": control.count(429),
+        "burst_credit_spends": spends,
+    }
+
+
+# ---------------------------------------------------------------------------
+# phase 3: deterministic decision timeline over adversarial shapes
+# ---------------------------------------------------------------------------
+
+class _CountingActuator:
+    def __init__(self, current: int = 1):
+        self.n = current
+
+    def current(self) -> int:
+        return self.n
+
+    def add_replica(self) -> bool:
+        self.n += 1
+        return True
+
+    def remove_replica(self, reason=None) -> bool:
+        if self.n <= 0:
+            return False
+        self.n -= 1
+        return True
+
+
+class _ScriptedSLO:
+    def __init__(self):
+        self.burns: dict[str, float] = {}
+
+    def max_burns(self) -> dict[str, float]:
+        return dict(self.burns)
+
+
+class _ScriptedService:
+    """The sweep surface EngineScaleSet consumes, driven by scripted
+    per-tick pressures/burns instead of a live fleet scrape."""
+
+    class _Gateway:
+        def __init__(self, names):
+            self.labeled = True
+            self._groups = {
+                n: type("G", (), {"slo": _ScriptedSLO()})()
+                for n in names}
+
+        def get(self, name):
+            return self._groups.get(name)
+
+    def __init__(self, names):
+        self.gateway = self._Gateway(names)
+        self.pressures: dict[str, float] = {}
+
+    def fleet_metrics_families(self):
+        from predictionio_tpu.obs.registry import Metric
+
+        return [Metric(
+            name="pio_fleet_pressure", kind="gauge", help="scripted",
+            samples=[({"engine": n}, v)
+                     for n, v in self.pressures.items()])]
+
+
+def _shape_traces(ticks: int) -> dict[str, list[tuple[float, float]]]:
+    """Per-tick ``(pressure, fast_burn)`` per tenant: a diurnal ramp,
+    a spike train, and an abusive tenant that burns flat-out through
+    the first half then CAMPS — pressure parked between the down and
+    up thresholds, so it neither releases its replicas nor stays hot
+    enough to be protected. When the diurnal peak lands, the arbiter
+    must preempt the camper's above-min replicas (drain-then-retire),
+    not starve the compliant tenant."""
+    diurnal, spiky, abusive = [], [], []
+    for t in range(ticks):
+        # ramp up over the first half, back down over the second
+        phase = t / max(1, ticks - 1)
+        diurnal.append((round(0.9 - abs(phase - 0.5) * 1.6, 3), 0.0))
+        spiky.append((0.95, 0.0) if t % 8 in (4, 5) else (0.05, 0.0))
+        abusive.append((0.95, 20.0) if t < ticks // 2 else (0.3, 0.0))
+    return {"diurnal": diurnal, "spiky": spiky, "abusive": abusive}
+
+
+def _flat_reasons(snapshot: dict) -> dict[str, int]:
+    return {f"{decision}:{reason}": n
+            for decision, reasons in snapshot["decisionReasons"].items()
+            for reason, n in reasons.items()}
+
+
+def bench_decision_timeline(ticks: int = 24,
+                            tick_s: float = 10.0,
+                            budget: int = 6) -> dict:
+    from predictionio_tpu.fleet.controller import (
+        CapacityArbiter,
+        EngineScaleSet,
+        ScalePolicy,
+    )
+    from predictionio_tpu.utils.resilience import ManualClock
+
+    clock = ManualClock()
+    traces = _shape_traces(ticks)
+    service = _ScriptedService(list(traces))
+    scale_set = EngineScaleSet(
+        service, CapacityArbiter(budget, clock=clock), clock=clock)
+    actuators = {}
+    for name in traces:
+        actuators[name] = _CountingActuator(1)
+        scale_set.add_engine(name, ScalePolicy(
+            min_replicas=1, max_replicas=4, pressure_up=0.5,
+            burn_up=14.4, pressure_down=0.15, up_sustain_s=10.0,
+            down_sustain_s=30.0, cooldown_s=20.0, interval_s=tick_s),
+            actuators[name])
+
+    timeline: list[dict] = []
+    prev = {name: {} for name in traces}
+    for t in range(ticks):
+        for name, trace in traces.items():
+            pressure, burn = trace[t]
+            service.pressures[name] = pressure
+            service.gateway.get(name).slo.burns = {"fast": burn,
+                                                   "slow": 0.0}
+        scale_set.tick_all()
+        for name in traces:
+            snap = scale_set.get(name).snapshot()
+            flat = _flat_reasons(snap)
+            fresh = [key for key in flat
+                     if flat[key] > prev[name].get(key, 0)
+                     and not key.startswith("hold:")]
+            prev[name] = flat
+            if fresh:
+                timeline.append({
+                    "t_s": round(t * tick_s, 1), "engine": name,
+                    "decisions": sorted(fresh),
+                    "desired": snap["desiredReplicas"],
+                    "actual": snap["actualReplicas"],
+                })
+        clock.advance(tick_s)
+    arbiter = scale_set.arbiter.snapshot()
+    return {
+        "scale_ticks": ticks,
+        "scale_tick_s": tick_s,
+        "scale_replica_budget": budget,
+        "scale_budget_used_final": scale_set.arbiter.used(),
+        "scale_timeline": timeline,
+        "scale_decisions": {
+            name: _flat_reasons(scale_set.get(name).snapshot())
+            for name in traces},
+        "scale_preemptions": arbiter["preemptions"],
+        "scale_budget_denials": arbiter["denials"],
+        "scale_final_replicas": {name: act.n
+                                 for name, act in actuators.items()},
+    }
+
+
+# ---------------------------------------------------------------------------
+# glue
+# ---------------------------------------------------------------------------
+
+def bench_elasticity(rounds: int = 4, b_requests: int = 80,
+                     idle_s: float = 3.0, ticks: int = 24) -> dict:
+    out = {
+        "metric": "elasticity_compliant_p99_ratio",
+        "unit": "x",
+        "host_cores": os.cpu_count(),
+        # the isolation ratio folds client threads, the router, and
+        # both echo backends onto however many cores exist — on a
+        # 1-core host the contended p99 measures time-slicing as much
+        # as admission, so the ratio is reported, never pinned
+        "host_cores_caveat": host_core_ratio_caveat(),
+    }
+    out.update(bench_isolation(rounds=rounds, b_requests=b_requests))
+    out["value"] = out["b_p99_ratio_x"]
+    out.update(bench_burst_credits(idle_s=idle_s))
+    out.update(bench_decision_timeline(ticks=ticks))
+    return out
+
+
+def bench_section(shrunk: bool = False) -> dict:
+    """The bench.py ``elasticity`` section (router threads + stdlib
+    echo backends: CPU-light, runs under --skip-heavy too; full
+    artifacts: BENCH_elasticity_rNN.json)."""
+    if shrunk:
+        r = bench_elasticity(rounds=2, b_requests=30, idle_s=1.0,
+                             ticks=12)
+    else:
+        r = bench_elasticity()
+    return {
+        "elasticity_compliant_p99_ratio_x": r["value"],
+        "elasticity_b_http_5xx": r["b_http_5xx"],
+        "elasticity_throttled_429": r["a_throttled_429"],
+        "elasticity_burst_admitted_with_credits":
+            r["burst_admitted_with_credits"],
+        "elasticity_burst_admitted_control":
+            r["burst_admitted_control"],
+        "elasticity_scale_decisions_engines":
+            len(r["scale_decisions"]),
+        "elasticity_host_cores": r["host_cores"],
+        "elasticity_host_cores_caveat": r["host_cores_caveat"],
+    }
+
+
+if __name__ == "__main__":
+    result = bench_elasticity()
+    print(json.dumps(result, indent=2))
+    with open("BENCH_elasticity_r01.json", "w") as f:
+        json.dump(result, f, indent=2)

@@ -107,6 +107,11 @@ COMPUTE_PATHS = ("ops/", "models/", "e2/")
 #: resolution runs on EVERY gateway query, the gateway itself does no
 #: I/O (routing + token buckets only), and its table-mutation paths
 #: must never grow a bare sleep or an untimed fetch
+#: the per-tenant elasticity plane (PR 16: CapacityArbiter,
+#: EngineScaleSet, burst credits) rides the same fleet/ prefix — its
+#: sweep loop must stay on Event.wait, its one fleet scrape flows
+#: through the already-policed fleet_metrics fan-out, and the
+#: credit-spend check sits on the gateway's admit path
 HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/", "obs/",
              "fleet/", "ops/ann.py", "online/")
 

@@ -181,6 +181,7 @@ class RouterService:
         #: their collectors and appear in the /fleet document
         self.supervisor = None
         self.controller = None
+        self.scale_set = None
         if self.worker_hub is not None:
             self._wire_abort_hooks()
             self._sync_admin_once()     # respawn adoption
@@ -218,6 +219,17 @@ class RouterService:
 
         self.controller = controller
         self.registry.register(controller_collector(controller))
+
+    def attach_scale_set(self, scale_set) -> None:
+        """Per-tenant elasticity (`pio router --engine ... --supervise`
+        with scaling armed): one ScaleController per engine behind a
+        CapacityArbiter. Mutually exclusive with attach_controller —
+        the scale-set collector owns the pio_fleet_desired_replicas /
+        decisions families (labeled per engine when the gateway is)."""
+        from predictionio_tpu.fleet.controller import scale_set_collector
+
+        self.scale_set = scale_set
+        self.registry.register(scale_set_collector(scale_set))
 
     def close(self) -> None:
         self._admin_stop.set()
@@ -376,7 +388,7 @@ class RouterService:
                               "engines": self.gateway.snapshot()})
             if path == "/fleet/engines":
                 if method == "GET":
-                    return (200, self.gateway.snapshot())
+                    return (200, self.engines_doc())
                 if method == "POST":
                     self._check_router_key(params)
                     return self.engines_admin(body)
@@ -534,6 +546,18 @@ class RouterService:
                         labels={"engine": engine})
                     pressure.samples.extend(per.samples)
             merged.append(pressure)
+        if self.scale_set is not None:
+            # the per-tenant elasticity families ride the fleet-facing
+            # exposition too: every scale decision is attributed
+            # `engine=` right next to the pressure signal it answered
+            # (the acceptance contract; also in /metrics via the
+            # registry). The scale set's own sweep only reads
+            # pio_fleet_pressure from this list — no recursion.
+            from predictionio_tpu.fleet.controller import (
+                scale_set_collector,
+            )
+
+            merged.extend(scale_set_collector(self.scale_set)())
         return merged
 
     def stitched_trace(self, trace_id: str) -> tuple:
@@ -641,7 +665,38 @@ class RouterService:
                if self.supervisor is not None else {}),
             **({"scaleController": self.controller.snapshot()}
                if self.controller is not None else {}),
+            **({"elasticity": self.scale_set.snapshot()}
+               if self.scale_set is not None else {}),
         }
+
+    def engines_doc(self) -> dict:
+        """``GET /fleet/engines``: the gateway table, each engine
+        annotated with its scale state (bounds, desired/actual, last
+        decision+reason) when an elasticity loop — per-tenant scale
+        set or the single PR 9 controller — is attached. Storage-free:
+        everything comes from in-process snapshots."""
+        doc = self.gateway.snapshot()
+        scales: dict[str, dict] = {}
+        if self.scale_set is not None:
+            scales = self.scale_set.snapshot()["engines"]
+        elif self.controller is not None:
+            scales = {self.gateway.default_engine:
+                      self.controller.snapshot()}
+        if scales:
+            for entry in doc["engines"]:
+                snap = scales.get(entry.get("name"))
+                if snap is None:
+                    continue
+                entry["scale"] = {
+                    "minReplicas": snap["minReplicas"],
+                    "maxReplicas": snap["maxReplicas"],
+                    "desiredReplicas": snap["desiredReplicas"],
+                    "actualReplicas": snap["actualReplicas"],
+                    "dryRun": snap["dryRun"],
+                    "lastDecision": snap.get("lastDecision"),
+                    "lastReason": snap.get("lastReason"),
+                }
+        return doc
 
     def engines_admin(self, body: bytes) -> tuple:
         """POST /fleet/engines (key-authed): mutate the engine table at

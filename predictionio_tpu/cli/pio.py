@@ -89,6 +89,17 @@ def _status_router(args) -> int:
         if quota.get("limited"):
             state += (f" | quota qps={quota.get('qps') or 'inf'}"
                       f" inflight<={quota.get('maxInflight') or 'inf'}")
+        scale = eng.get("scale")
+        if scale:
+            last = scale.get("lastDecision")
+            reason = scale.get("lastReason")
+            state += (f" | replicas {scale.get('actualReplicas')}"
+                      f" (desired {scale.get('desiredReplicas')},"
+                      f" bounds {scale.get('minReplicas')}-"
+                      f"{scale.get('maxReplicas')}"
+                      + (", dry-run" if scale.get("dryRun") else "")
+                      + ")"
+                      + (f" | last {last}:{reason}" if last else ""))
         print(f"[INFO]  {marker} {name}: "
               f"{'; '.join(parts) or 'no backends'} | {state}")
     return 0
@@ -383,10 +394,22 @@ def _cmd_router(args, storage: Storage) -> int:
                     canary_weight_pct=flag["weight"] or 0.0,
                     quota_qps=flag["qps"],
                     quota_burst=flag["burst"],
-                    max_inflight=flag["max_inflight"]))
+                    max_inflight=flag["max_inflight"],
+                    burst_credits=flag["credits"],
+                    min_replicas=flag["min_replicas"],
+                    max_replicas=flag["max_replicas"]))
             except ValueError as exc:
                 print(f"[ERROR] {exc}")
                 return 1
+        if any(f["min_replicas"] is not None
+               or f["max_replicas"] is not None for f in flags):
+            # per-engine bounds arm scaling like the global flags do
+            if not supervise:
+                print("[ERROR] --engine min-replicas=/max-replicas= "
+                      "require --supervise (the supervisor owns the "
+                      "replicas the per-engine controllers scale).")
+                return 1
+            scaling = True
 
     backends = tuple(args.backend or ()) + tuple(
         s.address for s in replica_specs)
@@ -459,6 +482,7 @@ def _cmd_router(args, storage: Storage) -> int:
 
     supervisor = None
     controller = None
+    scale_set = None
     if supervise:
         from predictionio_tpu.fleet.supervisor import (
             FleetSupervisor,
@@ -499,7 +523,130 @@ def _cmd_router(args, storage: Storage) -> int:
             backend = membership.by_id(spec.address)
             if backend is not None:
                 backend.mark_down("starting")
-    if supervise and (scaling or replica_cmd is not None):
+    if supervise and (scaling or replica_cmd is not None) and engine_specs:
+        # per-tenant elasticity (docs/fleet.md "Per-tenant
+        # elasticity"): one ScaleController per engine group, each with
+        # its own bounds/hysteresis/cooldown, scale-ups arbitrated
+        # against the shared --replica-budget. Engines with supervised
+        # replicas actuate; engines fronting only static backends run
+        # dry (verdicts exported, nothing to spawn).
+        import os
+
+        from predictionio_tpu.fleet.controller import (
+            CapacityArbiter,
+            EngineScaleSet,
+            MembershipCountActuator,
+            ScalePolicy,
+            SupervisedFleetActuator,
+            engine_scale_policy,
+        )
+        from predictionio_tpu.fleet.supervisor import REPLICA, SpawnSpec
+
+        budget = args.replica_budget
+        if budget is None:
+            raw = os.environ.get("PIO_FLEET_REPLICA_BUDGET")
+            try:
+                budget = int(raw) if raw else 0
+            except ValueError:
+                print("[WARN] ignoring unparseable "
+                      f"PIO_FLEET_REPLICA_BUDGET={raw!r}")
+                budget = 0
+        dry_run = bool(args.scale_dry_run) or not scaling
+        if dry_run and not args.scale_dry_run:
+            print("[INFO] per-engine scale controllers in DRY-RUN (no "
+                  "scale bounds given): verdicts exported only; add "
+                  "min-replicas=/max-replicas= per engine or --scale-* "
+                  "to arm actuation (docs/fleet.md rollout runbook).")
+        #: the global --scale-* flags become each tenant's base layer;
+        #: PIO_FLEET_ENGINE_<NAME>_* env and per-engine flag keys
+        #: override (engine_scale_policy precedence)
+        base_policy = {
+            "min_replicas": args.min_replicas,
+            "max_replicas": args.max_replicas,
+            "interval_s": args.scale_interval_s,
+            "pressure_up": args.scale_pressure_up,
+            "burn_up": args.scale_burn_up,
+            "up_sustain_s": args.scale_up_sustain_s,
+            "down_sustain_s": args.scale_down_sustain_s,
+            "cooldown_s": args.scale_cooldown_s,
+        }
+        arbiter = CapacityArbiter(budget)
+        interval = (args.scale_interval_s
+                    if args.scale_interval_s is not None
+                    else ScalePolicy().interval_s)
+        scale_set = EngineScaleSet(server.service, arbiter,
+                                   interval_s=interval)
+        supervised: dict[str, list] = {}
+        for engine_name, spec in engine_replica_specs:
+            supervised.setdefault(engine_name, []).append(spec)
+        for flag in flags:
+            name = flag["name"]
+            group = server.gateway.get(name)
+            if group is None:
+                continue
+            owned = supervised.get(name)
+            engine_dry = dry_run
+            if owned and replica_cmd is not None:
+                # this engine's scale-up ports continue past its
+                # initial spawns, inside its own port-base range
+                counter = itertools.count(
+                    flag["port_base"] + flag["replicas"])
+
+                def make_engine_spec(_index=None, name=name,
+                                     counter=counter):
+                    port = next(counter)
+                    argv = [a.format(port=port)
+                            for a in shlex.split(replica_cmd)]
+                    return SpawnSpec(
+                        id=f"replica:{name}:{port}",
+                        spawn=lambda: subprocess.Popen(argv),
+                        role=REPLICA,
+                        address=f"127.0.0.1:{port}")
+
+                actuator = SupervisedFleetActuator(
+                    supervisor, group.router.membership,
+                    make_spec=make_engine_spec,
+                    breaker_threshold=config.breaker_threshold,
+                    breaker_reset_s=config.breaker_reset_s)
+                for spec in owned:
+                    actuator.adopt(spec.id)
+            else:
+                actuator = MembershipCountActuator(
+                    group.router.membership)
+                engine_dry = True
+            scale_set.add_engine(
+                name,
+                engine_scale_policy(
+                    name, dry_run=engine_dry, base=base_policy,
+                    min_replicas=flag["min_replicas"],
+                    max_replicas=flag["max_replicas"]),
+                actuator)
+        # the default engine built from --backend / --replica-cmd
+        # participates too when it exists alongside the named engines
+        default_name = server.gateway.default_engine
+        if backends and scale_set.get(default_name) is None \
+                and server.gateway.get(default_name) is not None:
+            engine_dry = dry_run
+            if next_replica_spec is not None:
+                actuator = SupervisedFleetActuator(
+                    supervisor, server.router.membership,
+                    make_spec=next_replica_spec,
+                    breaker_threshold=config.breaker_threshold,
+                    breaker_reset_s=config.breaker_reset_s)
+                for spec in replica_specs:
+                    actuator.adopt(spec.id)
+            else:
+                actuator = MembershipCountActuator(
+                    server.router.membership)
+                engine_dry = True
+            scale_set.add_engine(
+                default_name,
+                engine_scale_policy(default_name, dry_run=engine_dry,
+                                    base=base_policy),
+                actuator)
+        scale_set.start()
+        server.service.attach_scale_set(scale_set)
+    elif supervise and (scaling or replica_cmd is not None):
         from predictionio_tpu.fleet.controller import (
             MembershipCountActuator,
             ScaleController,
@@ -560,6 +707,10 @@ def _cmd_router(args, storage: Storage) -> int:
              + ("dry-run" if controller is not None
                 and controller.policy.dry_run else "active")
              if controller is not None else "")
+          + (f", per-engine elasticity x{len(scale_set.controllers())}"
+             + (f" budget={scale_set.arbiter.budget}"
+                if scale_set.arbiter.budget else "")
+             if scale_set is not None else "")
           + ")")
     if worker_procs or supervisor is not None:
         # SIGTERM's default action kills the parent without running
@@ -582,6 +733,8 @@ def _cmd_router(args, storage: Storage) -> int:
     finally:
         if controller is not None:
             controller.stop()
+        if scale_set is not None:
+            scale_set.stop()
         if supervisor is not None:
             supervisor.shutdown()
         server.stop()
@@ -947,8 +1100,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "name=rec,backend=h:p+h:p[,canary=h:p]"
                         "[,weight=10][,qps=100][,burst=200]"
                         "[,max-inflight=64][,replicas=2,port-base=8300]"
+                        "[,min-replicas=1,max-replicas=4][,credits=50]"
                         " (replicas= spawns supervised engine replicas "
-                        "from --replica-cmd). Requests route by path "
+                        "from --replica-cmd; min/max-replicas= bound "
+                        "that engine's OWN scale controller under the "
+                        "shared --replica-budget; credits= caps its "
+                        "burst-credit reservoir). Requests route by path "
                         "/engines/<name>/queries.json or the "
                         "X-PIO-Engine header; bare /queries.json keeps "
                         "hitting the default engine")
@@ -1023,6 +1180,14 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="scale_cooldown_s",
                    help="minimum gap between scale actions "
                         "(PIO_FLEET_COOLDOWN_S)")
+    p.add_argument("--replica-budget", type=int, default=None,
+                   dest="replica_budget",
+                   help="fleet-wide replica budget across ALL engines "
+                        "(device/HBM slots; 0 = unlimited, "
+                        "PIO_FLEET_REPLICA_BUDGET). Contention is "
+                        "burn-weighted; a hot tenant may preempt an "
+                        "idle tenant's above-min replica "
+                        "(docs/fleet.md \"Per-tenant elasticity\")")
 
     p = sub.add_parser(
         "trace",

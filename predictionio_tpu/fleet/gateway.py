@@ -78,6 +78,11 @@ ENGINE_NAME_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 DEFAULT_ENGINE = "default"
 
+#: fraction of the shared admission gate that must be free before a
+#: tenant may spend burst credits — borrowed capacity must be capacity
+#: nobody else is queueing for (an uncapped gate always has headroom)
+FLEET_IDLE_HEADROOM = 0.5
+
 
 def engine_query_path(name: str) -> str:
     return f"/engines/{name}/queries.json"
@@ -100,6 +105,16 @@ class EngineSpec:
     quota_burst: float | None = None
     #: per-engine concurrent in-flight cap; None inherits, 0 uncapped
     max_inflight: int | None = None
+    #: burst-credit reservoir cap (weighted fair queueing): unused
+    #: quota accrues as credits, spendable during a burst while the
+    #: fleet has headroom; None inherits ``PIO_ROUTER_ENGINE_BURST_
+    #: CREDITS``, 0 disables (docs/fleet.md "Per-tenant elasticity")
+    burst_credits: float | None = None
+    #: per-engine scale bounds consumed by the elasticity loop
+    #: (fleet/controller.py EngineScaleSet); None inherits the global
+    #: PIO_FLEET_MIN/MAX_REPLICAS defaults
+    min_replicas: int | None = None
+    max_replicas: int | None = None
 
     def __post_init__(self):
         if not ENGINE_NAME_RE.match(self.name):
@@ -119,6 +134,9 @@ class EngineSpec:
             "quotaQps": self.quota_qps,
             "quotaBurst": self.quota_burst,
             "maxInflight": self.max_inflight,
+            "burstCredits": self.burst_credits,
+            "minReplicas": self.min_replicas,
+            "maxReplicas": self.max_replicas,
         }
 
     @classmethod
@@ -136,6 +154,9 @@ class EngineSpec:
             quota_qps=opt("quotaQps", float),
             quota_burst=opt("quotaBurst", float),
             max_inflight=opt("maxInflight", int),
+            burst_credits=opt("burstCredits", float),
+            min_replicas=opt("minReplicas", int),
+            max_replicas=opt("maxReplicas", int),
         )
 
     def topology_key(self) -> tuple:
@@ -144,7 +165,8 @@ class EngineSpec:
         return (self.backends, self.canary_backends)
 
     def quota_key(self) -> tuple:
-        return (self.quota_qps, self.quota_burst, self.max_inflight)
+        return (self.quota_qps, self.quota_burst, self.max_inflight,
+                self.burst_credits)
 
 
 #: `pio router --engine` flag grammar: comma-separated key=value pairs.
@@ -155,6 +177,7 @@ class EngineSpec:
 _ENGINE_FLAG_KEYS = frozenset({
     "name", "backend", "canary", "weight", "qps", "burst",
     "max-inflight", "replicas", "port-base",
+    "credits", "min-replicas", "max-replicas",
 })
 
 
@@ -206,6 +229,9 @@ def parse_engine_flag(text: str) -> dict:
         "max_inflight": num("max-inflight", int),
         "replicas": num("replicas", int),
         "port_base": num("port-base", int),
+        "credits": num("credits", float),
+        "min_replicas": num("min-replicas", int),
+        "max_replicas": num("max-replicas", int),
     }
 
 
@@ -217,42 +243,70 @@ class EngineQuota:
     Retry-After hint in seconds — the 429 the gateway answers with, so
     one tenant's burst queues against its OWN budget and never a
     sibling's. Unlimited (qps=0, max_inflight=0) costs one uncontended
-    lock acquisition per request."""
+    lock acquisition per request.
+
+    With ``burst_credits`` > 0 the bucket gains a weighted-fair
+    reservoir: refill that would overflow the bucket cap (the tenant
+    running UNDER its quota) accrues as credits instead of vanishing,
+    and a credit substitutes for a token during a burst — but only
+    while the fleet has admission headroom (``fleet_idle``), so
+    borrowed capacity is capacity nobody else was using and compliant
+    tenants' p99 stays pinned."""
 
     def __init__(self, qps: float = 0.0, burst: float = 0.0,
-                 max_inflight: int = 0, clock: Clock = SYSTEM_CLOCK):
+                 max_inflight: int = 0, burst_credits: float = 0.0,
+                 clock: Clock = SYSTEM_CLOCK):
         self.qps = max(0.0, float(qps or 0.0))
         self.burst = (float(burst) if burst and burst > 0
                       else max(1.0, self.qps))
         self.max_inflight = max(0, int(max_inflight or 0))
+        self.burst_credits = max(0.0, float(burst_credits or 0.0))
         self._clock = clock
         self._lock = threading.Lock()
         self._tokens = self.burst
         self._last = clock.monotonic()
         self._inflight = 0
+        self._credits = 0.0
+        self._credit_spends = 0
 
     @property
     def limited(self) -> bool:
         return self.qps > 0 or self.max_inflight > 0
 
-    def try_admit(self) -> float | None:
+    def try_admit(self, fleet_idle: bool = False) -> float | None:
         """None = admitted (call :meth:`release` when done); else the
-        seconds-until-a-token-exists hint for Retry-After."""
+        seconds-until-a-token-exists hint for Retry-After.
+        ``fleet_idle`` gates credit spends: the caller (the gateway)
+        passes whether the shared admission gate has headroom."""
         with self._lock:
+            spend = 0  # 0 = free (unlimited qps), 1 = token, 2 = credit
             if self.qps > 0:
                 now = self._clock.monotonic()
-                self._tokens = min(
-                    self.burst, self._tokens + (now - self._last) * self.qps)
+                tokens = self._tokens + (now - self._last) * self.qps
+                if tokens > self.burst:
+                    if self.burst_credits > 0:
+                        self._credits = min(self.burst_credits,
+                                            self._credits
+                                            + tokens - self.burst)
+                    tokens = self.burst
+                self._tokens = tokens
                 self._last = now
-                if self._tokens < 1.0:
-                    return max(0.001, (1.0 - self._tokens) / self.qps)
+                if tokens >= 1.0:
+                    spend = 1
+                elif fleet_idle and self._credits >= 1.0:
+                    spend = 2
+                else:
+                    return max(0.001, (1.0 - tokens) / self.qps)
             if self.max_inflight and self._inflight >= self.max_inflight:
                 # no refill schedule to size the hint from: one qps
                 # beat when a rate exists, else a short constant (the
                 # header layer jitters every hint anyway)
                 return 1.0 / self.qps if self.qps > 0 else 0.25
-            if self.qps > 0:
+            if spend == 1:
                 self._tokens -= 1.0
+            elif spend == 2:
+                self._credits -= 1.0
+                self._credit_spends += 1
             self._inflight += 1
             return None
 
@@ -275,6 +329,10 @@ class EngineQuota:
                 "inflight": self._inflight,
                 "tokens": (round(self._tokens, 3)
                            if self.qps > 0 else None),
+                "burstCredits": self.burst_credits or None,
+                "credits": (round(self._credits, 3)
+                            if self.burst_credits > 0 else None),
+                "creditSpends": self._credit_spends,
             }
 
 
@@ -320,6 +378,9 @@ class EngineGroup:
                    else cfg.engine_quota_burst),
             max_inflight=(spec.max_inflight if spec.max_inflight is not None
                           else cfg.engine_max_inflight),
+            burst_credits=(spec.burst_credits
+                           if spec.burst_credits is not None
+                           else cfg.engine_burst_credits),
             clock=self._clock)
 
     @property
@@ -573,7 +634,11 @@ class EngineGateway:
         # fresh bucket would drive its in-flight count negative (and
         # quietly widen the cap by the number of in-flight requests)
         quota = group.quota
-        hint = quota.try_admit()
+        # Burst credits only spend into idle fleet capacity: gate on
+        # the SHARED admission gate's headroom so borrowed slots are
+        # slots no compliant tenant was using.
+        fleet_idle = self.admission.headroom() >= FLEET_IDLE_HEADROOM
+        hint = quota.try_admit(fleet_idle=fleet_idle)
         if hint is not None:
             group.router.stats.bump_throttled()
             trace = active_trace()
@@ -745,7 +810,9 @@ class EngineGateway:
                     quota_burst=field("quotaBurst",
                                       group.spec.quota_burst, float),
                     max_inflight=field("maxInflight",
-                                       group.spec.max_inflight, int))
+                                       group.spec.max_inflight, int),
+                    burst_credits=field("burstCredits",
+                                        group.spec.burst_credits, float))
             except (TypeError, ValueError) as exc:
                 raise ValueError(f"invalid quota: {exc}")
             group.apply_quota(spec)
@@ -806,6 +873,16 @@ class EngineGateway:
                     name="pio_router_engine_quota_qps", kind="gauge",
                     help="Configured token-bucket rate per engine "
                          "(0 = unlimited)")
+                credits = Metric(
+                    name="pio_router_engine_burst_credits", kind="gauge",
+                    help="Accrued burst credits per engine (weighted "
+                         "fair queueing reservoir; only engines with a "
+                         "credit cap emit a sample)")
+                spends = Metric(
+                    name="pio_router_engine_credit_spends_total",
+                    kind="counter",
+                    help="Admissions paid with a burst credit instead "
+                         "of a bucket token (fleet had headroom)")
                 for name, group in groups.items():
                     fams = router_collector(
                         group.router.stats, group.router.membership,
@@ -818,12 +895,22 @@ class EngineGateway:
                             have.samples.extend(fam.samples)
                             have.histograms.extend(fam.histograms)
                     labels = {"engine": name}
+                    quota = group.quota
                     inflight.samples.append(
-                        (labels, float(group.quota.inflight)))
-                    qps.samples.append((labels, float(group.quota.qps)))
+                        (labels, float(quota.inflight)))
+                    qps.samples.append((labels, float(quota.qps)))
+                    if quota.burst_credits > 0:
+                        snap = quota.snapshot()
+                        credits.samples.append(
+                            (labels, float(snap["credits"] or 0.0)))
+                        spends.samples.append(
+                            (labels, float(snap["creditSpends"])))
                 out.extend(merged.values())
                 out.append(inflight)
                 out.append(qps)
+                if credits.samples:
+                    out.append(credits)
+                    out.append(spends)
                 out.append(labeled_burn_metric(
                     [({"engine": name}, group.slo)
                      for name, group in groups.items()],

@@ -153,6 +153,16 @@ class AdmissionGate:
         with self._lock:
             return self._inflight
 
+    def headroom(self) -> float:
+        """Free fraction of the gate, 0.0 (saturated) .. 1.0 (idle).
+        An uncapped gate always reports full headroom. The burst-credit
+        layer (fleet/gateway.py) reads this to decide whether borrowed
+        capacity is really idle capacity."""
+        if self.max_inflight <= 0:
+            return 1.0
+        with self._lock:
+            return max(0.0, 1.0 - self._inflight / self.max_inflight)
+
 
 class HedgePolicy:
     """When and how late to fire a tail-latency hedge.
@@ -244,6 +254,13 @@ class RouterConfig:
     engine_quota_qps: float = _env_field("ENGINE_QPS", 0.0, float)
     engine_quota_burst: float = _env_field("ENGINE_BURST", 0.0, float)
     engine_max_inflight: int = _env_field("ENGINE_MAX_INFLIGHT", 0, int)
+    #: burst-credit reservoir cap for engines that do not set their own
+    #: (0 = credits off): under-quota refill accrues as credits, spent
+    #: during bursts while the shared gate has headroom — weighted fair
+    #: queueing atop the token bucket (docs/fleet.md "Per-tenant
+    #: elasticity")
+    engine_burst_credits: float = _env_field("ENGINE_BURST_CREDITS",
+                                             0.0, float)
     #: membership probe loop (fleet/membership.py)
     probe_interval_s: float = _env_field("PROBE_INTERVAL_S", 1.0, float)
     probe_timeout_s: float = _env_field("PROBE_TIMEOUT_S", 1.0, float)

@@ -255,14 +255,21 @@ class FleetSupervisor:
         if start:
             self._spawn(child)
 
-    def remove(self, spec_id: str, drain: bool = True) -> bool:
+    def remove(self, spec_id: str, drain: bool = True,
+               reason: str | None = None) -> bool:
         """Stop owning ``spec_id``: drain (replicas), SIGTERM with a
         grace window, SIGKILL stragglers. Returns False for an unknown
         id. The caller is expected to have detached the replica from
         routing FIRST (membership removal) — the drain here covers
-        routers this process does not own."""
+        routers this process does not own. ``reason`` stamps the
+        child's event log (e.g. ``remove:preempted_by_<engine>`` from
+        the CapacityArbiter) so a retirement is attributable."""
         with self._lock:
             child = self._children.pop(spec_id, None)
+            if child is not None and reason:
+                # only attributed removals stamp the log — unattributed
+                # ones keep the pinned ["spawn", "drain", ...] shape
+                child.events.append(f"remove:{reason}")
         if child is None:
             return False
         self._drain_and_stop(child, drain=drain)

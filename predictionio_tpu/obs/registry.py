@@ -62,24 +62,36 @@ class MetricRegistry:
         the scrape path, where tests live)."""
         with self._lock:
             collectors = list(self._collectors)
-        by_name: dict[str, Metric] = {}
+        out: list[Metric] = []
         for collector in collectors:
-            for metric in collector():
-                have = by_name.get(metric.name)
-                if have is None:
-                    by_name[metric.name] = dataclasses.replace(
-                        metric,
-                        samples=list(metric.samples),
-                        histograms=list(metric.histograms),
-                    )
-                    continue
-                if have.kind != metric.kind:
-                    raise ValueError(
-                        f"metric {metric.name!r} registered as both "
-                        f"{have.kind!r} and {metric.kind!r}")
-                have.samples.extend(metric.samples)
-                have.histograms.extend(metric.histograms)
-        return list(by_name.values())
+            out.extend(collector())
+        return merge_families(out)
+
+
+def merge_families(metrics: Sequence[Metric]) -> list[Metric]:
+    """Merge same-name families into one (duplicate HELP/TYPE blocks
+    are invalid exposition), failing loud on a kind mismatch. Input
+    families are never mutated — the first occurrence is copied.
+    Factored out of :meth:`MetricRegistry.collect` so composite
+    collectors (the multi-engine gateway, the per-tenant scale set)
+    can merge before registering."""
+    by_name: dict[str, Metric] = {}
+    for metric in metrics:
+        have = by_name.get(metric.name)
+        if have is None:
+            by_name[metric.name] = dataclasses.replace(
+                metric,
+                samples=list(metric.samples),
+                histograms=list(metric.histograms),
+            )
+            continue
+        if have.kind != metric.kind:
+            raise ValueError(
+                f"metric {metric.name!r} registered as both "
+                f"{have.kind!r} and {metric.kind!r}")
+        have.samples.extend(metric.samples)
+        have.histograms.extend(metric.histograms)
+    return list(by_name.values())
 
 
 class HistogramFamily:

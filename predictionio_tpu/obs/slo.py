@@ -190,6 +190,17 @@ class SLOEngine:
                 out[(obj.name, label)] = (bad / total) / obj.budget
         return out
 
+    def max_burns(self) -> dict[str, float]:
+        """``{window_label: worst burn across objectives}`` — the
+        scale-up signal shape the fleet controller consumes
+        (fleet/controller.py ``ScaleSignals``): any objective burning
+        hot in a window makes that window hot."""
+        out: dict[str, float] = {label: 0.0 for label, _ in self.windows}
+        for (_slo, window), rate in self.burn_rates().items():
+            if rate > out.get(window, 0.0):
+                out[window] = rate
+        return out
+
     # -- registry adapter ----------------------------------------------------
     def collector(self) -> Collector:
         def collect() -> list[Metric]:

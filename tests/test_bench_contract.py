@@ -83,6 +83,18 @@ def tiny_bench(monkeypatch):
                               "gateway_throttled_429": 100,
                               "gateway_http_5xx": 0,
                               "gateway_host_cores": 2})
+    # elasticity drives live router threads + a ManualClock timeline
+    # (bench_elasticity.py) — stubbed here; the real tiny harness is
+    # the slow-marked test below
+    monkeypatch.setattr(
+        bench, "bench_elasticity_section",
+        lambda shrunk=False: {"elasticity_compliant_p99_ratio_x": 1.0,
+                              "elasticity_b_http_5xx": 0,
+                              "elasticity_throttled_429": 100,
+                              "elasticity_burst_admitted_with_credits": 21,
+                              "elasticity_burst_admitted_control": 5,
+                              "elasticity_host_cores": 2,
+                              "elasticity_host_cores_caveat": None})
     # keep calibration real but tiny (2048^3 bf16 chains are for the chip)
     real_calib = bench.bench_calibration
     monkeypatch.setattr(bench, "bench_calibration",
@@ -114,6 +126,11 @@ def test_single_json_line_with_primary_contract(tiny_bench, capsys, monkeypatch)
                 "gateway_quota_neighbor_p99_ratio_x",
                 "gateway_two_engine_overhead_pct",
                 "gateway_throttled_429", "gateway_http_5xx",
+                # the per-tenant elasticity trajectory keys (PR 16)
+                "elasticity_compliant_p99_ratio_x",
+                "elasticity_b_http_5xx", "elasticity_throttled_429",
+                "elasticity_burst_admitted_with_credits",
+                "elasticity_host_cores_caveat",
                 # train_profile runs REAL (tiny train, seconds): the
                 # device/compiler observability trajectory keys
                 "train_profile_mfu", "train_profile_compile_seconds",
@@ -163,6 +180,8 @@ def test_skip_heavy_lists_skipped_sections(tiny_bench, capsys, monkeypatch):
     assert "freshness_lag_p50_ms" in line
     # gateway runs SHRUNK under --skip-heavy too
     assert "gateway_quota_neighbor_p99_ratio_x" in line
+    # elasticity runs SHRUNK under --skip-heavy too
+    assert "elasticity_compliant_p99_ratio_x" in line
 
 
 @pytest.mark.perf
@@ -236,6 +255,40 @@ def test_gateway_harness_contract_tiny():
     assert r["ecom_quota_throttled_total"] == 0
     assert r["http_5xx"] == 0
     assert r["host_cores"] >= 1
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+@pytest.mark.elasticity
+def test_elasticity_harness_contract_tiny():
+    """bench_elasticity.py's real harness at tiny scale: live router +
+    echo backends for the isolation and burst-credit phases, a
+    ManualClock EngineScaleSet for the timeline phase. Must report the
+    compliant-tenant ratio with ZERO 5xx, a throttled abusive tenant,
+    more burst admissions with credits than without, a non-empty
+    per-engine decision timeline, and the 1-core caveat contract (the
+    keys BENCH_elasticity_rNN.json records). Slow-marked: live HTTP
+    rounds plus a deliberate credit-accrual idle."""
+    import os
+
+    import bench_elasticity
+
+    r = bench_elasticity.bench_elasticity(
+        rounds=1, b_requests=20, idle_s=1.0, ticks=12)
+    assert r["value"] > 0
+    assert r["b_http_5xx"] == 0
+    assert r["a_throttled_429"] > 0
+    assert r["burst_admitted_with_credits"] > r["burst_admitted_control"]
+    assert r["burst_credit_spends"] > 0
+    assert r["scale_timeline"], "timeline must record scale decisions"
+    assert set(r["scale_decisions"]) == {"diurnal", "spiky", "abusive"}
+    # honest 1-core caveat: present exactly when the host is too small
+    # for multi-process ratios to be pins
+    cores = os.cpu_count() or 1
+    if cores < 2:
+        assert r["host_cores_caveat"] and "NOT a pin" in r["host_cores_caveat"]
+    else:
+        assert r["host_cores_caveat"] is None
 
 
 @pytest.mark.perf

@@ -1,0 +1,656 @@
+"""Per-tenant elasticity suite (docs/fleet.md "Per-tenant
+elasticity"): one scale controller per engine group under a shared
+CapacityArbiter, weighted-fair burst credits at the gateway, and the
+multi-tenant chaos acceptance.
+
+The acceptance scenario:
+
+- two live engines behind one router, each with its own supervised
+  replica set and scale bounds; an abusive tenant A spins past its
+  quota while compliant tenant B serves under live load → B sees ZERO
+  5xx and its SLO burn stays under 1.0 while A is throttled; ``kill
+  -9`` A's replicas mid-ramp → the supervisor restores A within A's
+  own min/max without B losing a replica; every scale decision is
+  attributed ``engine="a"`` on ``GET /fleet/metrics``.
+
+Plus the ManualClock decision-table units the tentpole pins:
+per-engine hysteresis independence (A's cooldown never delays B),
+budget-contention arbitration (hot-vs-hot is a deny, not a
+tug-of-war), preemption orders drain-before-grow, crash-looped
+replicas count as neither capacity nor budget, burst credits accrue
+only from under-quota refill and spend only with fleet headroom, and
+the ``PIO_FLEET_ENGINE_*`` policy-precedence contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api.router_server import RouterServer
+from predictionio_tpu.fleet.controller import (
+    CapacityArbiter,
+    EngineScaleSet,
+    ScalePolicy,
+    ScaleSignals,
+    SupervisedFleetActuator,
+    controller_collector,
+    engine_scale_policy,
+    scale_set_collector,
+)
+from predictionio_tpu.fleet.gateway import EngineQuota, EngineSpec
+from predictionio_tpu.fleet.router import RouterConfig
+from predictionio_tpu.fleet.supervisor import (
+    CRASH_LOOPED,
+    FleetSupervisor,
+    SupervisorConfig,
+)
+from predictionio_tpu.obs.exporter import render_metrics
+from predictionio_tpu.obs.registry import Metric
+from predictionio_tpu.utils.resilience import ManualClock
+
+from tests.netutil import free_port, wait_until
+from tests.test_fleet_router import get_json, post_engine_query
+from tests.test_fleet_supervisor import direct_post, replica_spec
+from tests.test_observability import parse_prometheus
+
+pytestmark = pytest.mark.elasticity
+
+
+# ---------------------------------------------------------------------------
+# deterministic doubles: a fleet-shaped service the sweep can scrape
+# ---------------------------------------------------------------------------
+
+class SimpleActuator:
+    """Counting actuator; shared ``events`` list records actuation
+    ORDER across tenants (the preemption drain-before-grow pin)."""
+
+    def __init__(self, current: int = 0, name: str = "",
+                 events: list | None = None):
+        self.n = current
+        self.name = name
+        self.events = events if events is not None else []
+
+    def current(self) -> int:
+        return self.n
+
+    def add_replica(self) -> bool:
+        self.n += 1
+        self.events.append(f"add:{self.name}")
+        return True
+
+    def remove_replica(self, reason=None) -> bool:
+        if self.n <= 0:
+            return False
+        self.n -= 1
+        self.events.append(f"remove:{self.name}:{reason}")
+        return True
+
+
+class FakeSLO:
+    def __init__(self):
+        self.burns: dict[str, float] = {}
+
+    def max_burns(self) -> dict[str, float]:
+        return dict(self.burns)
+
+
+class FakeGroup:
+    def __init__(self):
+        self.slo = FakeSLO()
+
+
+class FakeGateway:
+    def __init__(self, names, labeled: bool = True):
+        self._groups = {n: FakeGroup() for n in names}
+        self.labeled = labeled
+
+    def get(self, name):
+        return self._groups.get(name)
+
+
+class FakeService:
+    """What EngineScaleSet.sweep_signals consumes: one merged metric
+    fan-out (here: just the pressure gauge) + the gateway's SLO view.
+    ``pressures`` maps engine name -> value; the ``None`` key renders
+    an UNLABELED sample (the lone implicit default engine)."""
+
+    def __init__(self, names, labeled: bool = True):
+        self.gateway = FakeGateway(names, labeled=labeled)
+        self.pressures: dict[str | None, float] = {}
+
+    def fleet_metrics_families(self):
+        samples = [
+            ({} if name is None else {"engine": name}, value)
+            for name, value in self.pressures.items()
+        ]
+        return [Metric(name="pio_fleet_pressure", kind="gauge",
+                       help="fixture", samples=samples)]
+
+
+def make_set(names, budget=0, labeled=True):
+    clock = ManualClock()
+    service = FakeService(names, labeled=labeled)
+    scale_set = EngineScaleSet(
+        service, CapacityArbiter(budget, clock=clock), clock=clock)
+    return clock, service, scale_set
+
+
+def policy(**overrides) -> ScalePolicy:
+    defaults = dict(min_replicas=1, max_replicas=4, pressure_up=0.5,
+                    burn_up=14.4, pressure_down=0.1, up_sustain_s=10.0,
+                    down_sustain_s=1000.0, cooldown_s=0.0,
+                    interval_s=1.0)
+    defaults.update(overrides)
+    return ScalePolicy(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# per-engine hysteresis independence
+# ---------------------------------------------------------------------------
+
+class TestPerEngineHysteresis:
+    def test_one_tenants_cooldown_never_delays_the_other(self):
+        """A scales, enters its long cooldown, and B still scales on
+        its OWN sustain window — then A's next verdict is held by A's
+        cooldown while B keeps acting."""
+        clock, service, ss = make_set(["a", "b"])
+        a_act, b_act = SimpleActuator(1, "a"), SimpleActuator(1, "b")
+        ss.add_engine("a", policy(cooldown_s=100.0), a_act)
+        ss.add_engine("b", policy(cooldown_s=0.0), b_act)
+
+        service.pressures = {"a": 0.9, "b": 0.2}
+        ss.tick_all()                       # t=0: a hot-since-now, b calm
+        service.pressures["b"] = 0.9
+        clock.advance(10.0)
+        ss.tick_all()                       # t=10: a sustained -> up
+        assert (a_act.n, b_act.n) == (2, 1)
+        clock.advance(10.0)
+        ss.tick_all()                       # t=20: b sustained -> up,
+        assert (a_act.n, b_act.n) == (2, 2)  # DURING a's cooldown
+        clock.advance(10.0)
+        ss.tick_all()                       # t=30: a sustained again but
+        clock.advance(10.0)                 # cooldown-held; b re-arming
+        ss.tick_all()                       # t=40: b up again, a held
+        assert (a_act.n, b_act.n) == (2, 3)
+
+        a_snap = ss.get("a").snapshot()
+        b_snap = ss.get("b").snapshot()
+        assert a_snap["decisions"]["up"] == 1
+        assert a_snap["decisions"]["cooldown_hold"] >= 1
+        assert a_snap["decisionReasons"]["cooldown_hold"]["cooldown"] >= 1
+        assert b_snap["decisions"]["up"] == 2
+        assert b_snap["decisions"]["cooldown_hold"] == 0
+
+
+# ---------------------------------------------------------------------------
+# arbitration: priority, budget contention, preemption
+# ---------------------------------------------------------------------------
+
+class TestArbiterPriority:
+    def test_burn_beats_pressure_beats_seniority(self):
+        clock = ManualClock(100.0)
+        arbiter = CapacityArbiter(budget=10, clock=clock)
+        arbiter.register("burning", policy(), SimpleActuator(1))
+        arbiter.register("queued", policy(), SimpleActuator(1),
+                         last_action=lambda: None)
+        arbiter.register("acted", policy(), SimpleActuator(1),
+                         last_action=lambda: 95.0)
+        arbiter.observe("burning",
+                        ScaleSignals(pressure=0.1, fast_burn=20.0))
+        arbiter.observe("queued", ScaleSignals(pressure=0.9))
+        arbiter.observe("acted", ScaleSignals(pressure=0.9))
+        # fast burn outranks pressure; equal burn+pressure falls to
+        # cooldown seniority (never-acted = infinitely senior)
+        assert arbiter.priority("burning") > arbiter.priority("queued")
+        assert arbiter.priority("queued") > arbiter.priority("acted")
+
+    def test_tick_order_is_descending_priority(self):
+        clock, service, ss = make_set(["cold", "hot"])
+        ss.add_engine("cold", policy(), SimpleActuator(1, "cold"))
+        ss.add_engine("hot", policy(), SimpleActuator(1, "hot"))
+        service.pressures = {"cold": 0.2, "hot": 0.9}
+        assert ss.tick_all() == ["hot", "cold"]
+
+
+class TestBudgetContention:
+    def test_hot_vs_hot_is_a_deny_not_a_tug_of_war(self):
+        """Budget spent, both tenants hot: neither may preempt the
+        other — both verdicts land as actuation_failed with the
+        arbiter's budget_exhausted attribution, and NO replica moves."""
+        clock, service, ss = make_set(["a", "b"], budget=2)
+        a_act, b_act = SimpleActuator(1, "a"), SimpleActuator(1, "b")
+        ss.add_engine("a", policy(up_sustain_s=0.0), a_act)
+        ss.add_engine("b", policy(up_sustain_s=0.0), b_act)
+        service.pressures = {"a": 0.9, "b": 0.9}
+        ss.tick_all()
+        assert (a_act.n, b_act.n) == (1, 1)
+        for name in ("a", "b"):
+            snap = ss.get(name).snapshot()
+            assert snap["decisions"]["up"] == 1
+            assert snap["decisionReasons"]["actuation_failed"][
+                "budget_exhausted"] == 1
+            assert snap["lastDecision"] == "actuation_failed"
+        assert ss.arbiter.snapshot()["denials"] == {"a": 1, "b": 1}
+        assert ss.arbiter.snapshot()["preemptions"] == {}
+
+    def test_last_slot_goes_to_the_higher_priority_tenant(self):
+        clock, service, ss = make_set(["a", "b"], budget=3)
+        a_act, b_act = SimpleActuator(1, "a"), SimpleActuator(1, "b")
+        ss.add_engine("a", policy(up_sustain_s=0.0), a_act)
+        ss.add_engine("b", policy(up_sustain_s=0.0), b_act)
+        service.pressures = {"a": 0.6, "b": 0.9}
+        assert ss.tick_all() == ["b", "a"]   # hotter tenant asks first
+        assert (a_act.n, b_act.n) == (1, 2)
+        assert ss.arbiter.snapshot()["grants"] == {"b": 1}
+        assert ss.get("a").snapshot()["decisionReasons"][
+            "actuation_failed"]["budget_exhausted"] == 1
+
+
+class TestPreemption:
+    def test_idle_tenant_is_drained_before_the_hot_one_grows(self):
+        """The victim's above-min replica retires through the
+        drain-then-retire actuator path BEFORE the requester's spawn —
+        and the victim is chosen only while genuinely idle."""
+        events: list[str] = []
+        clock, service, ss = make_set(["idle", "hot"], budget=3)
+        idle_act = SimpleActuator(2, "idle", events)
+        hot_act = SimpleActuator(1, "hot", events)
+        ss.add_engine("idle", policy(), idle_act)
+        ss.add_engine("hot", policy(up_sustain_s=0.0), hot_act)
+        service.pressures = {"idle": 0.3, "hot": 0.9}
+        ss.tick_all()
+        assert events == ["remove:idle:preempted_by_hot", "add:hot"]
+        assert (idle_act.n, hot_act.n) == (1, 2)
+        assert ss.arbiter.used() == 3        # budget conserved
+        snap = ss.arbiter.snapshot()
+        assert snap["preemptions"] == {"idle": 1}
+        assert snap["grants"] == {"hot": 1}
+        # the requester's verdict is a clean up, not a failure
+        assert ss.get("hot").snapshot()["lastDecision"] == "up"
+
+    def test_victim_is_never_taken_below_its_own_min(self):
+        events: list[str] = []
+        clock, service, ss = make_set(["idle", "hot"], budget=2)
+        idle_act = SimpleActuator(1, "idle", events)   # at min already
+        hot_act = SimpleActuator(1, "hot", events)
+        ss.add_engine("idle", policy(), idle_act)
+        ss.add_engine("hot", policy(up_sustain_s=0.0), hot_act)
+        service.pressures = {"idle": 0.0, "hot": 0.9}
+        ss.tick_all()
+        assert events == []                  # no preemption possible
+        assert ss.arbiter.snapshot()["denials"] == {"hot": 1}
+
+
+class TestCrashLoopExclusion:
+    class _LatchedSupervisor:
+        """Supervisor-shaped double: one running child, one latched."""
+
+        def children(self):
+            return [
+                {"id": "replica:8001", "state": "running",
+                 "address": "127.0.0.1:8001"},
+                {"id": "replica:8002", "state": CRASH_LOOPED,
+                 "address": "127.0.0.1:8002"},
+            ]
+
+    def _actuator(self) -> SupervisedFleetActuator:
+        actuator = SupervisedFleetActuator(
+            self._LatchedSupervisor(), membership=None,
+            make_spec=lambda i: None)
+        actuator.adopt("replica:8001")
+        actuator.adopt("replica:8002")
+        return actuator
+
+    def test_latched_replica_is_not_capacity(self):
+        assert self._actuator().current() == 1
+
+    def test_latched_replica_frees_its_budget_slot(self):
+        """With the latched child counted, used() would be 2 == budget
+        and the sibling's scale-up would be denied — it must not be."""
+        arbiter = CapacityArbiter(budget=2)
+        arbiter.register("latched", policy(), self._actuator())
+        arbiter.register("healthy", policy(), SimpleActuator(0))
+        assert arbiter.used() == 1
+        assert arbiter.request_up("healthy") == (True, "within_budget")
+
+    def test_scale_up_refused_while_latched(self):
+        """The broken SPEC must not be respawned by the controller —
+        and the arbiter's grant does not override the actuator's own
+        crash-loop refusal."""
+        actuator = self._actuator()
+        assert actuator.add_replica() is False
+        from predictionio_tpu.fleet.controller import ArbitratedActuator
+        wrapped = ArbitratedActuator(
+            "latched", actuator, CapacityArbiter(budget=0))
+        assert wrapped.add_replica() is False
+        assert wrapped.last_refusal == "actuator_refused"
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair burst credits at the gateway
+# ---------------------------------------------------------------------------
+
+class TestBurstCredits:
+    def test_credits_accrue_from_under_quota_refill_capped(self):
+        clock = ManualClock()
+        quota = EngineQuota(qps=1.0, burst=2.0, burst_credits=3.0,
+                            clock=clock)
+        clock.advance(10.0)                 # 10 tokens vs a 2-cap bucket
+        assert quota.try_admit() is None    # overflow banked, not lost
+        snap = quota.snapshot()
+        assert snap["credits"] == 3.0       # capped at burst_credits
+        assert snap["burstCredits"] == 3.0
+        assert snap["creditSpends"] == 0
+
+    def test_credits_spend_only_with_fleet_headroom(self):
+        clock = ManualClock()
+        quota = EngineQuota(qps=1.0, burst=2.0, burst_credits=3.0,
+                            clock=clock)
+        clock.advance(10.0)
+        assert quota.try_admit() is None    # token (banks 3 credits)
+        assert quota.try_admit() is None    # token (bucket now dry)
+        # dry bucket, busy fleet: throttled with a Retry-After hint
+        hint = quota.try_admit(fleet_idle=False)
+        assert hint is not None and hint > 0
+        # dry bucket, idle fleet: the reservoir carries the burst
+        for spent in (1, 2, 3):
+            assert quota.try_admit(fleet_idle=True) is None
+            assert quota.snapshot()["creditSpends"] == spent
+        assert quota.snapshot()["credits"] == 0.0
+        # reservoir dry too: headroom no longer buys admission
+        assert quota.try_admit(fleet_idle=True) is not None
+
+    def test_no_reservoir_configured_means_no_borrowing(self):
+        clock = ManualClock()
+        quota = EngineQuota(qps=1.0, burst=2.0, clock=clock)
+        clock.advance(10.0)
+        assert quota.try_admit() is None
+        assert quota.try_admit() is None
+        assert quota.try_admit(fleet_idle=True) is not None
+        assert quota.snapshot()["credits"] is None
+
+    def test_spec_round_trips_credits_and_bounds(self):
+        spec = EngineSpec(name="rec", backends=("h:1",), quota_qps=10.0,
+                          burst_credits=50.0, min_replicas=1,
+                          max_replicas=4)
+        assert EngineSpec.from_doc(spec.to_doc()) == spec
+
+
+# ---------------------------------------------------------------------------
+# per-engine policy precedence
+# ---------------------------------------------------------------------------
+
+class TestEnginePolicyPrecedence:
+    def test_flag_beats_env_beats_base_beats_default(self, monkeypatch):
+        monkeypatch.setenv("PIO_FLEET_ENGINE_REC_V2_MIN_REPLICAS", "4")
+        resolved = engine_scale_policy(
+            "rec-v2", base={"min_replicas": 2, "max_replicas": 9})
+        assert resolved.min_replicas == 4    # env beats base
+        assert resolved.max_replicas == 9    # base beats default
+        assert resolved.cooldown_s == ScalePolicy().cooldown_s
+        explicit = engine_scale_policy(
+            "rec-v2", base={"min_replicas": 2}, min_replicas=7)
+        assert explicit.min_replicas == 7    # flag beats env
+
+    def test_unparseable_env_falls_through_to_base(self, monkeypatch):
+        monkeypatch.setenv("PIO_FLEET_ENGINE_ECOM_MAX_REPLICAS", "lots")
+        resolved = engine_scale_policy("ecom", base={"max_replicas": 6})
+        assert resolved.max_replicas == 6
+
+    def test_dry_run_passes_through(self):
+        assert engine_scale_policy("ecom", dry_run=True).dry_run is True
+
+
+# ---------------------------------------------------------------------------
+# exposition: lone-default delegation + labeled attribution
+# ---------------------------------------------------------------------------
+
+class TestScaleSetExposition:
+    def test_lone_default_engine_renders_byte_identical(self):
+        """The PR 15 convention: an implicit single-engine deployment
+        must expose EXACTLY the unlabeled single-controller families."""
+        clock, service, ss = make_set(["default"], labeled=False)
+        controller = ss.add_engine(
+            "default", policy(up_sustain_s=0.0, dry_run=True),
+            SimpleActuator(1))
+        service.pressures = {None: 0.9}      # the unlabeled sample
+        ss.tick_all()
+        text = render_metrics(scale_set_collector(ss)())
+        assert text == render_metrics(controller_collector(controller)())
+        assert 'engine="' not in text
+        assert 'pio_fleet_scale_decisions_total{decision="up"} 1' in text
+
+    def test_multi_engine_families_carry_engine_and_reason(self):
+        clock, service, ss = make_set(["a", "b"], budget=2)
+        ss.add_engine("a", policy(up_sustain_s=0.0), SimpleActuator(1))
+        ss.add_engine("b", policy(), SimpleActuator(1))
+        service.pressures = {"a": 0.9, "b": 0.9}   # b idle? no: hot but
+        ss.tick_all()                              # unsustained -> hold
+        families = parse_prometheus(
+            render_metrics(scale_set_collector(ss)()))
+        samples = families["pio_fleet_scale_decisions_total"]["samples"]
+        assert samples[("pio_fleet_scale_decisions_total",
+                        (("decision", "up"), ("engine", "a"),
+                         ("reason", "pressure")))] == 1.0
+        assert samples[("pio_fleet_scale_decisions_total",
+                        (("decision", "actuation_failed"),
+                         ("engine", "a"),
+                         ("reason", "budget_exhausted")))] == 1.0
+        gauges = families["pio_fleet_desired_replicas"]["samples"]
+        assert gauges[("pio_fleet_desired_replicas",
+                       (("engine", "a"),))] == 2.0
+        assert families["pio_fleet_replica_budget"]["samples"][
+            ("pio_fleet_replica_budget", ())] == 2.0
+        assert families["pio_fleet_replica_budget_used"]["samples"][
+            ("pio_fleet_replica_budget_used", ())] == 2.0
+        assert families["pio_fleet_budget_denials_total"]["samples"][
+            ("pio_fleet_budget_denials_total",
+             (("engine", "a"),))] == 1.0
+
+    def test_failed_sweep_holds_every_tenant_as_error(self):
+        clock, service, ss = make_set(["a"])
+        ss.add_engine("a", policy(), SimpleActuator(1))
+
+        def boom():
+            raise OSError("scrape down")
+
+        service.fleet_metrics_families = boom
+        ss.tick_all()
+        snap = ss.get("a").snapshot()
+        assert snap["decisionReasons"]["error"][
+            "signals_unreadable"] == 1
+
+
+# ---------------------------------------------------------------------------
+# THE multi-tenant chaos acceptance
+# ---------------------------------------------------------------------------
+
+class TestElasticityChaosE2E:
+    def test_abusive_tenant_bounded_compliant_tenant_untouched(self):
+        pa1, pa2, pb1 = free_port(), free_port(), free_port()
+        spare_ports = iter([pa2])
+
+        sup = FleetSupervisor(
+            [replica_spec(pa1, "a1"), replica_spec(pb1, "b1")],
+            SupervisorConfig(
+                poll_interval_s=0.1, probe_timeout_s=1.0,
+                unhealthy_after=0, backoff_base_s=0.2, backoff_max_s=1.0,
+                crash_loop_threshold=5, crash_loop_window_s=30.0,
+                drain_timeout_s=2.0, drain_settle_s=0.1,
+                term_grace_s=3.0))
+        router = RouterServer(RouterConfig(
+            ip="127.0.0.1", port=0,
+            engines=(
+                # near-zero refill (the TestMultiEngineRouting
+                # rationale): the abusive spin must stay throttled for
+                # the whole load window even on a slow 1-core host
+                EngineSpec(name="a", backends=(f"127.0.0.1:{pa1}",),
+                           quota_qps=0.05, quota_burst=2.0),
+                EngineSpec(name="b", backends=(f"127.0.0.1:{pb1}",)),
+            ),
+            default_engine="b", probe_interval_s=0.25, up_after=1))
+
+        clock = ManualClock()
+        scale_set = EngineScaleSet(
+            router.service, CapacityArbiter(budget=3, clock=clock),
+            clock=clock)
+        sup.start()
+        router.start()
+        try:
+            actuator_a = SupervisedFleetActuator(
+                sup, router.gateway.get("a").router.membership,
+                lambda i: replica_spec(next(spare_ports),
+                                       f"a{i + 1}"))
+            actuator_a.adopt(f"replica:{pa1}")
+            actuator_b = SupervisedFleetActuator(
+                sup, router.gateway.get("b").router.membership,
+                lambda i: replica_spec(free_port(), "never"))
+            actuator_b.adopt(f"replica:{pb1}")
+            scale_set.add_engine(
+                "a", policy(min_replicas=2, max_replicas=2), actuator_a)
+            scale_set.add_engine(
+                "b", policy(min_replicas=1, max_replicas=1), actuator_b)
+            router.service.attach_scale_set(scale_set)
+
+            def fleet_settled():
+                for port in (pa1, pb1):
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/healthz",
+                            timeout=2) as r:
+                        if r.status != 200:
+                            return False
+                return True
+            wait_until(fleet_settled, message="initial replicas up")
+
+            # tick 1: A is one replica below ITS min bound -> the
+            # controller scales it up through the arbiter (3-budget
+            # fleet, 2 used -> within_budget); B holds at its max=min=1
+            scale_set.tick_all()
+            assert actuator_a.current() == 2
+            assert actuator_b.current() == 1
+            assert scale_set.get("a").snapshot()["lastDecision"] == "up"
+            assert scale_set.get("b").snapshot()["lastDecision"] == "hold"
+            assert scale_set.arbiter.snapshot()["grants"] == {"a": 1}
+
+            # the scaled-up replica serves and the probe loop marks it
+            # up in A's membership (checked directly: A's quota is
+            # deliberately tiny, so routed probes would spend it)
+            def scaled_replica_routable():
+                if direct_post(pa2, {"ping": 0})["tag"] != "a2":
+                    return False
+                membership = router.gateway.get("a").router.membership
+                return any(b.id == f"127.0.0.1:{pa2}"
+                           and b.state == "up"
+                           for b in membership.backends)
+            wait_until(scaled_replica_routable,
+                       message="scaled-up replica serving")
+
+            # live load: A spins far past its quota, B stays compliant
+            statuses_a: list[int] = []
+            statuses_b: list[int] = []
+            lock = threading.Lock()
+            stop_load = threading.Event()
+
+            def abusive_client():
+                i = 0
+                while not stop_load.is_set():
+                    try:
+                        status, _, _ = post_engine_query(
+                            router.port, "a", {"i": i}, timeout=10)
+                        with lock:
+                            statuses_a.append(status)
+                    except OSError:
+                        pass                 # A's own replicas die below
+                    i += 1
+
+            def compliant_client():
+                i = 0
+                while not stop_load.is_set():
+                    status, _, _ = post_engine_query(
+                        router.port, "b", {"i": i}, timeout=10)
+                    with lock:
+                        statuses_b.append(status)
+                    i += 1
+                    time.sleep(0.02)
+
+            threads = [threading.Thread(target=abusive_client),
+                       threading.Thread(target=compliant_client)]
+            for t in threads:
+                t.start()
+
+            time.sleep(0.5)                  # load flowing, A ramped
+            pid_a1 = sup.child_pid(f"replica:{pa1}")
+            pid_a2 = sup.child_pid(f"replica:{pa2}")
+            pid_b = sup.child_pid(f"replica:{pb1}")
+            os.kill(pid_a1, signal.SIGKILL)  # kill A's fleet mid-ramp
+            os.kill(pid_a2, signal.SIGKILL)
+            time.sleep(1.0)                  # load over the corpses
+            stop_load.set()
+            for t in threads:
+                t.join(timeout=20)
+
+            # compliant tenant B: zero 5xx, burn under 1.0 throughout
+            assert len(statuses_b) > 10
+            assert [s for s in statuses_b if s >= 500] == []
+            burns = router.gateway.get("b").slo.max_burns()
+            assert all(rate < 1.0 for rate in burns.values()), burns
+            # abusive tenant A: throttled against its OWN budget
+            assert statuses_a.count(429) >= 8
+
+            # the supervisor restores A within A's bounds, and B's
+            # replica never moved
+            wait_until(lambda: sup.child_pid(f"replica:{pa1}")
+                       not in (None, pid_a1),
+                       message="A replica 1 respawned")
+            wait_until(lambda: sup.child_pid(f"replica:{pa2}")
+                       not in (None, pid_a2),
+                       message="A replica 2 respawned")
+            wait_until(lambda: direct_post(pa1, {"ping": 1})["tag"]
+                       == "a1", message="restored A serving")
+            assert sup.child_pid(f"replica:{pb1}") == pid_b
+            assert actuator_b.current() == 1
+            assert actuator_a.current() == 2     # within A's min/max
+            assert not sup.crash_looped()
+
+            # every decision is attributed engine="a" on the merged
+            # fleet scrape, and the budget families are exported
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{router.port}/fleet/metrics",
+                    timeout=10) as r:
+                families = parse_prometheus(r.read().decode())
+            decisions = families[
+                "pio_fleet_scale_decisions_total"]["samples"]
+            assert decisions[("pio_fleet_scale_decisions_total",
+                              (("decision", "up"), ("engine", "a"),
+                               ("reason", "pressure")))] >= 1.0
+            assert all("engine" in dict(labels)
+                       for _, labels in decisions)
+            assert families["pio_fleet_desired_replicas"]["samples"][
+                ("pio_fleet_desired_replicas",
+                 (("engine", "a"),))] == 2.0
+            assert families["pio_fleet_replica_budget"]["samples"][
+                ("pio_fleet_replica_budget", ())] == 3.0
+
+            # the pio status --router source: per-engine bounds + the
+            # last decision, storage-free off the live table
+            status, doc = get_json(router.port, "/fleet/engines")
+            assert status == 200
+            scale_a = next(e for e in doc["engines"]
+                           if e["name"] == "a")["scale"]
+            assert (scale_a["minReplicas"], scale_a["maxReplicas"]) \
+                == (2, 2)
+            assert scale_a["lastDecision"] == "up"
+            assert scale_a["actualReplicas"] == 2
+            _, fleet = get_json(router.port, "/fleet")
+            assert fleet["elasticity"]["budget"] == 3
+        finally:
+            scale_set.stop()
+            sup.shutdown()
+            router.stop()
