@@ -254,6 +254,21 @@ def default_config() -> LintConfig:
                 },
             ),
             "lock-discipline": RuleConfig(paths=("",)),
+            # -- project-phase rules (PR 17): whole-tree scope; the
+            # ProjectModel is built from every module in the run, so
+            # narrowing `paths` only narrows where findings ANCHOR,
+            # not what the analysis sees
+            "shared-state-race": RuleConfig(paths=("",)),
+            "lock-order": RuleConfig(paths=("",)),
+            "jit-recompile-risk": RuleConfig(
+                paths=("",),
+                options={
+                    # width-menu snappers (ops/topk.py): a static arg
+                    # routed through one of these is pinned to the
+                    # BATCH_WIDTHS/_K_WIDTHS menus and cannot drift
+                    "snap_calls": ["serving_k", "serving_batch"],
+                },
+            ),
         },
         exclude=("__pycache__/",),
     )

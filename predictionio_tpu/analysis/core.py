@@ -71,9 +71,18 @@ class ModuleInfo:
         #: path scoping (untimed-blocking-io's call_paths) match on it.
         self.relpath = relpath
         self.lines = source.splitlines()
-        self.suppressions = parse_suppressions(source)
+        self._suppressions: tuple[Suppression, ...] | None = None
         self._parents: dict[ast.AST, ast.AST] | None = None
         self._stmt_ends: dict[int, int] | None = None
+
+    @property
+    def suppressions(self) -> tuple["Suppression", ...]:
+        """Parsed lint-ignore comments, tokenized lazily: a warm cached
+        run only pays the tokenize cost for modules that actually have
+        project-pass findings to filter."""
+        if self._suppressions is None:
+            self._suppressions = parse_suppressions(self.source)
+        return self._suppressions
 
     @property
     def parents(self) -> dict[ast.AST, ast.AST]:
@@ -256,6 +265,28 @@ class Rule:
                 yield from visit(child, stack)
 
         yield from visit(tree, ())
+
+
+class ProjectRule(Rule):
+    """A rule with a whole-program pass.
+
+    The runner builds one :class:`analysis.project.ProjectModel` from
+    every parsed module in the run and hands it to
+    :meth:`check_project` AFTER the per-module phase. Findings must
+    carry the package-relative ``path`` of the module they anchor to —
+    the runner applies that module's suppressions and the rule's path
+    scope to them exactly as it does for per-module findings.
+
+    ``check`` defaults to no per-module findings so a ProjectRule can
+    be purely global; hybrids may implement both.
+    """
+
+    def check(self, module: ModuleInfo, options: dict[str, Any]) -> list[Finding]:
+        return []
+
+    def check_project(self, project: "Any",
+                      options: dict[str, Any]) -> list[Finding]:
+        raise NotImplementedError
 
 
 _REGISTRY: dict[str, Rule] = {}

@@ -9,3 +9,6 @@ import predictionio_tpu.analysis.rules.host_sync  # noqa: F401
 import predictionio_tpu.analysis.rules.dtype  # noqa: F401
 import predictionio_tpu.analysis.rules.blocking_io  # noqa: F401
 import predictionio_tpu.analysis.rules.locks  # noqa: F401
+import predictionio_tpu.analysis.rules.shared_state_race  # noqa: F401
+import predictionio_tpu.analysis.rules.lock_order  # noqa: F401
+import predictionio_tpu.analysis.rules.jit_recompile  # noqa: F401

@@ -391,9 +391,11 @@ class EngineGroup:
         """Re-weight in place: swap the quota object (readers grab the
         attribute once; in-flight slots held on the OLD bucket release
         against it harmlessly) and remember the new spec."""
+        # pio: lint-ignore[shared-state-race]: lock-free reference swap — readers grab self.spec/self.quota once per request (GIL-atomic); stale reads for one request are the documented re-weight semantics
         self.spec = dataclasses.replace(
             spec, backends=self.spec.backends,
             canary_backends=self.spec.canary_backends)
+        # pio: lint-ignore[shared-state-race]: same swap discipline — in-flight slots release against the old bucket harmlessly (docstring)
         self.quota = self._build_quota(spec)
 
     def start(self) -> None:
@@ -547,9 +549,12 @@ class EngineGateway:
         """Caller holds ``_lock``. Publish a new table atomically:
         groups first, then the route dict compiled FROM it — a reader
         that wins a route hit always finds the group."""
+        # pio: lint-ignore[shared-state-race]: writers serialize on _lock; readers deliberately take none — dict references are swapped whole (GIL-atomic) in groups-then-routes order so a route hit always finds its group
         self._groups = groups
         if default is not None:
+            # pio: lint-ignore[shared-state-race]: same publish discipline — a reader sees either the old or the new default, both valid tables
             self.default_engine = default
+        # pio: lint-ignore[shared-state-race]: same publish discipline — routes compiled FROM the already-published groups
         self._routes = self._compile_routes(groups, self.default_engine)
 
     def register(self, spec: EngineSpec) -> EngineGroup:

@@ -263,6 +263,11 @@ class FleetMembership:
         self.starvation_grace_s = starvation_grace_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # guards the start/stop lifecycle (NOT the probe cycle):
+        # start() is reachable from the router's admin-sync thread via
+        # gateway registration, so the check-then-spawn must not race a
+        # concurrent start()/stop()
+        self._lifecycle = threading.Lock()
 
     # -- views --------------------------------------------------------------
     @property
@@ -382,17 +387,22 @@ class FleetMembership:
             self._stop.wait(self.probe_interval_s)
 
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="pio-fleet-probe", daemon=True)
-        self._thread.start()
+        with self._lifecycle:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="pio-fleet-probe", daemon=True)
+            self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        with self._lifecycle:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            # join OUTSIDE the lifecycle lock: a probe pass can run up
+            # to the probe timeout, and holding the lock here would
+            # stall a concurrent start() for that long
+            thread.join(timeout=5)
         for backend in self.backends:
             backend.close()

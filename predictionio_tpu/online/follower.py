@@ -230,5 +230,6 @@ class EventTailFollower:
         applied (at-least-once contract in the class docstring)."""
         if cursor is None:
             return
+        # pio: lint-ignore[shared-state-race]: cursor is an immutable TailCursor swapped by reference on the fold thread; the status-doc read tolerates staleness
         self.cursor = cursor
         self.store.save(cursor)

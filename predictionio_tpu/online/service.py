@@ -351,8 +351,16 @@ class OnlineFoldIn:
         the fresh model objects, and queue every previously-folded user
         for a refold against the new base — their post-training events
         may postdate the new model's training read too."""
-        self._pending_refold |= set(self.overlay.touched_users())
+        # under _lock: the fold thread swaps this set out concurrently
+        # (_fold_once), and |= is a read-modify-write — an unlocked
+        # interleave would silently drop queued refold users
+        with self._lock:
+            self._pending_refold |= set(self.overlay.touched_users())
         self.overlay.advance_generation(generation)
+        # racy clears are deliberate: both caches key on the generation
+        # captured at cycle start, so a fold cycle that repopulates them
+        # after this clear self-heals on its next gen check; the tuple
+        # swap itself is atomic under the GIL
         self._gram = None
         self._prior = None
         self._rebind()
@@ -420,7 +428,8 @@ class OnlineFoldIn:
         t0 = time.perf_counter()
         rows, new_cursor = self._follower.poll_once()
         t_tail = time.perf_counter()
-        refold, self._pending_refold = self._pending_refold, set()
+        with self._lock:
+            refold, self._pending_refold = self._pending_refold, set()
         if not rows and not refold:
             return 0
         try:
@@ -433,8 +442,10 @@ class OnlineFoldIn:
             # tailed rows replay — but the refold queue was already
             # swapped out and its users' events are BEHIND the cursor;
             # restore it or a single failed cycle silently drops the
-            # refold-after-reload guarantee
-            self._pending_refold |= refold
+            # refold-after-reload guarantee (under _lock: a /reload's
+            # own |= may interleave with this restore)
+            with self._lock:
+                self._pending_refold |= refold
             raise
 
     def _solve_and_publish(self, binding: OnlineBinding, generation: int,
@@ -481,7 +492,8 @@ class OnlineFoldIn:
             # do NOT advance the cursor — the next cycle re-reads these
             # events and re-solves against the NEW model (fold-in is a
             # recomputation, so the replay is exact, not additive)
-            self._pending_refold |= set(deltas)
+            with self._lock:
+                self._pending_refold |= set(deltas)
         else:
             self._follower.commit(new_cursor)
         now = time.time()
@@ -521,6 +533,7 @@ class OnlineFoldIn:
             # background fold thread
             # pio: lint-ignore[host-sync-in-hot-path]: fold-in runs on the background tail thread, never under a request
             table = np.asarray(model.item_factors)
+            # pio: lint-ignore[shared-state-race]: gen-keyed cache — a racy clear from on_model_swapped is healed by the gen check above; the tuple swap is atomic under the GIL
             self._prior = (gen, popularity_prior(table))
         return self._prior[1]
 
@@ -528,6 +541,7 @@ class OnlineFoldIn:
         # same captured-generation keying as _item_prior
         if self._gram is None or self._gram[0] != gen:
             # pio: lint-ignore[host-sync-in-hot-path]: per-generation constant, computed off the request path
+            # pio: lint-ignore[shared-state-race]: gen-keyed cache — a racy clear from on_model_swapped is healed by the gen check above; the tuple swap is atomic under the GIL
             self._gram = (gen, item_gramian(np.asarray(factors)))
         return self._gram[1]
 

@@ -282,12 +282,20 @@ class CircuitBreaker:
         self._successes = 0
         self._opened_at = 0.0
         self._probing = False
-        self.opens = 0  # lifetime count of CLOSED/HALF_OPEN -> OPEN
+        self._opens = 0  # lifetime count of CLOSED/HALF_OPEN -> OPEN
 
     @property
     def state(self) -> str:
         with self._lock:
             return self._peek_state()
+
+    @property
+    def opens(self) -> int:
+        """Lifetime CLOSED/HALF_OPEN -> OPEN count, read under the
+        breaker lock like ``state`` (trips happen on request threads;
+        status readers live elsewhere)."""
+        with self._lock:
+            return self._opens
 
     def _peek_state(self) -> str:
         if self._state == OPEN:
@@ -344,7 +352,7 @@ class CircuitBreaker:
         self._opened_at = self._clock.monotonic()
         self._failures = 0
         self._probing = False
-        self.opens += 1
+        self._opens += 1
         logger.warning("circuit breaker %s opened (retry in %.1fs)",
                        self.name, self.reset_timeout)
 

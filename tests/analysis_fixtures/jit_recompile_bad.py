@@ -1,0 +1,28 @@
+"""jit-recompile-risk bad twin: static args derived from per-request
+values (arithmetic on a query field, ``len()`` of a request list) and a
+shape-varying inline array built at the call site — each distinct
+value/length compiles a fresh executable.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k",))
+def top_scores(scores, k):
+    return jax.lax.top_k(scores, k)[0]
+
+
+@partial(jax.jit, static_argnums=(1,))
+def pad_rows(rows, width):
+    return jnp.pad(rows, (0, width - rows.shape[0]))
+
+
+def serve(query_num, items, scores):
+    k = query_num * 2  # per-request arithmetic feeding a static arg
+    top = top_scores(scores, k=k)
+    padded = pad_rows(scores, len(items))  # len() of a request list
+    ragged = top_scores(jnp.asarray([s for s in items]), k=4)
+    return top, padded, ragged

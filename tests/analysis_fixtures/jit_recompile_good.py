@@ -1,0 +1,38 @@
+"""jit-recompile-risk good twin: every static arg is drawn from a
+bounded menu — a literal, a module constant, a snap-to-menu call
+(``snap_calls`` option), a ``.shape``-derived value (adds no variation
+beyond the array's own recompiles), or the pad-to-multiple idiom.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+TOPK_WIDTHS = (8, 16, 32)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def top_scores(scores, k):
+    return jax.lax.top_k(scores, k)[0]
+
+
+@partial(jax.jit, static_argnums=(1,))
+def pad_rows(rows, width):
+    return jnp.pad(rows, (0, width - rows.shape[0]))
+
+
+def snap_width(n):
+    for w in TOPK_WIDTHS:
+        if n <= w:
+            return w
+    return TOPK_WIDTHS[-1]
+
+
+def serve(query_num, scores):
+    literal = top_scores(scores, k=16)
+    snapped = top_scores(scores, k=snap_width(query_num))
+    widest = top_scores(scores, k=TOPK_WIDTHS[-1])
+    own_shape = pad_rows(scores, scores.shape[0])
+    multiple = pad_rows(scores, scores.shape[0] + (-scores.shape[0]) % 8)
+    return literal, snapped, widest, own_shape, multiple

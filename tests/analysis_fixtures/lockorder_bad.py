@@ -1,0 +1,51 @@
+"""lock-order bad twin: a two-lock ordering cycle across classes
+(Ledger→Journal in one path, Journal→Ledger in the other) and a
+non-reentrant self-deadlock (Recount.total calls a helper that
+re-acquires the same plain Lock on the same instance).
+"""
+
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ledger = None
+
+    def bind(self, ledger: "Ledger"):
+        self.ledger = ledger
+
+    def sync(self):
+        with self._lock:
+            pass
+
+    def flush(self):
+        with self._lock:
+            self.ledger.reconcile()  # Journal._lock -> Ledger._lock
+
+
+class Ledger:
+    def __init__(self, journal: Journal):
+        self._lock = threading.Lock()
+        self.journal = journal
+
+    def post(self):
+        with self._lock:
+            self.journal.sync()  # Ledger._lock -> Journal._lock
+
+    def reconcile(self):
+        with self._lock:
+            pass
+
+
+class Recount:
+    def __init__(self):
+        self._lock = threading.Lock()  # NOT reentrant
+
+    def total(self):
+        with self._lock:
+            return self._unsafe_total()
+
+    def _unsafe_total(self):
+        with self._lock:  # same instance, plain Lock: deadlock
+            return 0

@@ -1,0 +1,42 @@
+"""lock-order good twin: the same call shapes with the discipline
+applied — every path acquires Ledger then Journal (one global order,
+no cycle), and the self-re-acquiring class uses an RLock.
+"""
+
+import threading
+
+
+class JournalSafe:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def sync(self):
+        with self._lock:
+            pass
+
+
+class LedgerSafe:
+    def __init__(self, journal: JournalSafe):
+        self._lock = threading.Lock()
+        self.journal = journal
+
+    def post(self):
+        with self._lock:
+            self.journal.sync()  # Ledger -> Journal
+
+    def audit(self):
+        with self._lock:
+            self.journal.sync()  # same direction: no cycle
+
+
+class RecountSafe:
+    def __init__(self):
+        self._lock = threading.RLock()  # reentrant: self-call is fine
+
+    def total(self):
+        with self._lock:
+            return self._unsafe_total()
+
+    def _unsafe_total(self):
+        with self._lock:
+            return 0

@@ -1,0 +1,45 @@
+"""shared-state-race bad twin: unsynchronized cross-thread attribute
+sharing the whole-program pass must catch.
+
+Two shapes: an unlocked thread-context write (Telemetry.pump, spawned
+on an object reached through a typed attribute), and the
+half-discipline case (HalfLockedBox: writer locks, reader doesn't).
+"""
+
+import threading
+
+
+class Telemetry:
+    def __init__(self):
+        self.samples = 0
+
+    def pump(self):
+        while True:
+            self.samples += 1  # thread-context write, no lock
+
+
+class Collector:
+    def __init__(self, tele: Telemetry):
+        self.tele = tele
+
+    def start(self):
+        threading.Thread(target=self.tele.pump, daemon=True).start()
+
+    def report(self):
+        return self.tele.samples  # main-context read of the same attr
+
+
+class HalfLockedBox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def start(self):
+        threading.Thread(target=self._fill, daemon=True).start()
+
+    def _fill(self):
+        with self._lock:
+            self.value = 42  # locked write...
+
+    def peek(self):
+        return self.value  # ...but the reader takes no lock

@@ -1,0 +1,52 @@
+"""shared-state-race good twin: the same shapes as race_bad.py with
+the discipline applied — a common lock on both sides, and pre-spawn
+setup (which happens-before the thread starts) left unlocked.
+"""
+
+import threading
+
+
+class TelemetrySafe:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.samples = 0
+
+    def pump(self):
+        while True:
+            with self._lock:
+                self.samples += 1
+
+    def read(self):
+        with self._lock:
+            return self.samples
+
+
+class CollectorSafe:
+    def __init__(self, tele: TelemetrySafe):
+        self.tele = tele
+
+    def start(self):
+        # pre-spawn setup in the spawning function: program order
+        # happens-before the thread starts, no lock needed
+        self.tele.samples = 0
+        threading.Thread(target=self.tele.pump, daemon=True).start()
+
+    def report(self):
+        return self.tele.read()
+
+
+class FullyLockedBox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def start(self):
+        threading.Thread(target=self._fill, daemon=True).start()
+
+    def _fill(self):
+        with self._lock:
+            self.value = 42
+
+    def peek(self):
+        with self._lock:
+            return self.value

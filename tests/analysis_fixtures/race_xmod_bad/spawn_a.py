@@ -1,0 +1,17 @@
+"""The spawning half of the cross-module race fixture: the thread
+target is a bound method on a module-level object whose class lives in
+another module (state_b.py)."""
+
+import threading
+
+from .state_b import SharedCursor
+
+CURSOR = SharedCursor()
+
+
+def start_advancer():
+    threading.Thread(target=CURSOR.advance, daemon=True).start()
+
+
+def poll():
+    return CURSOR.position  # main-context read, no lock anywhere

@@ -1,0 +1,15 @@
+"""The shared-state half of the cross-module race fixture: this file
+contains NO thread spawn, so the per-file lock-discipline rule sees
+nothing wrong here — only the whole-program pass, which resolves the
+spawn in spawn_a.py to ``SharedCursor.advance``, can flag the
+unsynchronized ``position`` traffic.
+"""
+
+
+class SharedCursor:
+    def __init__(self):
+        self.position = 0
+
+    def advance(self):
+        while True:
+            self.position += 1  # runs on the thread spawned in spawn_a

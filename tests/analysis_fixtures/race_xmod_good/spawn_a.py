@@ -1,0 +1,16 @@
+"""Cross-module good twin: same spawn shape as race_xmod_bad, but the
+main context reads through the locked accessor."""
+
+import threading
+
+from .state_b import SharedCursor
+
+CURSOR = SharedCursor()
+
+
+def start_advancer():
+    threading.Thread(target=CURSOR.advance, daemon=True).start()
+
+
+def poll():
+    return CURSOR.read()

@@ -1,0 +1,20 @@
+"""Cross-module good twin: the shared object carries its own lock and
+every access — the thread-side write and the main-side read — goes
+through it."""
+
+import threading
+
+
+class SharedCursor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.position = 0
+
+    def advance(self):
+        while True:
+            with self._lock:
+                self.position += 1
+
+    def read(self):
+        with self._lock:
+            return self.position

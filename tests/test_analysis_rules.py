@@ -204,6 +204,79 @@ class TestLockDisciplineRule:
         assert run_rule("lock-discipline", "locks_good.py") == []
 
 
+class TestSharedStateRaceRule:
+    def test_bad_fixture_fires(self):
+        findings = run_rule("shared-state-race", "race_bad.py")
+        messages = "\n".join(f.message for f in findings)
+        # unlocked thread write on an object reached via a typed attr
+        assert "Telemetry.samples" in messages
+        # half-discipline: locked writer, unlocked reader — the finding
+        # anchors at the WRITE and names the reader
+        assert "HalfLockedBox.value" in messages
+        assert "without that lock" in messages
+        assert len(findings) == 2
+
+    def test_good_fixture_clean(self):
+        # common lock both sides + pre-spawn setup in the spawning
+        # function (program order happens-before the thread starts)
+        assert run_rule("shared-state-race", "race_good.py") == []
+
+    def test_cross_module_race_found(self):
+        """The tentpole case: the spawn lives in spawn_a.py, the racy
+        class in state_b.py — only the whole-program pass connects
+        them."""
+        findings = run_rule("shared-state-race", "race_xmod_bad")
+        assert len(findings) == 1
+        (f,) = findings
+        assert f.path == "race_xmod_bad/state_b.py"
+        assert "SharedCursor.position" in f.message
+        assert "race_xmod_bad/spawn_a.py" in f.message  # spawn provenance
+
+    def test_cross_module_good_clean(self):
+        assert run_rule("shared-state-race", "race_xmod_good") == []
+
+    def test_per_file_rule_provably_misses_the_cross_module_case(self):
+        """Why the project pass exists: lock-discipline sees no Thread
+        in state_b.py, so the identical racy traffic passes it clean."""
+        assert run_rule("lock-discipline", "race_xmod_bad") == []
+
+
+class TestLockOrderRule:
+    def test_bad_fixture_fires(self):
+        findings = run_rule("lock-order", "lockorder_bad.py")
+        messages = "\n".join(f.message for f in findings)
+        assert "cycle" in messages
+        assert "Ledger._lock" in messages and "Journal._lock" in messages
+        assert "self-deadlock" in messages
+        assert "Recount._lock" in messages
+        assert len(findings) == 2
+
+    def test_good_fixture_clean(self):
+        # one global acquisition order + RLock for the self-call
+        assert run_rule("lock-order", "lockorder_good.py") == []
+
+
+JIT_RECOMPILE_OPTS = {"snap_calls": ["snap_width"]}
+
+
+class TestJitRecompileRiskRule:
+    def test_bad_fixture_fires(self):
+        findings = run_rule("jit-recompile-risk", "jit_recompile_bad.py",
+                            JIT_RECOMPILE_OPTS)
+        messages = "\n".join(f.message for f in findings)
+        # per-request arithmetic and len() feeding static params
+        assert "'k'" in messages and "'width'" in messages
+        # shape-varying inline array at the call site
+        assert "comprehension" in messages
+        assert len(findings) == 3
+
+    def test_good_fixture_clean(self):
+        # literals, module constants, snap calls, .shape-derived values
+        # and the pad-to-multiple idiom are all bounded menus
+        assert run_rule("jit-recompile-risk", "jit_recompile_good.py",
+                        JIT_RECOMPILE_OPTS) == []
+
+
 class TestSuppressionMachinery:
     def test_missing_justification_is_reported_and_not_honored(self):
         findings = run_rule("dtype-discipline", "suppress_bad.py")
