@@ -765,6 +765,21 @@ def bench_workers_scaling(shrunk: bool = False):
     return bench_serving.bench_workers_section(shrunk=shrunk)
 
 
+def bench_shm_cache(shrunk: bool = False):
+    """Shared-memory serving plane (private per-worker LRU vs ONE
+    seqlock shm segment at 1 and 2 SO_REUSEPORT workers) — the PR 18
+    trajectory: paired qps/p99, the pool-wide hit ratio from the
+    merged /metrics scrape, and the post-invalidation rewarm probe
+    (a shared segment pays each cold key ONCE pool-wide; replicated
+    LRUs pay it once per worker the replays land on). Standalone
+    harness: bench_serving.py --shm-only (committed artifacts:
+    BENCH_shm_rNN.json); under --skip-heavy it runs shrunk (small
+    catalog, fewer rounds, smaller probe — same contract)."""
+    import bench_serving
+
+    return bench_serving.bench_shm_section(shrunk=shrunk)
+
+
 def bench_gateway_phase(shrunk: bool = False):
     """Multi-tenant gateway: 1 vs 2 engines behind one router + the
     quota-isolation pin (a tenant driven past its qps quota is 429'd
@@ -1319,6 +1334,8 @@ def main() -> None:
          lambda: bench_ann_retrieval(shrunk=args.skip_heavy)),
         ("workers_scaling",
          lambda: bench_workers_scaling(shrunk=args.skip_heavy)),
+        ("shm_cache",
+         lambda: bench_shm_cache(shrunk=args.skip_heavy)),
         ("gateway",
          lambda: bench_gateway_phase(shrunk=args.skip_heavy)),
         ("freshness",
@@ -1341,9 +1358,11 @@ def main() -> None:
         # device involvement
         # elasticity rides along shrunk: router threads + stdlib echo
         # backends + a ManualClock timeline, no device involvement
+        # shm_cache rides along shrunk: subprocess serving pools +
+        # loopback HTTP + one POSIX shm segment, no device involvement
         keep = ("quality", "ingest", "data_plane", "ann_retrieval",
                 "workers_scaling", "freshness", "train_profile",
-                "gateway", "elasticity")
+                "gateway", "elasticity", "shm_cache")
         failed.extend(s[0] for s in sections if s[0] not in keep)
         sections = [s for s in sections if s[0] in keep]
     for section, fn in sections:

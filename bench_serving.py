@@ -612,6 +612,11 @@ def _serving_worker_main(argv: list[str]) -> None:
     ap.add_argument("--model-dir", default=None)
     ap.add_argument("--retrieval", default="brute")
     ap.add_argument("--nprobe", type=int, default=0)
+    # the shm phase's two arms: --cache alone is the replicated
+    # private-LRU baseline, --cache --shm-segment NAME attaches every
+    # sibling to one pre-created seqlock segment (shm_cache.py)
+    ap.add_argument("--cache", action="store_true")
+    ap.add_argument("--shm-segment", default="")
     args = ap.parse_args(argv)
 
     from predictionio_tpu.api.engine_server import EngineServer
@@ -633,7 +638,13 @@ def _serving_worker_main(argv: list[str]) -> None:
         batch_policy="adaptive", batch_max=args.batch_max,
         batch_wait_ms=5.0,
         reuse_port=True, worker_spool_dir=args.spool,
-        admin_sync_interval_s=0.5))
+        admin_sync_interval_s=0.5,
+        cache_enabled=args.cache or bool(args.shm_segment),
+        # rounds span minutes; a 30s TTL would turn the steady-state
+        # hit ratio into a TTL-expiry measurement
+        cache_ttl_s=300.0,
+        shm_cache=bool(args.shm_segment),
+        shm_segment=args.shm_segment))
     server.start()
     print(f"PORT {server.port}", flush=True)
     sys.stdin.readline()                 # parent closes stdin to stop
@@ -862,6 +873,208 @@ def bench_workers_section(shrunk: bool = False) -> dict:
         "workers_reported_in_merged_metrics":
             r["workers_reported_in_merged_metrics"],
     }
+
+
+def _scrape_counters(port: int, names: tuple) -> dict[str, float]:
+    """Pool-wide counter totals from the merged /metrics exposition:
+    wherever the connection lands, the scrape folds every sibling in
+    (obs/registry merge_sources), so these are the POOL's numbers —
+    /stats.json's serving section is per-worker and would under-count
+    a 2-worker arm by whatever the other sibling served."""
+    import urllib.request
+
+    totals = {n: 0.0 for n in names}
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        for line in r.read().decode().splitlines():
+            head = line.split("{")[0].split(" ")[0]
+            if head in totals:
+                totals[head] += float(line.split()[-1])
+    return totals
+
+
+def _probe_query(port: int, doc: dict) -> None:
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json",
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        r.read()
+
+
+def _rewarm_misses(port: int, keys: int, passes: int = 3,
+                   settle_s: float = 0.0) -> int:
+    """The cold-start-elimination probe (ISSUE PR 18): invalidate the
+    whole pool (POST /retrieval reconfig — every applying worker bumps
+    its cache generation, private or shared), wait for sibling
+    admin-sync to settle, then replay ``keys`` distinct queries
+    ``passes`` times over fresh connections (SO_REUSEPORT spreads them
+    across siblings) and count pool-wide misses. A shared segment pays
+    exactly ``keys`` misses — the first toucher warms EVERY sibling;
+    replicated private LRUs pay ~``keys`` per DISTINCT sibling the
+    replays land on."""
+    base = _scrape_counters(
+        port, ("pio_serving_cache_misses_total",))
+    _probe_reconfig(port)
+    if settle_s:
+        time.sleep(settle_s)
+    for _ in range(passes):
+        for i in range(keys):
+            _probe_query(port, {"user": "u0", "num": 3 + i})
+    after = _scrape_counters(
+        port, ("pio_serving_cache_misses_total",))
+    return int(after["pio_serving_cache_misses_total"]
+               - base["pio_serving_cache_misses_total"])
+
+
+def _probe_reconfig(port: int) -> None:
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/retrieval",
+        data=json.dumps({"retrieval": "brute"}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        r.read()
+
+
+def bench_shm(items: int = DEF_ITEMS, rank: int = DEF_RANK,
+              clients: int = DEF_CLIENTS,
+              per_client: int = DEF_PER_CLIENT,
+              batch_max: int = 32, rounds: int = 4,
+              procs: int = DEF_CLIENT_PROCS,
+              rewarm_keys: int = 16,
+              worker_counts: tuple = (1, 2)) -> dict:
+    """The shared-memory serving-plane phase (PR 18;
+    docs/serving-performance.md "Shared-memory serving plane"): the
+    SAME cached workload served by a pool whose result cache is (a)
+    one private LRU per worker — the replicated baseline, N physical
+    copies of every hot answer — vs (b) ONE seqlock shm segment every
+    sibling attaches to (`pio deploy --shm-cache`). Paired
+    order-alternated rounds at each worker count give the steady-state
+    qps comparison (the seqlock read path vs a plain dict is the
+    overhead question); the pool-wide hit ratio and the post-
+    invalidation rewarm probe are the coherence story — one warm pass
+    heats EVERY sibling of a shared pool, while private caches pay the
+    miss once per worker the traffic lands on."""
+    import os
+    import shutil
+
+    from predictionio_tpu.serving.shm_cache import ShmResultCache
+
+    worker_args = ["--items", str(items), "--rank", str(rank),
+                   "--batch-max", str(batch_max)]
+    pool = [f"u{i}" for i in range(DEF_POOL)]
+    per_workers = []
+    ratio_2w = None
+    for n_workers in worker_counts:
+        segment = f"pio-bench-shm-{os.getpid()}-{n_workers}w"
+        # the bench parent is the segment owner — exactly the deploy
+        # parent's role in cli_commands._deploy_pool
+        owner = ShmResultCache(segment, nslots=4096, slot_bytes=4096,
+                               ttl_s=300.0, create="create")
+        arms: dict[str, dict] = {}
+        children_all: list = []
+        spools: list = []
+        try:
+            for tag, extra in (
+                    ("private", ["--cache"]),
+                    ("shm", ["--cache", "--shm-segment", segment])):
+                children, port, spool = _spawn_worker_pool(
+                    n_workers, worker_args + extra)
+                children_all += children
+                spools.append(spool)
+                arms[tag] = {"port": port, "rounds": [], "best": None}
+            for i in range(rounds):
+                order = ["private", "shm"]
+                if i % 2:
+                    order.reverse()
+                for tag in order:
+                    r = _drive(arms[tag]["port"], pool, clients,
+                               per_client, rounds=1, procs=procs)
+                    arms[tag]["rounds"].append(r["qps"])
+                    best = arms[tag]["best"]
+                    if best is None or r["qps"] > best["qps"]:
+                        arms[tag]["best"] = r
+            for tag in ("private", "shm"):
+                c = _scrape_counters(arms[tag]["port"], (
+                    "pio_serving_cache_hits_total",
+                    "pio_serving_cache_misses_total"))
+                hits = c["pio_serving_cache_hits_total"]
+                misses = c["pio_serving_cache_misses_total"]
+                arms[tag]["hit_ratio"] = (
+                    round(hits / (hits + misses), 4)
+                    if hits + misses else None)
+                # sibling sync applies the reconfig once per worker
+                # (~admin_sync_interval_s apart); probing before the
+                # last sibling's generation bump would re-chill keys
+                # warmed by pass 1 and measure the race, not the cache
+                arms[tag]["rewarm_misses"] = _rewarm_misses(
+                    arms[tag]["port"], rewarm_keys,
+                    settle_s=2.0 if n_workers > 1 else 0.0)
+        finally:
+            _stop_children(children_all)
+            for d in spools:
+                shutil.rmtree(d, ignore_errors=True)
+            owner.close(unlink=True)
+        entry = {"workers": n_workers}
+        for tag in ("private", "shm"):
+            a = arms[tag]
+            entry[f"{tag}_qps"] = a["best"]["qps"]
+            entry[f"{tag}_p99_ms"] = a["best"]["p99_ms"]
+            entry[f"{tag}_steady_qps"] = round(
+                _steady_mean(a["rounds"]), 1)
+            entry[f"{tag}_round_qps"] = a["rounds"]
+            entry[f"{tag}_hit_ratio"] = a["hit_ratio"]
+            entry[f"{tag}_rewarm_misses"] = a["rewarm_misses"]
+            entry[f"{tag}_errors"] = a["best"]["errors"]
+        entry["shm_vs_private_x"] = (
+            round(entry["shm_steady_qps"] / entry["private_steady_qps"],
+                  2) if entry["private_steady_qps"] else None)
+        if n_workers == 2:
+            ratio_2w = entry["shm_vs_private_x"]
+        per_workers.append(entry)
+    return {
+        "metric": f"shm_cache_2w_shm_vs_private_{clients}c",
+        "value": ratio_2w,
+        "unit": "x",
+        "host_cores": os.cpu_count(),
+        "host_cores_caveat": host_core_ratio_caveat(),
+        "rewarm_keys": rewarm_keys,
+        "per_workers": per_workers,
+        "clients": clients,
+        "items": items,
+        "rank": rank,
+    }
+
+
+def bench_shm_section(shrunk: bool = False) -> dict:
+    """The ``shm_cache`` section for bench.py's round artifact:
+    paired private-vs-shm at 1 and 2 workers. ``shrunk``
+    (--skip-heavy) shrinks the catalog, round count, and probe size;
+    the key set is pinned by tests/test_bench_contract.py."""
+    if shrunk:
+        r = bench_shm(items=16_384, per_client=8, rounds=2,
+                      rewarm_keys=8)
+    else:
+        r = bench_shm(per_client=16)
+    by_workers = {e["workers"]: e for e in r["per_workers"]}
+    out: dict = {}
+    for n in (1, 2):
+        e = by_workers[n]
+        out[f"shm_qps_{n}w_private"] = e["private_qps"]
+        out[f"shm_qps_{n}w_shm"] = e["shm_qps"]
+    for tag in ("private", "shm"):
+        out[f"shm_hit_ratio_2w_{tag}"] = by_workers[2][f"{tag}_hit_ratio"]
+        out[f"shm_rewarm_misses_2w_{tag}"] = \
+            by_workers[2][f"{tag}_rewarm_misses"]
+        out[f"shm_p99_ms_2w_{tag}"] = by_workers[2][f"{tag}_p99_ms"]
+    out["shm_host_cores"] = r["host_cores"]
+    out["shm_host_cores_caveat"] = r["host_cores_caveat"]
+    return out
 
 
 def _router_main(argv: list[str]) -> None:
@@ -1679,7 +1892,20 @@ def main() -> None:
                         help="catalog size for the ANN re-run under 2 "
                              "workers (0 skips it)")
     parser.add_argument("--workers-rounds", type=int, default=6)
+    parser.add_argument("--shm-only", action="store_true",
+                        help="run only the shared-memory serving-plane "
+                             "phase (private LRU vs shm segment at 1 "
+                             "and 2 workers; BENCH_shm_rNN.json)")
+    parser.add_argument("--shm-rounds", type=int, default=4)
+    parser.add_argument("--shm-rewarm-keys", type=int, default=16)
     args = parser.parse_args()
+    if args.shm_only:
+        print(json.dumps(bench_shm(
+            items=args.items, rank=args.rank, clients=args.clients,
+            per_client=args.per_client, batch_max=args.batch_max,
+            rounds=args.shm_rounds, procs=args.client_procs,
+            rewarm_keys=args.shm_rewarm_keys)))
+        return
     if args.gateway_only:
         # --client-procs deliberately NOT forwarded: both arms of the
         # table-cost comparison pin the client layout at one process

@@ -112,6 +112,14 @@ COMPUTE_PATHS = ("ops/", "models/", "e2/")
 #: sweep loop must stay on Event.wait, its one fleet scrape flows
 #: through the already-policed fleet_metrics fan-out, and the
 #: credit-spend check sits on the gateway's admit path
+#: the shared-memory serving plane (PR 18: serving/shm_cache.py,
+#: serving/placement.py) is covered by the serving/ prefix here and in
+#: every serving-scoped rule below (resilience-bypass,
+#: untimed-blocking-io): the seqlock cache sits INSIDE every cached
+#: query, must never grow network I/O or a host sync, and its bounded
+#: read-retry loop must stay sleep-free (readers never wait on the
+#: writer — serving/shm_cache.py is in banned_sleep_paths to keep it
+#: that way)
 HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/", "obs/",
              "fleet/", "ops/ann.py", "online/")
 
@@ -247,8 +255,14 @@ def default_config() -> LintConfig:
                     # must be clock-injectable, so a bare time.sleep
                     # there is a finding — use clock.sleep or
                     # Event.wait (PR 9; docs/static-analysis.md)
+                    # serving/shm_cache.py (PR 18): the seqlock
+                    # reader's bounded retry must SPIN-then-miss, never
+                    # sleep — a sleeping reader inside /queries.json is
+                    # exactly the reader-blocks-on-writer coupling the
+                    # seqlock exists to remove
                     "banned_sleep_paths": ["fleet/",
                                            "serving/workers.py",
+                                           "serving/shm_cache.py",
                                            "data/wal.py",
                                            "online/"],
                 },

@@ -291,13 +291,23 @@ class EngineService:
         #: one counter set shared by batcher + cache (GET /stats.json)
         self.serving_stats = ServingStats()
         #: opt-in result cache: canonical-query-JSON -> prediction,
-        #: invalidated on successful /reload (ResultCache docs)
-        self.cache: ResultCache | None = (
-            ResultCache(max_entries=config.cache_max_entries,
-                        ttl_s=config.cache_ttl_s,
-                        stats=self.serving_stats)
-            if config.cache_enabled else None
-        )
+        #: invalidated on successful /reload (ResultCache docs). With
+        #: --shm-cache the pool shares ONE seqlock-slotted segment
+        #: (serving/shm_cache) behind the same interface; a platform
+        #: without shared memory warns and falls back to the private
+        #: LRU — same contract, worker-local warmth
+        self.cache = None
+        if config.cache_enabled:
+            if config.shm_cache:
+                from predictionio_tpu.serving.shm_cache import open_shm_cache
+
+                self.cache = open_shm_cache(config,
+                                            stats=self.serving_stats)
+            if self.cache is None:
+                self.cache = ResultCache(
+                    max_entries=config.cache_max_entries,
+                    ttl_s=config.cache_ttl_s,
+                    stats=self.serving_stats)
         #: opt-in micro-batching: concurrent queries coalesce into one
         #: device dispatch (ServerConfig.batching; QueryBatcher docs);
         #: the wait/target per batch comes from the configured policy
@@ -1459,6 +1469,12 @@ class EngineServer(RestServer):
             undeploy(ip, port, self.config.server_key)
 
     def _on_close(self) -> None:
+        # the shm cache detaches (and unlinks iff this process created
+        # the segment — the standalone case; pool workers only attach,
+        # the deploy CLI owns the pool segment's lifetime)
+        cache_close = getattr(self.service.cache, "close", None)
+        if cache_close is not None:
+            cache_close()
         if self.service.online is not None:
             self.service.online.close()
         if self.service.coherence is not None:

@@ -571,7 +571,10 @@ class ALSModel:
     def load(directory: str, shardings: dict | None = None) -> "ALSModel":
         """``shardings`` optionally maps "user"/"item" to target
         ``NamedSharding``s so factors restore straight onto a mesh."""
-        from predictionio_tpu.utils.checkpoint import load_sharded
+        from predictionio_tpu.utils.checkpoint import (
+            default_mmap_mode,
+            load_sharded,
+        )
 
         # an orbax dir without meta means a crash interrupted save() after
         # the checkpoint write — still newer than any legacy factors.npz
@@ -597,9 +600,18 @@ class ALSModel:
         if "ann" in meta:
             # the meta names an index: a missing/corrupt ann/ payload is
             # CheckpointCorruptError (load_sharded), surfaced — never a
-            # silent fall-back to brute on a torn checkpoint
+            # silent fall-back to brute on a torn checkpoint.
+            # --model-mmap covers this payload too: flat_vecs is the
+            # index's big allocation (a full f32 copy of the item
+            # table), and from_arrays keeps the mapping (asarray on a
+            # dtype-matching memmap is a view, not a copy), so N pool
+            # workers share ONE page-cache copy of the vectors exactly
+            # like the factor tables. Passed explicitly — the ann/
+            # checkpoint must ride the same knob as the factors even if
+            # a caller someday threads a per-call mode through.
             ann_index = ann_ops.AnnIndex.from_arrays(
-                load_sharded(os.path.join(directory, _ANN_SUBDIR)),
+                load_sharded(os.path.join(directory, _ANN_SUBDIR),
+                             mmap_mode=default_mmap_mode()),
                 n_items=int(meta["ann"]["n_items"]))
         return ALSModel(
             rank=int(meta["rank"]),

@@ -194,6 +194,12 @@ class AnnIndex:
 
     @staticmethod
     def from_arrays(arrays: Mapping[str, Any], n_items: int) -> "AnnIndex":
+        # dtype-preserving on the persisted layout: the checkpoint
+        # writes these at exactly these dtypes, so asarray is a VIEW —
+        # under load_sharded(mmap_mode="r") the arrays (flat_vecs
+        # above all) stay page-cache-backed and N prefork workers
+        # share one physical copy (docs/serving-performance.md
+        # "Model memory: replicated vs mmap")
         centroids = np.asarray(arrays["centroids"], dtype=np.float32)
         return AnnIndex(
             nlist=int(centroids.shape[0]),

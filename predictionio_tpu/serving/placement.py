@@ -1,0 +1,74 @@
+"""Best-effort NUMA/CPU-affinity worker placement for the prefork pool.
+
+`pio deploy --workers N` leaves the kernel free to bounce N engine
+processes across cores; on big hosts that costs cache locality (each
+worker's model pages, batcher state, and shm-cache slots keep migrating
+between L2/LLC domains) and, on multi-socket machines, cross-NUMA
+traffic against the mmap'd factor tables. Pinning each worker to a
+contiguous stripe of the allowed CPU list keeps a worker's working set
+on one cache/NUMA domain — contiguous CPU ids are the portable proxy
+for "same socket" without parsing sysfs topology.
+
+Everything here is best-effort by contract: a 1-core container, a
+host with fewer allowed CPUs than workers, a platform without
+``sched_setaffinity`` (macOS), or a denied syscall all return ``None``
+and change nothing — placement is an optimization, never a boot
+requirement (degrade-don't-die, the knob discipline every serving
+feature follows).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from collections.abc import Iterable
+
+logger = logging.getLogger(__name__)
+
+
+def assign_worker_cpus(index: int, total: int,
+                       cpus: Iterable[int]) -> frozenset[int] | None:
+    """The contiguous CPU stripe worker ``index`` of ``total`` should
+    pin to, carved from the ALLOWED cpu list (so an outer cgroup/taskset
+    restriction is respected, never widened). None when placement can't
+    help: a single worker (nothing to separate) or fewer CPUs than
+    workers (pinning would serialize siblings a free scheduler could
+    still interleave)."""
+    cpu_list = sorted(set(cpus))
+    if total <= 1 or index < 0 or index >= total:
+        return None
+    if len(cpu_list) < total:
+        return None
+    per, extra = divmod(len(cpu_list), total)
+    start = index * per + min(index, extra)
+    size = per + (1 if index < extra else 0)
+    return frozenset(cpu_list[start:start + size])
+
+
+def apply_worker_affinity(index: int, total: int) -> frozenset[int] | None:
+    """Pin THIS process to its stripe; returns the applied CPU set, or
+    None when the platform/topology says don't (logged at debug — this
+    is the expected outcome on 1-core CI hosts, not an error)."""
+    getter = getattr(os, "sched_getaffinity", None)
+    setter = getattr(os, "sched_setaffinity", None)
+    if getter is None or setter is None:
+        return None
+    try:
+        allowed = getter(0)
+    except OSError:
+        return None
+    stripe = assign_worker_cpus(index, total, allowed)
+    if stripe is None:
+        logger.debug(
+            "worker %d/%d: no affinity stripe (%d allowed cpus) — "
+            "leaving scheduling to the kernel", index, total, len(allowed))
+        return None
+    try:
+        setter(0, stripe)
+    except OSError as exc:                 # containers may deny the call
+        logger.debug("worker %d/%d: sched_setaffinity(%s) denied: %s",
+                     index, total, sorted(stripe), exc)
+        return None
+    logger.info("worker %d/%d pinned to cpus %s", index, total,
+                sorted(stripe))
+    return stripe

@@ -24,6 +24,7 @@ The clock is injectable for TTL tests on virtual time.
 
 from __future__ import annotations
 
+import json
 import threading
 from collections import OrderedDict
 from typing import Any
@@ -33,6 +34,24 @@ from predictionio_tpu.utils.resilience import SYSTEM_CLOCK, Clock
 
 #: sentinel distinguishing "miss" from a cached None prediction
 _MISS = object()
+
+
+def user_fragment_of(key: str) -> str | None:
+    """The ``"user":...`` canonical fragment a cache key carries, or
+    None for keys without a top-level user (non-JSON test keys, engines
+    whose queries aren't user-addressed). Derived through
+    ``canonical_json`` itself — the same construction as
+    ``online/service.user_key_fragment`` — so the index below and the
+    online plane's invalidation fragments can never drift apart."""
+    try:
+        doc = json.loads(key)
+    except ValueError:
+        return None
+    if not isinstance(doc, dict) or "user" not in doc:
+        return None
+    from predictionio_tpu.core.json_codec import canonical_json
+
+    return canonical_json({"user": doc["user"]})[1:-1]
 
 
 class ResultCache:
@@ -49,6 +68,13 @@ class ResultCache:
         #: key -> (inserted_at, value); insertion/access order = LRU
         self._entries: "OrderedDict[str, tuple[float, Any]]" = OrderedDict()
         self._generation = 0
+        #: user-fragment -> keys index so the online plane's per-fold
+        #: ``invalidate_matching`` costs the USER's entries, not a full
+        #: key scan (the shm cache keeps the same index as a tag
+        #: column); ``_key_tag`` is the reverse map the deletion paths
+        #: (evict/expire/invalidate) use to keep the index exact
+        self._tag_keys: dict[str, set[str]] = {}
+        self._key_tag: dict[str, str] = {}
 
     @property
     def generation(self) -> int:
@@ -74,6 +100,7 @@ class ResultCache:
             inserted, value = entry
             if self.ttl_s > 0 and now - inserted >= self.ttl_s:
                 del self._entries[key]
+                self._forget(key)
                 self.stats.bump("cache_expirations")
                 self.stats.bump("cache_misses")
                 return False, _MISS, gen
@@ -90,10 +117,27 @@ class ResultCache:
                 return False
             self._entries[key] = (now, value)
             self._entries.move_to_end(key)
+            if key not in self._key_tag:
+                tag = user_fragment_of(key)
+                if tag is not None:
+                    self._key_tag[key] = tag
+                    self._tag_keys.setdefault(tag, set()).add(key)
             while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
+                evicted, _ = self._entries.popitem(last=False)
+                self._forget(evicted)
                 self.stats.bump("cache_evictions")
             return True
+
+    def _forget(self, key: str) -> None:
+        """Drop ``key`` from the user index (caller already removed the
+        entry, under the cache lock)."""
+        tag = self._key_tag.pop(key, None)
+        if tag is not None:
+            keys = self._tag_keys.get(tag)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._tag_keys[tag]
 
     def invalidate(self, generation: int | None = None) -> None:
         """Atomically drop everything and start a new generation.
@@ -109,6 +153,8 @@ class ResultCache:
         ``put()`` guard depends on generations never repeating)."""
         with self._lock:
             self._entries.clear()
+            self._tag_keys.clear()
+            self._key_tag.clear()
             if generation is not None:
                 self._generation = max(self._generation + 1, generation)
             else:
@@ -127,11 +173,24 @@ class ResultCache:
         result right back (the stale-generation guard protects only
         puts, so every OTHER user's existing entries keep serving —
         the in-flight computations across the bump merely become
-        uncacheable, the small price of correctness)."""
+        uncacheable, the small price of correctness).
+
+        User fragments (``"user":...`` — the only kind the online
+        plane sends) resolve through the put-time user index, so the
+        cost is proportional to THAT user's entries instead of an
+        O(entries) key scan; any other fragment keeps the generic
+        full-scan substring contract. (A user fragment cannot hide
+        inside a string value — canonical JSON escapes the quotes — so
+        for the flat wire queries the templates serve, index equality
+        and substring match select the same keys.)"""
         with self._lock:
-            doomed = [k for k in self._entries if fragment in k]
+            if fragment.startswith('"user":'):
+                doomed = list(self._tag_keys.get(fragment, ()))
+            else:
+                doomed = [k for k in self._entries if fragment in k]
             for k in doomed:
                 del self._entries[k]
+                self._forget(k)
             # unconditional: the racing in-flight query may not have an
             # entry to doom YET — its put is the thing being fenced
             self._generation += 1

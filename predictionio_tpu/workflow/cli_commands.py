@@ -278,6 +278,22 @@ def _configure_deploy(sub) -> None:
                         "JSON, invalidated on /reload")
     p.add_argument("--cache-max-entries", type=int, default=None)
     p.add_argument("--cache-ttl-s", type=float, default=None)
+    p.add_argument("--shm-cache", action=argparse.BooleanOptionalAction,
+                   default=None, dest="shm_cache",
+                   help="back the result cache with ONE shared-memory "
+                        "segment all --workers siblings attach (a key "
+                        "warmed by any worker is hot pool-wide; "
+                        "serving/shm_cache). Implies --cache; falls "
+                        "back to the private LRU where the platform "
+                        "lacks shm")
+    p.add_argument("--shm-slots", type=int, default=None,
+                   dest="shm_slots",
+                   help="slot count of the shared cache table "
+                        "(PIO_SERVING_SHM_SLOTS)")
+    p.add_argument("--shm-slot-bytes", type=int, default=None,
+                   dest="shm_slot_bytes",
+                   help="bytes per shared-cache slot "
+                        "(PIO_SERVING_SHM_SLOT_BYTES)")
     # sublinear retrieval (ops/ann; docs/serving-performance.md):
     # None defers to the PIO_SERVING_ANN_* env-aware ServerConfig
     # defaults, matching the other serving knobs
@@ -340,8 +356,12 @@ def _deploy_worker(config) -> None:
     connection and model replica (mmap-share the factor tables via
     --model-mmap / PIO_CHECKPOINT_MMAP=r)."""
     from predictionio_tpu.api.engine_server import create_engine_server
+    from predictionio_tpu.serving.placement import apply_worker_affinity
     from predictionio_tpu.storage.registry import Storage
 
+    # before the model loads, so its pages fault in on the pinned
+    # cores; a respawn re-applies (the index rides the config)
+    apply_worker_affinity(config.worker_index, max(1, config.workers))
     server = create_engine_server(storage=Storage.default(), config=config)
     try:
         server.serve_forever()
@@ -382,9 +402,16 @@ def _cmd_deploy(args, storage) -> int:
             "batch_policy": args.batch_policy,
             "batch_max": args.batch_max,
             "batch_wait_ms": args.batch_wait_ms,
-            "cache_enabled": args.cache,
+            # --shm-cache without --cache means "cache, shared": the
+            # shm flag implies the cache it backs
+            "cache_enabled": (True if (args.cache is None
+                                       and args.shm_cache)
+                              else args.cache),
             "cache_max_entries": args.cache_max_entries,
             "cache_ttl_s": args.cache_ttl_s,
+            "shm_cache": args.shm_cache,
+            "shm_slots": args.shm_slots,
+            "shm_slot_bytes": args.shm_slot_bytes,
             "retrieval": args.retrieval,
             "ann_nlist": args.ann_nlist,
             "ann_nprobe": args.ann_nprobe,
@@ -430,9 +457,32 @@ def _cmd_deploy(args, storage) -> int:
         reuse_port=True,
         worker_spool_dir=tempfile.mkdtemp(prefix="pio-deploy-workers-"))
 
-    def sibling():
+    # ONE shared-memory cache segment for the whole pool: the parent
+    # creates and owns it (unlinked in the teardown below), workers
+    # attach by name. Creation failure degrades the pool to private
+    # per-worker LRUs — same serving semantics, worker-local warmth.
+    shm_owner = None
+    if config.shm_cache and config.cache_enabled and not config.shm_segment:
+        from predictionio_tpu.serving.shm_cache import ShmResultCache
+
+        segment = f"pio-shm-{os.getpid()}"
+        try:
+            shm_owner = ShmResultCache(
+                segment, nslots=config.shm_slots,
+                slot_bytes=config.shm_slot_bytes,
+                ttl_s=config.cache_ttl_s, create="create")
+            config = dataclasses.replace(config, shm_segment=segment)
+        except Exception as exc:
+            print(f"[WARN] shared-memory cache unavailable "
+                  f"({type(exc).__name__}: {exc}); workers fall back "
+                  f"to private result caches")
+            config = dataclasses.replace(config, shm_cache=False)
+
+    def sibling(index: int):
         return multiprocessing.Process(
-            target=_deploy_worker, args=(config,), daemon=True)
+            target=_deploy_worker,
+            args=(dataclasses.replace(config, worker_index=index),),
+            daemon=True)
 
     # SIGTERM's default action would kill this parent without running
     # any finally, orphaning the SO_REUSEPORT siblings on the shared
@@ -458,16 +508,20 @@ def _cmd_deploy(args, storage) -> int:
 
             supervisor = FleetSupervisor([
                 SpawnSpec(id=f"worker:{i}",
-                          spawn=lambda: ProcessHandle(sibling()),
+                          spawn=lambda i=i: ProcessHandle(sibling(i)),
                           role=WORKER)
                 for i in range(1, workers)
             ])
             supervisor.start()
         else:
-            for _ in range(workers - 1):
-                proc = sibling()
+            for i in range(1, workers):
+                proc = sibling(i)
                 proc.start()
                 worker_procs.append(proc)
+        # the parent is worker 0 of the pool: pin it to its own stripe
+        from predictionio_tpu.serving.placement import apply_worker_affinity
+
+        apply_worker_affinity(0, workers)
         server = create_engine_server(storage=storage, config=config)
         print(f"[INFO] Engine instance "
               f"{server.service.deployed.instance.id} listening on "
@@ -489,6 +543,10 @@ def _cmd_deploy(args, storage) -> int:
         # WorkerHub.close, leaving spool entries behind — the parent
         # mkdtemp'd the dir, the parent removes it
         shutil.rmtree(config.worker_spool_dir, ignore_errors=True)
+        if shm_owner is not None:
+            # same ownership story as the spool: the parent created
+            # the segment, the parent unlinks it
+            shm_owner.close(unlink=True)
     return 0
 
 

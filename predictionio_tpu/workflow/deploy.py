@@ -130,6 +130,24 @@ class ServerConfig:
     cache_enabled: bool = _env_field("CACHE_ENABLED", False, _cast_bool)
     cache_max_entries: int = _env_field("CACHE_MAX_ENTRIES", 4096, int)
     cache_ttl_s: float = _env_field("CACHE_TTL_S", 30.0, float)
+    #: shared-memory result cache (`pio deploy --shm-cache`;
+    #: serving/shm_cache, docs/serving-performance.md "Shared-memory
+    #: serving plane"): back the result cache with ONE
+    #: multiprocessing.shared_memory segment all pool workers attach —
+    #: a key warmed by any worker is hot for every sibling, and a
+    #: /reload re-warms once instead of N times. Requires
+    #: ``cache_enabled``; platforms without POSIX shm warn and fall
+    #: back to the private LRU (degrade-don't-die)
+    shm_cache: bool = _env_field("SHM", False, _cast_bool)
+    #: slot count of the direct-mapped table (also the entry cap the
+    #: snapshot reports); colliding keys overwrite — it's a cache
+    shm_slots: int = _env_field("SHM_SLOTS", 4096, int)
+    #: bytes per slot: header + canonical key + pickled prediction;
+    #: oversized entries simply stay uncached
+    shm_slot_bytes: int = _env_field("SHM_SLOT_BYTES", 4096, int)
+    #: segment name shared by the pool (the deploy CLI generates and
+    #: owns one per pool); empty = a private per-process segment
+    shm_segment: str = _env_field("SHM_SEGMENT", "", str)
     #: graceful degradation (beyond reference): per-request time budget
     #: for /queries.json. Propagated as the ambient resilience deadline
     #: (utils/resilience.deadline_scope — storage retries stop sleeping
@@ -177,6 +195,12 @@ class ServerConfig:
     #: (fleet/workers.WorkerHub, serving/workers.WorkerCoherence); the
     #: CLI mkdtemps it and passes it to every worker. None = no pool.
     worker_spool_dir: str | None = None
+    #: this worker's ordinal in the pool (0 = the parent process; the
+    #: CLI stamps 1..N-1 onto each sibling spawn) — drives best-effort
+    #: CPU-affinity placement (serving/placement): contiguous stripes
+    #: of the available cores, degrade-don't-die on hosts with fewer
+    #: cores than workers
+    worker_index: int = 0
     #: bind with SO_REUSEPORT so the N worker processes share the port
     #: (set by the CLI when workers > 1)
     reuse_port: bool = False

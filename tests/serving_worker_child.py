@@ -56,6 +56,12 @@ def main() -> None:
     parser.add_argument("--spool", required=True)
     parser.add_argument("--tag", default="w")
     parser.add_argument("--admin-sync-interval-s", type=float, default=0.1)
+    # opt-in shared-memory result cache: the chaos suite points every
+    # sibling at one pre-created segment so kill -9 mid-write leaves a
+    # torn slot the SURVIVORS must keep serving around
+    parser.add_argument("--shm-segment", default="")
+    parser.add_argument("--shm-slots", type=int, default=256)
+    parser.add_argument("--shm-slot-bytes", type=int, default=4096)
     args = parser.parse_args()
 
     from predictionio_tpu.api.engine_server import EngineServer
@@ -79,7 +85,11 @@ def main() -> None:
         ip="127.0.0.1", port=args.port,
         reuse_port=True, worker_spool_dir=args.spool,
         admin_sync_interval_s=args.admin_sync_interval_s,
-        cache_enabled=False))
+        cache_enabled=bool(args.shm_segment),
+        shm_cache=bool(args.shm_segment),
+        shm_segment=args.shm_segment,
+        shm_slots=args.shm_slots,
+        shm_slot_bytes=args.shm_slot_bytes))
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -66,6 +66,23 @@ def tiny_bench(monkeypatch):
                               "workers_qps_2w": 160.0,
                               "workers_host_cores": 2,
                               "workers_reported_in_merged_metrics": 2.0})
+    # shm_cache spawns paired private-vs-shm serving pools over one
+    # POSIX segment (bench_serving.py --shm-only) — stubbed here; the
+    # real tiny harness is the slow-marked test below
+    monkeypatch.setattr(
+        bench, "bench_shm_cache",
+        lambda shrunk=False: {"shm_qps_1w_private": 100.0,
+                              "shm_qps_1w_shm": 98.0,
+                              "shm_qps_2w_private": 150.0,
+                              "shm_qps_2w_shm": 148.0,
+                              "shm_hit_ratio_2w_private": 0.9,
+                              "shm_hit_ratio_2w_shm": 0.95,
+                              "shm_rewarm_misses_2w_private": 24,
+                              "shm_rewarm_misses_2w_shm": 8,
+                              "shm_p99_ms_2w_private": 5.0,
+                              "shm_p99_ms_2w_shm": 5.0,
+                              "shm_host_cores": 2,
+                              "shm_host_cores_caveat": None})
     # freshness trains + deploys a live server fleet (bench_freshness.py)
     # — stubbed here; the real tiny harness is the perf test below
     monkeypatch.setattr(
@@ -131,6 +148,10 @@ def test_single_json_line_with_primary_contract(tiny_bench, capsys, monkeypatch)
                 "elasticity_b_http_5xx", "elasticity_throttled_429",
                 "elasticity_burst_admitted_with_credits",
                 "elasticity_host_cores_caveat",
+                # the shared-memory serving-plane trajectory keys (PR 18)
+                "shm_qps_2w_private", "shm_qps_2w_shm",
+                "shm_hit_ratio_2w_shm", "shm_rewarm_misses_2w_private",
+                "shm_rewarm_misses_2w_shm", "shm_host_cores_caveat",
                 # train_profile runs REAL (tiny train, seconds): the
                 # device/compiler observability trajectory keys
                 "train_profile_mfu", "train_profile_compile_seconds",
@@ -182,6 +203,8 @@ def test_skip_heavy_lists_skipped_sections(tiny_bench, capsys, monkeypatch):
     assert "gateway_quota_neighbor_p99_ratio_x" in line
     # elasticity runs SHRUNK under --skip-heavy too
     assert "elasticity_compliant_p99_ratio_x" in line
+    # shm_cache runs SHRUNK under --skip-heavy too
+    assert "shm_rewarm_misses_2w_shm" in line
 
 
 @pytest.mark.perf
@@ -289,6 +312,40 @@ def test_elasticity_harness_contract_tiny():
         assert r["host_cores_caveat"] and "NOT a pin" in r["host_cores_caveat"]
     else:
         assert r["host_cores_caveat"] is None
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+@pytest.mark.shm
+def test_shm_harness_contract_tiny():
+    """bench_serving.py's real shm phase at tiny scale: spawns the
+    paired private-LRU and shared-segment pools at 1 and 2 workers,
+    drives the cached workload, scrapes the pool-wide hit ratio, and
+    runs the post-invalidation rewarm probe. The shared arm must pay
+    each probed key AT MOST what the private arm pays — sharing one
+    physical cache can only reduce pool-wide cold misses (the keys
+    BENCH_shm_rNN.json records). Slow-marked: four jax-importing
+    child processes."""
+    import bench_serving
+
+    r = bench_serving.bench_shm(
+        items=4096, clients=4, per_client=4, rounds=2, procs=1,
+        rewarm_keys=6)
+    assert r["value"] is not None and r["value"] > 0
+    assert r["host_cores"] >= 1
+    by_workers = {e["workers"]: e for e in r["per_workers"]}
+    for n in (1, 2):
+        e = by_workers[n]
+        assert e["private_qps"] > 0 and e["shm_qps"] > 0
+        assert e["private_errors"] == 0 and e["shm_errors"] == 0
+        assert e["shm_hit_ratio"] is not None and e["shm_hit_ratio"] > 0
+        # every probed key misses at least once (the invalidation took)
+        # and the shared segment never pays MORE than replicas do
+        assert e["shm_rewarm_misses"] >= r["rewarm_keys"]
+        assert e["shm_rewarm_misses"] <= e["private_rewarm_misses"]
+    # 1 worker: private and shm are the same topology — both pay each
+    # probed key exactly once
+    assert by_workers[1]["shm_rewarm_misses"] == r["rewarm_keys"]
 
 
 @pytest.mark.perf

@@ -1,0 +1,850 @@
+"""Shared-memory serving plane (PR 18): seqlock result cache over one
+``multiprocessing.shared_memory`` segment, the private LRU's user-index
+counterpart, and the pool-placement helpers.
+
+The acceptance spine:
+
+- **one physical copy**: a query served by worker A is a HIT on worker
+  B's *first* identical request (in-process pool AND real killed-worker
+  processes — the survivor serves the dead worker's answer);
+- **readers never block the writer**: a multi-process hammer (1 writer,
+  N readers, self-signed payloads) observes ZERO torn reads, and a
+  writer killed -9 mid-slot leaves a pool that keeps serving;
+- **invalidation is a stamp compare**: `/reload` bumps once per reload
+  sequence (sibling re-applies don't re-stale a re-warmed key), stale
+  epoch tokens fence in-flight puts, and per-user invalidation kills
+  exactly one user's slots pool-wide;
+- **degrade, don't die**: a garbage segment falls back to the private
+  LRU with a warning, and placement no-ops on hosts it can't help.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+import uuid
+
+import pytest
+
+# launched as `python tests/test_serving_shm.py --role ...` (the hammer
+# children): sys.path[0] is tests/, the package needs the repo root
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from predictionio_tpu.serving.placement import (  # noqa: E402
+    apply_worker_affinity,
+    assign_worker_cpus,
+)
+from predictionio_tpu.serving.result_cache import (  # noqa: E402
+    _MISS,
+    ResultCache,
+    user_fragment_of,
+)
+from predictionio_tpu.serving.shm_cache import (  # noqa: E402
+    ShmResultCache,
+    _hash64,
+    open_shm_cache,
+)
+from predictionio_tpu.utils.resilience import ManualClock  # noqa: E402
+
+pytestmark = pytest.mark.shm
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _unique_segment(tag: str) -> str:
+    return f"pio-shm-t-{tag}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+
+
+@pytest.fixture
+def segment():
+    name = _unique_segment("unit")
+    yield name
+    # belt-and-braces: a failed test must not leak /dev/shm into the
+    # next one (unlink of a never-created name is a no-op)
+    try:
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(name)
+        shm.close()
+        shm.unlink()
+    except FileNotFoundError:
+        pass
+
+
+def _signed_value(key: str, n: int) -> dict:
+    """A payload that carries its own proof of integrity: any torn or
+    interleaved read fails the signature check in the reader."""
+    blob = "x" * (50 + (n * 37) % 700)
+    sig = hashlib.sha256(f"{key}|{n}|{blob}".encode()).hexdigest()
+    return {"k": key, "n": n, "blob": blob, "sig": sig}
+
+
+def _check_signed(value: dict) -> bool:
+    try:
+        expect = hashlib.sha256(
+            f"{value['k']}|{value['n']}|{value['blob']}".encode()
+        ).hexdigest()
+        return value["sig"] == expect
+    except (KeyError, TypeError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# seqlock cache unit semantics (single process, cross-handle)
+# ---------------------------------------------------------------------------
+
+class TestShmCacheUnit:
+    def test_roundtrip_and_cross_handle_visibility(self, segment):
+        c = ShmResultCache(segment, nslots=64, slot_bytes=1024,
+                           ttl_s=300.0, create="create")
+        try:
+            hit, value, token = c.lookup('{"user":"u1"}')
+            assert not hit and value is _MISS
+            assert c.put('{"user":"u1"}', {"scores": [1, 2]},
+                         generation=token)
+            # a SECOND handle on the same segment sees the entry — the
+            # one-physical-copy property the private LRU can't have
+            c2 = ShmResultCache(segment, create="attach")
+            try:
+                hit, value, _ = c2.lookup('{"user":"u1"}')
+                assert hit and value == {"scores": [1, 2]}
+                assert c2.nslots == 64 and c2.slot_bytes == 1024
+                assert not c2.owner and c.owner
+            finally:
+                c2.close()
+            assert len(c) == 1
+            assert c.stats.count("cache_hits") == 0   # hit was c2's
+        finally:
+            c.close()
+
+    def test_attach_rejects_foreign_segment(self, segment):
+        from multiprocessing import shared_memory
+
+        raw = shared_memory.SharedMemory(segment, create=True, size=8192)
+        try:
+            with pytest.raises(ValueError, match="not a pio shm cache"):
+                ShmResultCache(segment, create="attach")
+        finally:
+            raw.close()
+            raw.unlink()
+
+    def test_ttl_expires_entries(self, segment):
+        clock = ManualClock()
+        c = ShmResultCache(segment, nslots=64, slot_bytes=1024,
+                           ttl_s=5.0, clock=clock, create="create")
+        try:
+            c.put("k", "v")
+            assert c.lookup("k")[0]
+            clock.advance(6.0)
+            assert not c.lookup("k")[0]
+            assert c.stats.count("cache_expirations") == 1
+            assert len(c) == 0
+        finally:
+            c.close()
+
+    def test_slot_collision_overwrites_and_counts_eviction(self, segment):
+        c = ShmResultCache(segment, nslots=8, slot_bytes=1024,
+                           ttl_s=300.0, create="create")
+        try:
+            # two distinct keys that direct-map to the same slot
+            keys = {}
+            a = b = None
+            for i in range(10_000):
+                k = f"key-{i}"
+                idx = _hash64(k.encode()) % c.nslots
+                if idx in keys:
+                    a, b = keys[idx], k
+                    break
+                keys[idx] = k
+            assert a is not None, "no slot collision in 10k keys?"
+            c.put(a, "va")
+            c.put(b, "vb")
+            assert not c.lookup(a)[0]          # displaced
+            assert c.lookup(b)[1] == "vb"
+            assert c.stats.count("cache_evictions") == 1
+            # same-key overwrite is NOT an eviction
+            c.put(b, "vb2")
+            assert c.stats.count("cache_evictions") == 1
+            assert c.lookup(b)[1] == "vb2"
+        finally:
+            c.close()
+
+    def test_oversize_and_unpicklable_puts_refuse(self, segment):
+        c = ShmResultCache(segment, nslots=8, slot_bytes=256,
+                           ttl_s=300.0, create="create")
+        try:
+            assert c.put("k", "x" * 4096) is False
+            assert not c.lookup("k")[0]
+            assert c.put("k", lambda: None) is False   # unpicklable
+        finally:
+            c.close()
+
+    def test_reload_invalidation_applies_once_per_sequence(self, segment):
+        """THE rewarm pin: the handling worker's bump stales the pool
+        once; every sibling's sync-loop re-apply of the SAME reload
+        sequence is a no-op, so a key re-warmed right after the bump
+        stays hot instead of dying N-1 more times."""
+        c = ShmResultCache(segment, nslots=64, slot_bytes=1024,
+                           ttl_s=300.0, create="create")
+        sibling = ShmResultCache(segment, create="attach")
+        try:
+            c.put("q", "old")
+            c.invalidate(generation=1)           # handling worker
+            assert not c.lookup("q")[0]
+            assert c.generation == 1
+            _, _, token = sibling.lookup("q")
+            assert sibling.put("q", "new", generation=token)
+            for _ in range(3):                   # sibling re-applies
+                sibling.invalidate(generation=1)
+            assert c.lookup("q")[1] == "new"     # still HOT
+            assert c.generation == 1
+            # the NEXT reload sequence is its own event again
+            c.invalidate(generation=2)
+            assert not c.lookup("q")[0]
+            assert c.generation == 2
+        finally:
+            sibling.close()
+            c.close()
+
+    def test_bare_invalidate_always_bumps(self, segment):
+        c = ShmResultCache(segment, nslots=64, slot_bytes=1024,
+                           ttl_s=300.0, create="create")
+        try:
+            c.put("q", "v")
+            c.invalidate()                       # retrieval reconfig
+            assert not c.lookup("q")[0]
+            g = c.generation
+            c.invalidate()
+            assert c.generation == g + 1
+        finally:
+            c.close()
+
+    def test_stale_epoch_put_dropped_even_after_publish_race(self, segment):
+        c = ShmResultCache(segment, nslots=64, slot_bytes=1024,
+                           ttl_s=300.0, create="create")
+        try:
+            _, _, token = c.lookup("q")
+            c.invalidate()                       # lands mid-computation
+            assert c.put("q", "pre-invalidation", generation=token) is False
+            assert not c.lookup("q")[0]
+            # per-user invalidation bumps the SAME epoch (a sibling
+            # handle proves it is segment state, not process state), so
+            # an in-flight put fenced by it dies too
+            sib = ShmResultCache(segment, create="attach")
+            try:
+                _, _, token = c.lookup("q")
+                assert c.put("q", "v", generation=token)
+                sib.invalidate_matching('"user":"nobody"')  # epoch += 1
+                _, _, t2 = c.lookup("q")
+                assert t2 == token + 1
+                assert c.put("q2", "v2", generation=token) is False
+            finally:
+                sib.close()
+        finally:
+            c.close()
+
+    def test_user_invalidation_kills_one_user_pool_wide(self, segment):
+        c = ShmResultCache(segment, nslots=128, slot_bytes=1024,
+                           ttl_s=300.0, create="create")
+        sibling = ShmResultCache(segment, create="attach")
+        try:
+            c.put('{"num":3,"user":"u1"}', "r1")
+            c.put('{"num":5,"user":"u1"}', "r2")
+            c.put('{"num":3,"user":"u2"}', "r3")
+            c.put("not-json", "r4")
+            frag = '"user":"u1"'
+            assert c.invalidate_matching(frag) == 2
+            assert not sibling.lookup('{"num":3,"user":"u1"}')[0]
+            assert not sibling.lookup('{"num":5,"user":"u1"}')[0]
+            # every OTHER user stays warm — generation untouched
+            assert sibling.lookup('{"num":3,"user":"u2"}')[1] == "r3"
+            assert sibling.lookup("not-json")[1] == "r4"
+            assert c.generation == 0
+            assert c.stats.count("cache_user_invalidations") == 2
+        finally:
+            sibling.close()
+            c.close()
+
+    def test_non_user_fragment_falls_back_to_key_scan(self, segment):
+        c = ShmResultCache(segment, nslots=64, slot_bytes=1024,
+                           ttl_s=300.0, create="create")
+        try:
+            c.put('{"item":"i9","n":1}', "a")
+            c.put('{"item":"i7","n":1}', "b")
+            assert c.invalidate_matching('"item":"i9"') == 1
+            assert not c.lookup('{"item":"i9","n":1}')[0]
+            assert c.lookup('{"item":"i7","n":1}')[0]
+        finally:
+            c.close()
+
+    def test_torn_slot_is_a_miss_and_the_next_put_recovers(self, segment):
+        """A writer killed mid-publish leaves its slot seq ODD — readers
+        treat it as a permanent miss (never an exception, never a spin)
+        and the next put on the slot resumes the even/odd protocol."""
+        c = ShmResultCache(segment, nslots=8, slot_bytes=1024,
+                           ttl_s=300.0, create="create")
+        try:
+            c.put("k", "v")
+            idx = _hash64(b"k") % c.nslots
+            off = c._slot_off(idx)
+            seq = c._u64(off)
+            c._set_u64(off, (seq + 1) | 1)       # died mid-write
+            assert not c.lookup("k")[0]
+            assert len(c) == 0
+            assert c.put("k", "v2")
+            assert c.lookup("k")[1] == "v2"
+        finally:
+            c.close()
+
+    def test_snapshot_carries_backend_and_geometry(self, segment):
+        c = ShmResultCache(segment, nslots=64, slot_bytes=2048,
+                           ttl_s=30.0, create="create")
+        try:
+            c.put("k", "v")
+            snap = c.snapshot()
+            assert snap == {
+                "size": 1, "maxEntries": 64, "ttlS": 30.0,
+                "generation": 0, "backend": "shm",
+                "segment": segment, "slotBytes": 2048,
+            }
+        finally:
+            c.close()
+
+    def test_open_shm_cache_falls_back_with_a_warning(self, segment,
+                                                      caplog):
+        from multiprocessing import shared_memory
+
+        import dataclasses
+
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        raw = shared_memory.SharedMemory(segment, create=True, size=8192)
+        try:
+            cfg = dataclasses.replace(
+                ServerConfig(), shm_cache=True, shm_segment=segment)
+            with caplog.at_level(logging.WARNING,
+                                 logger="predictionio_tpu.serving.shm_cache"):
+                assert open_shm_cache(cfg) is None
+            assert any("falling back" in r.message for r in caplog.records)
+        finally:
+            raw.close()
+            raw.unlink()
+
+
+# ---------------------------------------------------------------------------
+# the private LRU's user index (satellite: proportional invalidation)
+# ---------------------------------------------------------------------------
+
+class TestPrivateCacheUserIndex:
+    def test_user_fragment_matches_online_plane_spelling(self):
+        from predictionio_tpu.online.service import user_key_fragment
+
+        for uid in ("u1", "weird \"quote\"", "u/2", "42"):
+            key = json.dumps({"user": uid, "num": 3})
+            frag = user_fragment_of(key)
+            assert frag == user_key_fragment(uid)
+
+    def test_fragment_none_for_userless_or_non_json_keys(self):
+        assert user_fragment_of("not json") is None
+        assert user_fragment_of('{"item":"i1"}') is None
+        assert user_fragment_of('[1,2]') is None
+
+    def test_user_invalidation_uses_index_not_scan(self):
+        c = ResultCache(max_entries=64, ttl_s=300.0)
+        c.put('{"num":3,"user":"u1"}', "a")
+        c.put('{"num":5,"user":"u1"}', "b")
+        c.put('{"num":3,"user":"u2"}', "c")
+        assert set(c._tag_keys) == {'"user":"u1"', '"user":"u2"'}
+        assert c.invalidate_matching('"user":"u1"') == 2
+        assert len(c) == 1
+        assert c.lookup('{"num":3,"user":"u2"}')[0]
+        assert '"user":"u1"' not in c._tag_keys
+
+    def test_eviction_and_expiry_forget_index_entries(self):
+        clock = ManualClock()
+        c = ResultCache(max_entries=2, ttl_s=10.0, clock=clock)
+        c.put('{"user":"u1"}', "a")
+        c.put('{"user":"u2"}', "b")
+        c.put('{"user":"u3"}', "c")              # evicts u1
+        assert '"user":"u1"' not in c._tag_keys
+        clock.advance(11.0)
+        assert not c.lookup('{"user":"u2"}')[0]  # expires, forgets
+        assert '"user":"u2"' not in c._tag_keys
+        assert len(c._key_tag) == 1
+        c.invalidate()
+        assert not c._tag_keys and not c._key_tag
+
+    def test_generic_fragment_keeps_the_substring_contract(self):
+        c = ResultCache(max_entries=64, ttl_s=300.0)
+        c.put('{"item":"i9","n":1}', "a")
+        c.put('{"item":"i7","n":1}', "b")
+        assert c.invalidate_matching('"item":"i9"') == 1
+        assert len(c) == 1
+
+
+# ---------------------------------------------------------------------------
+# placement (satellite: best-effort NUMA/affinity stripes)
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_even_stripes_cover_without_overlap(self):
+        s0 = assign_worker_cpus(0, 2, range(8))
+        s1 = assign_worker_cpus(1, 2, range(8))
+        assert s0 == frozenset({0, 1, 2, 3})
+        assert s1 == frozenset({4, 5, 6, 7})
+
+    def test_uneven_remainder_goes_to_the_first_workers(self):
+        stripes = [assign_worker_cpus(i, 2, range(5)) for i in range(2)]
+        assert stripes[0] == frozenset({0, 1, 2})
+        assert stripes[1] == frozenset({3, 4})
+        # an outer cgroup restriction is respected, never widened
+        assert assign_worker_cpus(0, 2, [3, 7, 11, 15]) == frozenset({3, 7})
+
+    def test_degenerate_topologies_return_none(self):
+        assert assign_worker_cpus(0, 1, range(8)) is None   # solo worker
+        assert assign_worker_cpus(0, 4, range(2)) is None   # cpus < workers
+        assert assign_worker_cpus(5, 2, range(8)) is None   # index oob
+        assert assign_worker_cpus(-1, 2, range(8)) is None
+
+    def test_apply_pins_through_the_os_hooks(self, monkeypatch):
+        applied = {}
+        monkeypatch.setattr(os, "sched_getaffinity",
+                            lambda pid: {0, 1, 2, 3}, raising=False)
+        monkeypatch.setattr(os, "sched_setaffinity",
+                            lambda pid, cpus: applied.update(cpus=cpus),
+                            raising=False)
+        assert apply_worker_affinity(1, 2) == frozenset({2, 3})
+        assert applied["cpus"] == frozenset({2, 3})
+
+    def test_apply_degrades_on_missing_api_denied_call_small_host(
+            self, monkeypatch):
+        monkeypatch.delattr(os, "sched_getaffinity", raising=False)
+        monkeypatch.delattr(os, "sched_setaffinity", raising=False)
+        assert apply_worker_affinity(0, 2) is None          # no API
+
+        monkeypatch.setattr(os, "sched_getaffinity",
+                            lambda pid: {0}, raising=False)
+        monkeypatch.setattr(os, "sched_setaffinity",
+                            lambda pid, cpus: None, raising=False)
+        assert apply_worker_affinity(0, 2) is None          # 1-core host
+
+        def denied(pid, cpus):
+            raise OSError("EPERM")
+
+        monkeypatch.setattr(os, "sched_getaffinity",
+                            lambda pid: {0, 1, 2, 3}, raising=False)
+        monkeypatch.setattr(os, "sched_setaffinity", denied, raising=False)
+        assert apply_worker_affinity(0, 2) is None          # denied syscall
+
+    def test_apply_on_this_host_never_raises(self):
+        # whatever this CI host is (1 core or 64), best-effort means
+        # a clean answer, not an exception
+        assert apply_worker_affinity(0, 2) is None or True
+
+
+# ---------------------------------------------------------------------------
+# multi-process truth: hammer, kill -9, reattach
+# ---------------------------------------------------------------------------
+
+def _spawn_role(role: str, seg: str, **kw) -> subprocess.Popen:
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--role", role, "--segment", seg]
+    for k, v in kw.items():
+        cmd += [f"--{k.replace('_', '-')}", str(v)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(HERE))
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+class TestShmMultiProcess:
+    def test_hammer_one_writer_n_readers_zero_torn_reads(self, segment):
+        """THE seqlock criterion: concurrent readers against a live
+        writer observe hits or misses, NEVER a torn payload — every hit
+        passes the value's own signature check. Small slot table so the
+        writer keeps overwriting the very slots being read."""
+        owner = ShmResultCache(segment, nslots=16, slot_bytes=2048,
+                               ttl_s=300.0, create="create")
+        try:
+            writer = _spawn_role("writer", segment, duration=2.0, nkeys=8)
+            readers = [_spawn_role("reader", segment, duration=2.0, nkeys=8)
+                       for _ in range(2)]
+            out_w, err_w = writer.communicate(timeout=60)
+            assert writer.returncode == 0, err_w
+            puts = json.loads(out_w)["puts"]
+            assert puts > 100, f"writer too slow to prove anything: {puts}"
+            total_hits = 0
+            for r in readers:
+                out, err = r.communicate(timeout=60)
+                assert r.returncode == 0, err
+                doc = json.loads(out)
+                assert doc["torn"] == 0, doc
+                total_hits += doc["hits"]
+            assert total_hits > 0, "readers never hit a live slot"
+        finally:
+            owner.close()
+
+    def test_kill9_writer_mid_stream_pool_keeps_serving(self, segment):
+        """SIGKILL the writer while it hammers: at worst one slot is
+        left odd (a miss until overwritten); the segment stays fully
+        servable — reads don't raise, puts recover every slot."""
+        owner = ShmResultCache(segment, nslots=16, slot_bytes=2048,
+                               ttl_s=300.0, create="create")
+        try:
+            writer = _spawn_role("writer", segment, duration=60.0, nkeys=8)
+            try:
+                time.sleep(0.5)                  # mid-hammer
+                os.kill(writer.pid, signal.SIGKILL)
+            finally:
+                writer.wait(timeout=30)
+            for i in range(8):
+                owner.lookup(f"hk-{i}")          # must not raise
+            # put-then-read per key (keys can direct-map to a shared
+            # slot, where a later put legitimately displaces an earlier)
+            for i in range(8):
+                assert owner.put(f"hk-{i}", _signed_value(f"hk-{i}", i))
+                hit, value, _ = owner.lookup(f"hk-{i}")
+                assert hit and _check_signed(value)
+        finally:
+            owner.close()
+
+    def test_respawned_process_reattaches_warm(self, segment):
+        owner = ShmResultCache(segment, nslots=16, slot_bytes=2048,
+                               ttl_s=300.0, create="create")
+        try:
+            owner.put("warm-key", {"answer": 42})
+            probe = _spawn_role("probe", segment, key="warm-key")
+            out, err = probe.communicate(timeout=60)
+            assert probe.returncode == 0, err
+            doc = json.loads(out)
+            assert doc == {"hit": True, "value": {"answer": 42}}
+        finally:
+            owner.close()
+
+
+# ---------------------------------------------------------------------------
+# e2e: the serving pool on one segment
+# ---------------------------------------------------------------------------
+
+class TestShmServingPool:
+    def _pool(self, storage, seg, n=2, port=None, spool=None):
+        from tests.test_serving_workers import _worker_pool
+
+        return _worker_pool(storage, n=n, port=port, spool=spool,
+                            shm_cache=True, shm_segment=seg,
+                            shm_slots=256, shm_slot_bytes=8192)
+
+    def test_cross_worker_first_request_is_a_hit(self, storage):
+        """THE cold-start criterion: the query worker A served is a HIT
+        on worker B's FIRST identical request — one physical copy, no
+        per-worker warmup."""
+        from tests.test_serving_workers import _train
+
+        _train(storage)
+        seg = _unique_segment("pool")
+        (w1, w2), port, _ = self._pool(storage, seg)
+        try:
+            assert w1.service.cache is not w2.service.cache
+            assert w1.service.cache.snapshot()["backend"] == "shm"
+            status, p1 = w1.service.handle(
+                "POST", "/queries.json", {}, {}, {"x": 7})[:2]
+            assert status == 200
+            before = w2.service.serving_stats.count("cache_hits")
+            status, p2 = w2.service.handle(
+                "POST", "/queries.json", {}, {}, {"x": 7})[:2]
+            assert status == 200 and p2 == p1
+            assert w2.service.serving_stats.count("cache_hits") == before + 1
+            # /stats.json reports the shared backend
+            doc = w2.service.handle("GET", "/stats.json", {}, {}, None)[1]
+            assert doc["cache"]["backend"] == "shm"
+            assert doc["cache"]["segment"] == seg
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_reload_then_one_warm_request_is_hot_pool_wide(self, storage):
+        from tests.test_serving_workers import _train, wait_until
+
+        _train(storage, mult=2)
+        seg = _unique_segment("reload")
+        (w1, w2), port, _ = self._pool(storage, seg)
+        try:
+            w1.service.handle("POST", "/queries.json", {}, {}, {"x": 3})
+            _train(storage, mult=3)
+            status = w1.service.handle("GET", "/reload", {}, {}, None)[0]
+            assert status == 200
+            assert w1.service.cache.generation == 1
+            wait_until(
+                lambda: w2.service.deployed.instance.id
+                == w1.service.deployed.instance.id,
+                message="sibling adopted the reload")
+            # the reload staled the shared segment exactly once: the
+            # sibling's sync-loop re-apply didn't bump again
+            assert w2.service.cache.generation == 1
+            # ONE warm request (on the OTHER worker) re-warms the pool
+            status, fresh = w2.service.handle(
+                "POST", "/queries.json", {}, {}, {"x": 3})[:2]
+            assert status == 200
+            before = w1.service.serving_stats.count("cache_hits")
+            status, again = w1.service.handle(
+                "POST", "/queries.json", {}, {}, {"x": 3})[:2]
+            assert status == 200 and again == fresh
+            assert w1.service.serving_stats.count("cache_hits") == before + 1
+            assert w1.service.cache.generation == 1
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_stale_generation_put_dropped_through_the_segment(
+            self, storage):
+        from tests.test_serving_workers import _train
+
+        _train(storage, mult=2)
+        seg = _unique_segment("stale")
+        (w1, w2), port, _ = self._pool(storage, seg)
+        try:
+            _, _, token = w2.service.cache.lookup("q1")
+            _train(storage, mult=3)
+            w1.service.handle("GET", "/reload", {}, {}, None)
+            # the segment is shared: w2's view is staled IMMEDIATELY,
+            # no sync interval to wait out
+            assert w2.service.cache.put(
+                "q1", "old-model-answer", generation=token) is False
+            assert w2.service.cache.lookup("q1")[0] is False
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_respawned_worker_serves_hot_from_its_first_request(
+            self, storage):
+        """The respawn case the private LRU can't win: a worker joining
+        the pool attaches the SAME segment and its very first identical
+        request is already a hit — zero rewarm."""
+        from tests.test_serving_workers import _train
+
+        _train(storage)
+        seg = _unique_segment("respawn")
+        (w1, w2), port, spool = self._pool(storage, seg)
+        try:
+            status, p1 = w1.service.handle(
+                "POST", "/queries.json", {}, {}, {"x": 11})[:2]
+            assert status == 200
+            (w3,), _, _ = self._pool(storage, seg, n=1, port=port,
+                                     spool=spool)
+            try:
+                assert (w3.service.cache.generation
+                        == w1.service.cache.generation)
+                before = w3.service.serving_stats.count("cache_hits")
+                status, p3 = w3.service.handle(
+                    "POST", "/queries.json", {}, {}, {"x": 11})[:2]
+                assert status == 200 and p3 == p1
+                assert (w3.service.serving_stats.count("cache_hits")
+                        == before + 1)
+            finally:
+                w3.stop()
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_garbage_segment_boots_on_the_private_lru(self, storage):
+        from multiprocessing import shared_memory
+
+        from predictionio_tpu.api.engine_server import create_engine_server
+        from predictionio_tpu.workflow.deploy import ServerConfig
+        from tests.test_serving_workers import _train
+
+        _train(storage)
+        seg = _unique_segment("garbage")
+        raw = shared_memory.SharedMemory(seg, create=True, size=8192)
+        try:
+            server = create_engine_server(storage=storage, config=ServerConfig(
+                ip="127.0.0.1", port=0, cache_enabled=True,
+                shm_cache=True, shm_segment=seg))
+            server.start()
+            try:
+                assert isinstance(server.service.cache, ResultCache)
+                assert "backend" not in server.service.cache.snapshot()
+                # the degraded cache still works
+                server.service.cache.put("k", "v")
+                assert server.service.cache.lookup("k")[0]
+            finally:
+                server.stop()
+        finally:
+            raw.close()
+            raw.unlink()
+
+
+# ---------------------------------------------------------------------------
+# e2e chaos: real worker processes, kill -9, the dead worker's answer
+# survives in the segment
+# ---------------------------------------------------------------------------
+
+class TestShmChaosPool:
+    def test_survivor_serves_the_dead_workers_cached_answer(self):
+        """Two REAL worker processes on one segment; the worker that
+        computed a query dies -9; the survivor answers the same query
+        200 from shared memory — the payload still carries the DEAD
+        worker's pid, proving no recompute and no per-worker cold
+        start. Zero 5xx throughout."""
+        from tests.test_serving_workers import (
+            WORKER_CHILD,
+            _get_json,
+            _post_query,
+            free_port,
+            wait_until,
+        )
+
+        seg = _unique_segment("chaos")
+        owner = ShmResultCache(seg, nslots=256, slot_bytes=8192,
+                               ttl_s=300.0, create="create")
+        port = free_port()
+        spool = tempfile.mkdtemp(prefix="pio-test-shm-chaos-")
+
+        def spawn(tag):
+            return subprocess.Popen(
+                [sys.executable, WORKER_CHILD,
+                 "--port", str(port), "--spool", spool, "--tag", tag,
+                 "--shm-segment", seg])
+
+        children = [spawn("w0"), spawn("w1")]
+        try:
+            def pool_up():
+                try:
+                    return (_get_json(port, "/stats.json")
+                            ["workers"]["count"] == 2)
+                except OSError:
+                    return False
+            wait_until(pool_up, timeout=30, message="pool settled")
+
+            status, answer = _post_query(port, {"probe": 1})
+            assert status == 200
+            victim_pid = answer["pid"]
+            victim = next(c for c in children if c.pid == victim_pid)
+            os.kill(victim.pid, signal.SIGKILL)
+            victim.wait(timeout=30)
+
+            statuses = []
+            deadline = time.time() + 20.0
+            while len(statuses) < 10 and time.time() < deadline:
+                try:
+                    status, again = _post_query(port, {"probe": 1})
+                except OSError:
+                    continue                     # ripped connection
+                statuses.append(status)
+                assert status == 200
+                # the answer was computed by the CORPSE: served from
+                # the shared segment, not recomputed by the survivor
+                assert again == answer, (again, answer)
+            assert len(statuses) == 10, "survivor never settled"
+            assert all(s == 200 for s in statuses)
+        finally:
+            for c in children:
+                if c.poll() is None:
+                    c.terminate()
+            for c in children:
+                try:
+                    c.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    c.kill()
+            owner.close()
+            import shutil
+
+            shutil.rmtree(spool, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+class TestShmKnobs:
+    def test_env_defaults(self, monkeypatch):
+        from predictionio_tpu.workflow.deploy import ServerConfig
+
+        monkeypatch.setenv("PIO_SERVING_SHM", "1")
+        monkeypatch.setenv("PIO_SERVING_SHM_SLOTS", "512")
+        monkeypatch.setenv("PIO_SERVING_SHM_SLOT_BYTES", "16384")
+        monkeypatch.setenv("PIO_SERVING_SHM_SEGMENT", "pio-custom")
+        cfg = ServerConfig()
+        assert cfg.shm_cache is True
+        assert cfg.shm_slots == 512
+        assert cfg.shm_slot_bytes == 16384
+        assert cfg.shm_segment == "pio-custom"
+        monkeypatch.setenv("PIO_SERVING_SHM_SLOTS", "junk")
+        assert ServerConfig().shm_slots == 4096   # degrade, don't die
+
+    def test_deploy_parser_accepts_shm_flags(self):
+        import predictionio_tpu.workflow.cli_commands  # noqa: F401
+        from predictionio_tpu.cli.pio import _EXTRA_PARSERS, build_parser
+
+        parser = build_parser()
+        for name, configure in _EXTRA_PARSERS:
+            configure(parser.subparsers)
+        args = parser.parse_args(
+            ["deploy", "--workers", "2", "--shm-cache",
+             "--shm-slots", "512", "--shm-slot-bytes", "8192"])
+        assert args.shm_cache is True
+        assert args.shm_slots == 512
+        assert args.shm_slot_bytes == 8192
+        args = parser.parse_args(["deploy", "--no-shm-cache"])
+        assert args.shm_cache is False
+
+
+# ---------------------------------------------------------------------------
+# hammer/probe child entrypoints (subprocess roles for the tests above)
+# ---------------------------------------------------------------------------
+
+def _child_main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--role", required=True,
+                        choices=("writer", "reader", "probe"))
+    parser.add_argument("--segment", required=True)
+    parser.add_argument("--duration", type=float, default=2.0)
+    parser.add_argument("--nkeys", type=int, default=8)
+    parser.add_argument("--key", default="")
+    args = parser.parse_args()
+
+    cache = ShmResultCache(args.segment, create="attach")
+    keys = [f"hk-{i}" for i in range(args.nkeys)]
+    deadline = time.monotonic() + args.duration
+
+    if args.role == "writer":
+        puts = i = 0
+        while time.monotonic() < deadline:
+            key = keys[i % len(keys)]
+            if cache.put(key, _signed_value(key, i)):
+                puts += 1
+            i += 1
+        print(json.dumps({"puts": puts}))
+    elif args.role == "reader":
+        hits = misses = torn = i = 0
+        while time.monotonic() < deadline:
+            key = keys[i % len(keys)]
+            hit, value, _ = cache.lookup(key)
+            if not hit:
+                misses += 1
+            elif _check_signed(value) and value["k"] == key:
+                hits += 1
+            else:
+                torn += 1
+            i += 1
+        print(json.dumps({"hits": hits, "misses": misses, "torn": torn}))
+    else:
+        hit, value, _ = cache.lookup(args.key)
+        print(json.dumps({"hit": hit,
+                          "value": value if hit else None}))
+    cache.close()
+
+
+if __name__ == "__main__":
+    _child_main()

@@ -610,6 +610,96 @@ class TestCheckpointMmap:
             ckpt.load_sharded(directory, mmap_mode="r")
 
 
+class TestAnnMmap:
+    """`--model-mmap` covers the ANN payload too (PR 18): flat_vecs is
+    the index's big allocation — a full f32 copy of the item table — so
+    N pool workers must share ONE page-cache copy of it exactly like
+    the factor tables."""
+
+    @pytest.fixture(autouse=True)
+    def _force_npz(self, monkeypatch):
+        from predictionio_tpu.utils import checkpoint as ckpt
+
+        monkeypatch.setattr(ckpt, "_ocp", lambda: None)
+
+    def _save_indexed_model(self, tmp_path, monkeypatch):
+        from predictionio_tpu.models.als import ALSModel
+        from predictionio_tpu.ops import ann as ann_ops
+        from predictionio_tpu.utils.bimap import EntityIdIxMap
+
+        # force the persist-time index build on a tiny catalog
+        monkeypatch.setattr(ann_ops, "MIN_INDEX_ITEMS", 1)
+        rng = np.random.default_rng(7)
+        n_items, rank = 32, 4
+        model = ALSModel(
+            rank=rank,
+            user_factors=rng.normal(size=(5, rank)).astype(np.float32),
+            item_factors=rng.normal(
+                size=(n_items, rank)).astype(np.float32),
+            user_ids=EntityIdIxMap.from_ids(
+                [f"u{i}" for i in range(5)]),
+            item_ids=EntityIdIxMap.from_ids(
+                [f"i{i}" for i in range(n_items)]),
+            seen_by_user={},
+        )
+        directory = str(tmp_path / "model")
+        model.save(directory)
+        assert model.ann_index is not None
+        return directory, model
+
+    def _memmap_backed(self, arr) -> bool:
+        a = arr
+        while a is not None:
+            if isinstance(a, np.memmap):
+                return True
+            a = getattr(a, "base", None)
+        return False
+
+    def test_ann_payload_memmapped_under_the_knob(self, tmp_path,
+                                                  monkeypatch):
+        from predictionio_tpu.models.als import ALSModel
+
+        directory, saved = self._save_indexed_model(tmp_path, monkeypatch)
+        monkeypatch.setenv("PIO_CHECKPOINT_MMAP", "r")
+        loaded = ALSModel.load(directory)
+        assert loaded.ann_index is not None
+        # the big allocation shares pages; no private f32 copy was made
+        assert self._memmap_backed(loaded.ann_index.flat_vecs)
+        np.testing.assert_array_equal(
+            np.asarray(loaded.ann_index.flat_vecs),
+            np.asarray(saved.ann_index.flat_vecs))
+        # eager load (knob off) stays eager
+        monkeypatch.setenv("PIO_CHECKPOINT_MMAP", "off")
+        eager = ALSModel.load(directory)
+        assert not self._memmap_backed(eager.ann_index.flat_vecs)
+
+    def test_unmappable_ann_payload_falls_back_with_warning(
+            self, tmp_path, monkeypatch, caplog):
+        """A compressed ann/ payload degrades to the eager verified
+        load with the pinned warning — same fallback-don't-brick
+        contract as the factor tables."""
+        import predictionio_tpu.utils.checkpoint as ckpt
+        from predictionio_tpu.models.als import ALSModel
+
+        directory, saved = self._save_indexed_model(tmp_path, monkeypatch)
+        ann_dir = os.path.join(directory, "ann")
+        with open(os.path.join(ann_dir, "checkpoint_meta.json")) as f:
+            payload = json.load(f)["payload"]
+        arrays = saved.ann_index.to_arrays()
+        with open(os.path.join(ann_dir, payload), "wb") as f:
+            np.savez_compressed(f, **arrays)
+        monkeypatch.setenv("PIO_CHECKPOINT_MMAP", "r")
+        with caplog.at_level(logging.WARNING,
+                             logger="predictionio_tpu.utils.checkpoint"):
+            loaded = ALSModel.load(directory)
+        assert any("falling back" in r.message for r in caplog.records)
+        assert loaded.ann_index is not None
+        np.testing.assert_array_equal(
+            np.asarray(loaded.ann_index.flat_vecs),
+            np.asarray(saved.ann_index.flat_vecs))
+        assert ckpt.default_mmap_mode() == "r"
+
+
 # ---------------------------------------------------------------------------
 # knobs + observability satellites
 # ---------------------------------------------------------------------------
