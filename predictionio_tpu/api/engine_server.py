@@ -303,6 +303,15 @@ class EngineService:
 
                 self.cache = open_shm_cache(config,
                                             stats=self.serving_stats)
+                if self.cache is not None:
+                    # the pool-reload put fence (ShmResultCache
+                    # docstring): between a sibling's /reload bump and
+                    # THIS worker's own model swap (up to one admin
+                    # sync interval), local computations are old-model
+                    # results — the cache must refuse to publish them
+                    # into the new generation
+                    self.cache.model_generation_fn = (
+                        lambda: self.model_generation)
             if self.cache is None:
                 self.cache = ResultCache(
                     max_entries=config.cache_max_entries,
@@ -1469,18 +1478,22 @@ class EngineServer(RestServer):
             undeploy(ip, port, self.config.server_key)
 
     def _on_close(self) -> None:
-        # the shm cache detaches (and unlinks iff this process created
-        # the segment — the standalone case; pool workers only attach,
-        # the deploy CLI owns the pool segment's lifetime)
-        cache_close = getattr(self.service.cache, "close", None)
-        if cache_close is not None:
-            cache_close()
         if self.service.online is not None:
             self.service.online.close()
         if self.service.coherence is not None:
             self.service.coherence.close()
         if self.service.worker_hub is not None:
             self.service.worker_hub.close()
+        # the shm cache detaches (and unlinks iff this process created
+        # the segment — the standalone case; pool workers only attach,
+        # the deploy CLI owns the pool segment's lifetime) strictly
+        # AFTER the online fold-in thread and the coherence loop stop:
+        # both call into the cache (per-user invalidation, reload
+        # adoption), and releasing the segment buffer under a live
+        # caller raises mid-shutdown
+        cache_close = getattr(self.service.cache, "close", None)
+        if cache_close is not None:
+            cache_close()
         if self.service.batcher is not None:
             self.service.batcher.close()
         self.service._query_pool.shutdown(wait=False)

@@ -45,18 +45,34 @@ def assign_worker_cpus(index: int, total: int,
     return frozenset(cpu_list[start:start + size])
 
 
-def apply_worker_affinity(index: int, total: int) -> frozenset[int] | None:
+def apply_worker_affinity(index: int, total: int,
+                          cpus: Iterable[int] | None = None
+                          ) -> frozenset[int] | None:
     """Pin THIS process to its stripe; returns the applied CPU set, or
     None when the platform/topology says don't (logged at debug — this
-    is the expected outcome on 1-core CI hosts, not an error)."""
+    is the expected outcome on 1-core CI hosts, not an error).
+
+    ``cpus`` is the pool-wide allowed set to carve stripes from. The
+    deploy CLI captures it ONCE, before the parent pins itself, and
+    threads it to every worker spawn: a worker respawned by the fleet
+    supervisor inherits the (already-pinned) parent's affinity mask,
+    so reading ``sched_getaffinity`` in the child would see only the
+    parent's stripe and either refuse placement or pile every respawn
+    onto worker 0's cores. ``None`` falls back to this process's own
+    inherited mask (the pre-pin spawn path and standalone use)."""
     getter = getattr(os, "sched_getaffinity", None)
     setter = getattr(os, "sched_setaffinity", None)
-    if getter is None or setter is None:
+    if setter is None:
         return None
-    try:
-        allowed = getter(0)
-    except OSError:
-        return None
+    if cpus is not None:
+        allowed = set(cpus)
+    else:
+        if getter is None:
+            return None
+        try:
+            allowed = getter(0)
+        except OSError:
+            return None
     stripe = assign_worker_cpus(index, total, allowed)
     if stripe is None:
         logger.debug(

@@ -32,15 +32,46 @@ payload rejects any interleaved result at read time (slots are
 direct-mapped by key hash, so two writers on one slot are already a
 cache-collision overwrite).
 
+**Memory-ordering assumption (x86-TSO).** The protocol issues no
+explicit fences — CPython has no portable store barrier — and leans on
+x86's total-store-order (stores become visible in program order;
+loads are not reordered with older loads) plus the crc32 backstop.
+What that buys and what it does not:
+
+- Slot payloads can never be *served* torn on any architecture: a
+  reordered or interleaved view fails the seq re-read or the crc/key
+  check and reads as a miss (the hammer test's zero-torn criterion).
+- The epoch fence's post-publish re-check vs ``invalidate_matching``'s
+  bump-then-scan is a classic store-buffer litmus (each side stores
+  then loads the other's word): on a machine that lets a load hop its
+  own earlier store, both sides could read the pre-store value and a
+  pre-fold result could theoretically survive per-user invalidation.
+  x86-TSO forbids neither side's store-load reordering being hidden
+  from the OTHER core's later loads in the order stored, and in
+  CPython every one of these accesses brushes the GIL's own seq-cst
+  handoffs, so the window is not observable in practice; on weakly
+  ordered hosts (aarch64) it is real but bounded — a stale entry
+  outlives the fence only until the key's TTL or next overwrite.
+  Serializing the epoch word through an OS-level atomic (fcntl byte
+  lock) would close it at the cost of a syscall per put; the TTL
+  bound is the deliberate trade.
+
 Invalidation is a stamp compare, not a broadcast:
 
 - ``generation`` (header) rides the pool's shared reload sequence. A
   slot is live only while its ``gen_stamp`` equals the header
   generation, so ``invalidate()`` — `/reload` — is ONE u64 bump that
-  stales every slot at once, applied exactly once per reload sequence
+  stales every slot at once, applied once per reload sequence
   (``last_reload`` makes each sibling's sync-loop re-apply a no-op, so
   the worker that re-warms a key right after the handling worker's
-  bump leaves it HOT for the whole pool).
+  bump leaves it HOT for the whole pool). Once-per-sequence is
+  best-effort, not exactly-once: the ``last_reload`` check-then-set is
+  guarded only by each process's own ``threading.Lock``, so two
+  siblings applying the SAME sequence truly concurrently can both pass
+  the check and double-bump — over-invalidation (re-warmed keys stale
+  again), never staleness. The guarantee that matters — the common
+  sequential re-apply, each sibling's sync loop firing after the
+  handling worker's bump, is a no-op — holds regardless.
 - ``epoch`` (header) is the put-fence token ``lookup`` hands out and
   ``put`` checks — it bumps on EVERY invalidation event, including the
   per-user kind, so an in-flight computation started before the event
@@ -48,6 +79,18 @@ Invalidation is a stamp compare, not a broadcast:
   now pool-wide). ``put`` re-checks the epoch AFTER publishing and
   zaps its own slot on a lost race, closing the check-then-write
   window a cross-process cache cannot lock away.
+- The epoch alone cannot fence a computation started AFTER a reload
+  bump on a worker that has not yet swapped its own model: that
+  worker's lookup would hand out a fresh token, and its old-model
+  result would publish into the NEW generation and serve pool-wide
+  (the private per-worker cache never had this hole — each worker's
+  swap cleared exactly its own entries). ``model_generation_fn`` —
+  the engine server wires it to its live ``model_generation`` — closes
+  it: while the local model trails the segment's ``last_reload``,
+  ``lookup`` hands out a poisoned token and ``put`` refuses to
+  publish (pre-check AND post-publish re-check, same discipline as
+  the epoch fence), so pre-swap results land nowhere and the worker
+  resumes publishing the moment its own swap catches it up.
 - ``invalidate_matching(fragment)`` — the PR 14 per-user contract —
   reads the contiguous user-tag column (one u64 per slot: the hash of
   the ``"user":...`` fragment extracted from the canonical key at put
@@ -107,6 +150,11 @@ SLOT_OVERHEAD = _SLOT_HDR.size
 #: never waits on the writer, it just stops trying
 _READ_RETRIES = 3
 
+#: the poisoned epoch token ``lookup`` hands out while this worker's
+#: model trails the pool's reload sequence: the header epoch is a u64,
+#: so -1 can never equal it and the eventual ``put`` is always fenced
+_STALE_TOKEN = -1
+
 
 def _hash64(data: bytes) -> int:
     """Stable 64-bit key/tag hash — processes must agree, so the
@@ -137,6 +185,13 @@ class ShmResultCache:
         self.ttl_s = ttl_s
         self.stats = stats or ServingStats()
         self._clock = clock
+        #: the pool-reload put fence (module docstring): the engine
+        #: server points this at its live ``model_generation`` so a
+        #: worker that has not yet swapped after a sibling's /reload
+        #: cannot publish old-model results into the new generation.
+        #: None (bare handles, tests, single-process deploys where
+        #: ``last_reload`` never moves) means no fence.
+        self.model_generation_fn = None
         # serializes THIS process's threads; cross-process coordination
         # is the seqlock protocol itself (module docstring)
         self._lock = threading.Lock()
@@ -202,6 +257,19 @@ class ShmResultCache:
     def generation(self) -> int:
         return self._u64(_OFF_GENERATION)
 
+    @property
+    def last_reload(self) -> int:
+        """The highest pool reload sequence applied to the segment."""
+        return self._u64(_OFF_LAST_RELOAD)
+
+    def _worker_lags(self) -> bool:
+        """True while THIS worker's model trails the pool's applied
+        reload sequence — the window between a sibling's /reload bump
+        and this worker's own model swap, when local computations are
+        old-model results that must not publish (module docstring)."""
+        fn = self.model_generation_fn
+        return fn is not None and fn() < self._u64(_OFF_LAST_RELOAD)
+
     # ---- slot helpers ---------------------------------------------------
 
     def _slot_off(self, idx: int) -> int:
@@ -235,8 +303,14 @@ class ShmResultCache:
         now = self._clock.monotonic()
         # the token must be read BEFORE the slot so it is conservative:
         # an invalidation between here and the payload copy makes the
-        # eventual put stale, never fresh
-        token = self._u64(_OFF_EPOCH)
+        # eventual put stale, never fresh. A worker whose model trails
+        # the pool's reload sequence gets a POISONED token: the miss it
+        # is about to take would be recomputed with the OLD model, and
+        # that result must never publish into the new generation (hits
+        # are still served — live slots were stamped by caught-up
+        # workers, so their values are new-model results)
+        token = (_STALE_TOKEN if self._worker_lags()
+                 else self._u64(_OFF_EPOCH))
         for _ in range(_READ_RETRIES):
             seq0 = self._u64(off)
             if seq0 & 1 or seq0 == 0:
@@ -289,6 +363,11 @@ class ShmResultCache:
             if (generation is not None
                     and generation != self._u64(_OFF_EPOCH)):
                 return False               # computed before an invalidation
+            if self._worker_lags():
+                # this worker's model trails the pool's reload
+                # sequence: the value was computed with the OLD model
+                # (also catches direct puts that never took a token)
+                return False
             seq0 = self._u64(off)
             if seq0 and not seq0 & 1:
                 old_hash = _SLOT_HDR.unpack_from(self._buf, off)[2]
@@ -307,10 +386,13 @@ class ShmResultCache:
                       off + SLOT_OVERHEAD + len(payload)] = payload
             self._set_u64(self._tag_off(idx), tag_hash)
             self._set_u64(off, ((seq0 + 1) | 1) + 1)
-            if (generation is not None
-                    and generation != self._u64(_OFF_EPOCH)):
-                # an invalidation landed between the pre-check and the
-                # publish: un-publish rather than serve a fenced result
+            if ((generation is not None
+                    and generation != self._u64(_OFF_EPOCH))
+                    or self._worker_lags()):
+                # an invalidation (or a sibling's reload bump this
+                # worker has not swapped for) landed between the
+                # pre-check and the publish: un-publish rather than
+                # serve a fenced result
                 self._zap(idx)
                 return False
             return True
@@ -318,11 +400,19 @@ class ShmResultCache:
     def invalidate(self, generation: int | None = None) -> None:
         """One header bump stales every slot (stamp compare — no
         broadcast, no slot walk). With ``generation`` (the pool's
-        shared reload sequence) the bump applies exactly ONCE per
-        sequence: the segment is shared, so the handling worker's bump
-        already invalidated for every sibling, and each sibling's
-        sync-loop re-apply must not re-stale the keys the pool just
-        re-warmed. Without it (single-process ``/reload``, retrieval
+        shared reload sequence) the bump applies ONCE per sequence:
+        the segment is shared, so the handling worker's bump already
+        invalidated for every sibling, and each sibling's sync-loop
+        re-apply must not re-stale the keys the pool just re-warmed.
+        Once is best-effort across processes — ``self._lock`` only
+        serializes this process's threads, so two siblings applying
+        the same sequence truly concurrently can both pass the
+        ``last_reload`` check and double-bump. That over-invalidates
+        (keys warmed between the bumps stale again — the safe
+        direction, never staleness), and the case the no-op exists
+        for — each sibling's sync loop re-applying AFTER the handling
+        worker's bump — is sequential and stays a no-op. Without
+        ``generation`` (single-process ``/reload``, retrieval
         reconfig) every call is its own event."""
         with self._lock:
             if generation is not None:

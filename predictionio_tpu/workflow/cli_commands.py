@@ -360,8 +360,11 @@ def _deploy_worker(config) -> None:
     from predictionio_tpu.storage.registry import Storage
 
     # before the model loads, so its pages fault in on the pinned
-    # cores; a respawn re-applies (the index rides the config)
-    apply_worker_affinity(config.worker_index, max(1, config.workers))
+    # cores; a respawn re-applies (the index rides the config, and the
+    # stripe is carved from the CLI's pre-pin CPU snapshot — a respawn
+    # inherits the PINNED parent's mask, which must not narrow ours)
+    apply_worker_affinity(config.worker_index, max(1, config.workers),
+                          cpus=config.cpu_allowlist)
     server = create_engine_server(storage=Storage.default(), config=config)
     try:
         server.serve_forever()
@@ -478,6 +481,21 @@ def _cmd_deploy(args, storage) -> int:
                   f"to private result caches")
             config = dataclasses.replace(config, shm_cache=False)
 
+    # capture the pool's allowed-CPU set BEFORE the parent pins itself
+    # to stripe 0: a supervisor respawn happens after that pin, and the
+    # child would inherit (and carve from) the parent's one-stripe
+    # mask — every respawn piling onto worker 0's cores is the exact
+    # opposite of the placement intent
+    from predictionio_tpu.serving.placement import apply_worker_affinity
+
+    getaffinity = getattr(os, "sched_getaffinity", None)
+    try:
+        allowed_cpus = (tuple(sorted(getaffinity(0)))
+                        if getaffinity is not None else None)
+    except OSError:
+        allowed_cpus = None
+    config = dataclasses.replace(config, cpu_allowlist=allowed_cpus)
+
     def sibling(index: int):
         return multiprocessing.Process(
             target=_deploy_worker,
@@ -519,9 +537,8 @@ def _cmd_deploy(args, storage) -> int:
                 proc.start()
                 worker_procs.append(proc)
         # the parent is worker 0 of the pool: pin it to its own stripe
-        from predictionio_tpu.serving.placement import apply_worker_affinity
-
-        apply_worker_affinity(0, workers)
+        # (carved from the same pre-pin snapshot the workers use)
+        apply_worker_affinity(0, workers, cpus=config.cpu_allowlist)
         server = create_engine_server(storage=storage, config=config)
         print(f"[INFO] Engine instance "
               f"{server.service.deployed.instance.id} listening on "

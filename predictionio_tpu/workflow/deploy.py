@@ -201,6 +201,13 @@ class ServerConfig:
     #: of the available cores, degrade-don't-die on hosts with fewer
     #: cores than workers
     worker_index: int = 0
+    #: the pool-wide allowed-CPU set, captured by the deploy CLI
+    #: BEFORE the parent pins itself to stripe 0 and threaded to every
+    #: worker spawn: a supervisor respawn inherits the parent's
+    #: already-narrowed affinity mask, so the child must carve its
+    #: stripe from this snapshot, not from sched_getaffinity. None =
+    #: carve from the process's own inherited mask.
+    cpu_allowlist: tuple[int, ...] | None = None
     #: bind with SO_REUSEPORT so the N worker processes share the port
     #: (set by the CLI when workers > 1)
     reuse_port: bool = False

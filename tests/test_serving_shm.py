@@ -252,6 +252,43 @@ class TestShmCacheUnit:
         finally:
             c.close()
 
+    def test_lagging_worker_put_fenced_until_its_own_swap(self, segment):
+        """THE pool reload coherence pin: between the handling worker's
+        bump and a sibling's own model swap, the sibling's fresh-token
+        computations are OLD-model results — they must not publish into
+        the new generation (the epoch fence alone only catches
+        computations begun BEFORE the bump)."""
+        c = ShmResultCache(segment, nslots=64, slot_bytes=1024,
+                           ttl_s=300.0, create="create")
+        sibling = ShmResultCache(segment, create="attach")
+        model_gen = {"c": 0, "s": 0}
+        c.model_generation_fn = lambda: model_gen["c"]
+        sibling.model_generation_fn = lambda: model_gen["s"]
+        try:
+            c.put("q", "seq0-answer")
+            model_gen["c"] = 1                   # handling worker swapped
+            c.invalidate(generation=1)           # ...and bumped the pool
+            # the sibling's model is still OLD; its post-bump lookup
+            # hands out a poisoned token, so the old-model recompute
+            # cannot publish — with or without a token
+            hit, _, token = sibling.lookup("q")
+            assert not hit
+            assert sibling.put("q", "old-model", generation=token) is False
+            assert sibling.put("q", "old-model") is False
+            assert not c.lookup("q")[0]
+            # hits are still SERVED while lagging: live slots were
+            # stamped by caught-up workers (new-model results)
+            assert c.put("warm", "new-model-warm")
+            assert sibling.lookup("warm")[1] == "new-model-warm"
+            # the sibling's own swap restores publishing
+            model_gen["s"] = 1
+            _, _, token = sibling.lookup("q")
+            assert sibling.put("q", "new-model", generation=token)
+            assert c.lookup("q")[1] == "new-model"
+        finally:
+            sibling.close()
+            c.close()
+
     def test_user_invalidation_kills_one_user_pool_wide(self, segment):
         c = ShmResultCache(segment, nslots=128, slot_bytes=1024,
                            ttl_s=300.0, create="create")
@@ -424,6 +461,23 @@ class TestPlacement:
                             raising=False)
         assert apply_worker_affinity(1, 2) == frozenset({2, 3})
         assert applied["cpus"] == frozenset({2, 3})
+
+    def test_explicit_cpus_override_the_inherited_mask(self, monkeypatch):
+        """A supervisor respawn inherits the PINNED parent's one-stripe
+        mask; the deploy CLI's pre-pin snapshot (threaded through
+        config) must win over sched_getaffinity in the child."""
+        applied = {}
+        monkeypatch.setattr(os, "sched_getaffinity",
+                            lambda pid: {0}, raising=False)  # parent's stripe
+        monkeypatch.setattr(os, "sched_setaffinity",
+                            lambda pid, cpus: applied.update(cpus=cpus),
+                            raising=False)
+        assert apply_worker_affinity(1, 2,
+                                     cpus=(0, 1, 2, 3)) == frozenset({2, 3})
+        assert applied["cpus"] == frozenset({2, 3})
+        # without the snapshot, the inherited one-core mask refuses
+        # placement outright — the respawn would stay on worker 0's core
+        assert apply_worker_affinity(1, 2) is None
 
     def test_apply_degrades_on_missing_api_denied_call_small_host(
             self, monkeypatch):
@@ -603,6 +657,67 @@ class TestShmServingPool:
         finally:
             w1.stop()
             w2.stop()
+
+    def test_lagging_sibling_never_publishes_old_model_answers(
+            self, storage):
+        """The service-level half of the coherence pin: a sibling that
+        has NOT yet adopted a pool /reload still answers (last-known-
+        good), but its old-model answer must not warm the shared
+        segment — the next request on the reloaded worker recomputes
+        with the NEW model instead of hitting a stale entry."""
+        from predictionio_tpu.api.engine_server import create_engine_server
+        from predictionio_tpu.workflow.deploy import ServerConfig
+        from tests.test_serving_workers import _train, free_port
+
+        _train(storage, mult=2)
+        seg = _unique_segment("lag")
+        port = free_port()
+        spool = tempfile.mkdtemp(prefix="pio-test-shm-lag-")
+        servers = []
+        for _ in range(2):
+            cfg = ServerConfig(
+                ip="127.0.0.1", port=port, reuse_port=True,
+                worker_spool_dir=spool,
+                # the hole under test IS the pre-adoption window: park
+                # the sync loop so the sibling stays on the old model
+                admin_sync_interval_s=3600.0,
+                cache_enabled=True, cache_ttl_s=300.0,
+                shm_cache=True, shm_segment=seg,
+                shm_slots=256, shm_slot_bytes=8192)
+            server = create_engine_server(storage=storage, config=cfg)
+            server.start()
+            servers.append(server)
+        w1, w2 = servers
+        try:
+            # the server wired the fence to its live model state
+            assert (w2.service.cache.model_generation_fn()
+                    == w2.service.model_generation)
+            _train(storage, mult=3)
+            assert w1.service.handle("GET", "/reload", {}, {}, None)[0] == 200
+            assert w1.service.cache.last_reload == 1
+            # the lagging sibling answers from its OLD model (mult=2:
+            # last-known-good semantics) ...
+            status, stale = w2.service.handle(
+                "POST", "/queries.json", {}, {}, {"x": 3})[:2]
+            assert status == 200 and stale["value"] == 6
+            # ... but the reloaded worker must RECOMPUTE (no hit on a
+            # poisoned entry) and serve the NEW model's answer
+            before = w1.service.serving_stats.count("cache_hits")
+            status, fresh = w1.service.handle(
+                "POST", "/queries.json", {}, {}, {"x": 3})[:2]
+            assert status == 200 and fresh["value"] == 9
+            assert w1.service.serving_stats.count("cache_hits") == before
+            # the new-model answer DID warm the pool — including the
+            # still-lagging sibling, which serves the shared hit
+            status, served = w2.service.handle(
+                "POST", "/queries.json", {}, {}, {"x": 3})[:2]
+            assert status == 200 and served["value"] == 9
+        finally:
+            w1.stop()
+            w2.stop()
+            import shutil
+
+            shutil.rmtree(spool, ignore_errors=True)
 
     def test_stale_generation_put_dropped_through_the_segment(
             self, storage):
