@@ -896,6 +896,22 @@ def bench_train_profile():
     }
 
 
+def bench_train_sharding(shrunk: bool = False):
+    """DP×MP factor-table sharding on the fused ALS flagship path —
+    the ROADMAP item 1 trajectory (standalone harness:
+    bench_sharding.py; committed artifacts: BENCH_sharding_rNN.json).
+    Runs in a forced-8-device subprocess child (this process owns a
+    1-device jax runtime): replicated-vs-sharded MFU/HBM at matched
+    shapes from TRAIN_REPORT.json (honest-or-null on CPU) plus
+    computed per-device table bytes, the factor-parity max |Δ|, and
+    the rank-512 sharded-only point against the stated per-device
+    budget. Under --skip-heavy it runs shrunk (tiny shapes, same
+    contract)."""
+    import bench_sharding
+
+    return bench_sharding.bench_sharding_section(shrunk=shrunk)
+
+
 def bench_batch_predict(n_items: int = 2_000_000, batch: int = 256,
                         rounds: int = 8):
     """Batched top-k scoring against a 2M-item catalog — the eval hot
@@ -1343,6 +1359,8 @@ def main() -> None:
         ("elasticity",
          lambda: bench_elasticity_section(shrunk=args.skip_heavy)),
         ("train_profile", bench_train_profile),
+        ("train_sharding",
+         lambda: bench_train_sharding(shrunk=args.skip_heavy)),
     ]
     failed = []
     if args.skip_heavy:
@@ -1360,9 +1378,12 @@ def main() -> None:
         # backends + a ManualClock timeline, no device involvement
         # shm_cache rides along shrunk: subprocess serving pools +
         # loopback HTTP + one POSIX shm segment, no device involvement
+        # train_sharding rides along shrunk: a seconds-scale forced-8-
+        # device subprocess child (tiny matched-shape parity + a small
+        # sharded point — same contract as the full artifact)
         keep = ("quality", "ingest", "data_plane", "ann_retrieval",
                 "workers_scaling", "freshness", "train_profile",
-                "gateway", "elasticity", "shm_cache")
+                "gateway", "elasticity", "shm_cache", "train_sharding")
         failed.extend(s[0] for s in sections if s[0] not in keep)
         sections = [s for s in sections if s[0] in keep]
     for section, fn in sections:

@@ -281,6 +281,12 @@ def default_config() -> LintConfig:
                     # routed through one of these is pinned to the
                     # BATCH_WIDTHS/_K_WIDTHS menus and cannot drift
                     "snap_calls": ["serving_k", "serving_batch"],
+                    # factory-backed jit wrappers: plain functions whose
+                    # named params compile-key a cached jit program
+                    # (ops/topk._sharded_topk_fn behind the sharded
+                    # serving dispatch) — same per-call-drift check as
+                    # decorator-declared static args
+                    "extra_entries": {"recommend_topk_sharded": ["k"]},
                 },
             ),
         },

@@ -18,6 +18,14 @@ this rule flags:
   via ``asarray``/``array``/``stack`` — its shape varies with the
   comprehension length, recompiling per batch size; pad through the
   width menus instead.
+
+Some jit programs hide behind a cached FACTORY instead of a decorator —
+``ops/topk._sharded_topk_fn`` builds its shard_map program keyed on
+``(mesh, k, shard_rows)``, so every distinct ``k`` reaching the plain
+wrapper ``recommend_topk_sharded`` mints a compile exactly like a
+static arg would, invisibly to the decorator scan. The
+``extra_entries`` option (function name → list of jit-static parameter
+names) extends the same call-site classification over those wrappers.
 """
 
 from __future__ import annotations
@@ -73,6 +81,49 @@ class JitRecompileRiskRule(ProjectRule):
                         "recompiling per batch size; pad to a width menu "
                         "(ops/topk BATCH_WIDTHS discipline) first",
                         arg.col_offset))
+        extra = {str(name): tuple(statics) for name, statics in
+                 (options.get("extra_entries") or {}).items()}
+        if extra:
+            findings.extend(self._check_extra_entries(project, extra, snaps))
+        return findings
+
+    def _check_extra_entries(self, project: ProjectModel,
+                             extra: dict[str, tuple[str, ...]],
+                             snaps: tuple[str, ...]) -> list[Finding]:
+        """Call-site classification for factory-backed jit wrappers
+        (module docstring): the wrapper is a plain function, so its
+        call edges are in ``unit.calls`` rather than
+        ``jit_call_sites``; the configured params compile-key the
+        cached program exactly like static args."""
+        findings: list[Finding] = []
+        for unit in project.functions.values():
+            for edge in unit.calls:
+                if not isinstance(edge.node, ast.Call):
+                    continue                # property-read edge
+                if edge.callee in project.jit_entries:
+                    continue                # already covered above
+                target = project.functions.get(edge.callee)
+                if target is None or target.name not in extra:
+                    continue
+                statics = extra[target.name]
+                params = tuple(a.arg for a in
+                               (list(target.node.args.posonlyargs)
+                                + list(target.node.args.args)))
+                for param, arg in self._bind(params, edge.node):
+                    if param not in statics:
+                        continue
+                    if self._classify(project, unit, arg, snaps,
+                                      0) == _RISKY:
+                        findings.append(Finding(
+                            self.rule_id, unit.module, arg.lineno,
+                            f"compile-keyed parameter '{param}' of "
+                            f"{target.name}() ({target.module}) receives "
+                            "a per-call-varying value — the cached jit "
+                            "factory behind it compiles a fresh program "
+                            "per distinct value; snap it to a width menu "
+                            "(e.g. ops/topk serving_k/serving_batch) or "
+                            "hoist it to a constant",
+                            arg.col_offset))
         return findings
 
     @staticmethod

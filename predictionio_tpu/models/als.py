@@ -41,6 +41,87 @@ _SEEN_PAD = 512
 _ANN_SUBDIR = "ann"
 
 
+def _model_shard_ways(arr) -> int:
+    """How many ways a factor table is row-sharded over a ``"model"``
+    mesh axis — 1 for replicated/host/NumPy tables. Duck-typed over the
+    array's ``.sharding`` so host arrays and single-device jax.Arrays
+    (SingleDeviceSharding has no mesh) all answer 1."""
+    sharding = getattr(arr, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    spec = getattr(sharding, "spec", None)
+    axes = dict(getattr(mesh, "shape", None) or {})
+    if not axes or spec is None or not len(spec):
+        return 1
+    dim0 = spec[0]
+    names = dim0 if isinstance(dim0, tuple) else (dim0,)
+    if "model" not in names:
+        return 1
+    return int(axes.get("model", 1))
+
+
+def _serving_shard_ways(n_items: int, n_devices: int) -> int:
+    """The model-axis width a deployed catalog of ``n_items`` rows can
+    shard over: the largest device count whose shards come out equal
+    (``device_put`` rejects uneven NamedShardings). 1 = stay
+    replicated."""
+    for ways in range(min(n_devices, n_items), 1, -1):
+        if n_items % ways == 0:
+            return ways
+    return 1
+
+
+def _resolve_serving_shardings(meta: Mapping, mesh) -> dict | None:
+    """Target shardings for :meth:`ALSModel.load` (None = replicated).
+
+    Sharded serving engages when the caller passes a ``mesh``, when the
+    checkpoint meta says the model was persisted sharded, or when
+    ``PIO_SERVING_SHARD_FACTORS=1`` forces it; ``=0`` vetoes all three.
+    The item table MUST divide the model axis (the sharded top-k
+    dispatch is shard_map-even); a table that doesn't stays replicated
+    with a warning rather than failing the deploy."""
+    env = os.environ.get("PIO_SERVING_SHARD_FACTORS", "").strip().lower()
+    if env in ("0", "false", "off", "no"):
+        return None
+    if not (mesh is not None or "sharded" in meta
+            or env in ("1", "true", "on", "yes")):
+        return None
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    n_users = len(meta["user_ids"])
+    n_items = len(meta["item_ids"])
+    if mesh is None:
+        devices = jax.devices()
+        ways = _serving_shard_ways(n_items, len(devices))
+        if ways <= 1:
+            logger.warning(
+                "sharded serving requested but the catalog (%d rows) has "
+                "no >=2-way even split over %d device(s); serving "
+                "replicated", n_items, len(devices))
+            return None
+        # all devices on the model axis: per-device table footprint is
+        # 1/ways, and a data axis of 1 admits every query batch size
+        mesh = Mesh(np.asarray(devices[:ways]).reshape(1, ways),
+                    ("data", "model"))
+    axes = dict(mesh.shape)
+    ways = int(axes.get("model", 1))
+    if ways <= 1 or n_items % ways:
+        logger.warning(
+            "item table (%d rows) cannot row-shard over the mesh model "
+            "axis (%d); serving replicated", n_items, ways)
+        return None
+    row_sharded = NamedSharding(mesh, PartitionSpec("model", None))
+    shardings = {"item": row_sharded}
+    if n_users % ways == 0:
+        shardings["user"] = row_sharded
+    else:
+        logger.warning(
+            "user table (%d rows) does not divide the model axis (%d); "
+            "user factors stay replicated", n_users, ways)
+    logger.info("restoring factor tables row-sharded %d-way over the "
+                "model axis (sharded top-k serving dispatch)", ways)
+    return shardings
+
+
 @_partial(instrumented_jit, static_argnames=("k",))
 def _serve_recommend(user_factors, item_f, packed, allow, k):
     """Single-dispatch, single-transfer serving path.
@@ -185,8 +266,10 @@ class ALSModel:
         point); a catalog too small to index degrades to brute with a
         warning instead of failing the deploy."""
         if mode == "ann" and self.ann_index is None:
-            built = ann_ops.build_index(np.asarray(self.item_factors),
-                                        nlist=nlist)
+            # build_index gathers sharded/device tables to host itself
+            # (chunked, with a pinned warning) — no eager np.asarray
+            # here, which would replicate a row-sharded table silently
+            built = ann_ops.build_index(self.item_factors, nlist=nlist)
             if built is None:
                 logger.warning(
                     "retrieval=ann requested but the catalog has only %d "
@@ -247,6 +330,26 @@ class ALSModel:
     def _ann_active(self) -> bool:
         return self.retrieval == "ann" and self.ann_index is not None
 
+    @property
+    def factor_shard_ways(self) -> int:
+        """Model-axis row-shard width of the deployed item table (1 =
+        replicated) — the `/stats.json` / deploy-log signal for whether
+        queries dispatch through the distributed top-k merge."""
+        return _model_shard_ways(self.item_factors)
+
+    def _serving_mesh(self):
+        """The mesh to run :func:`ops.topk.recommend_topk_sharded` over
+        when the deployed item table is row-sharded over a ``"model"``
+        axis > 1 and the catalog divides it — else None (brute/flat
+        dispatch). Sharded tables whose row count stopped dividing the
+        axis (it cannot happen through :meth:`load`, which picks the
+        axis from the row count) degrade to the flat path rather than
+        raising out of the serving loop."""
+        ways = _model_shard_ways(self.item_factors)
+        if ways <= 1 or int(self.item_factors.shape[0]) % ways:
+            return None
+        return self.item_factors.sharding.mesh
+
     def _ann_args(self) -> tuple:
         """(device arrays..., nprobe, rescore) for the jitted kernels —
         nprobe clamped to the index so the static args are always
@@ -300,6 +403,21 @@ class ALSModel:
             seen = seen[:_SEEN_PAD]
         allow_v = self._allow_or_default(allow)
         k = min(_serving_k(num), self.item_factors.shape[0])
+        mesh = None if self._ann_active() else self._serving_mesh()
+        if mesh is not None:
+            # deployed-sharded dispatch: the distributed top-k merge
+            # moves n_model*k candidates over ICI instead of gathering
+            # the row-sharded table for a (1, I) score row
+            cols = np.zeros((1, _SEEN_PAD), dtype=np.int32)
+            mask = np.zeros((1, _SEEN_PAD), dtype=np.float32)
+            cols[0, : len(seen)] = seen
+            mask[0, : len(seen)] = 1.0
+            uv = self.user_factors[jnp.asarray([uix], dtype=jnp.int32)]
+            vals, idxs = topk_ops.recommend_topk_sharded(
+                uv, self.item_factors, jnp.asarray(cols),
+                jnp.asarray(mask), allow_v, k, mesh)
+            return self._gather_results(
+                np.asarray(vals)[0], np.asarray(idxs)[0], num)
         buf = np.zeros((1 + 2 * _SEEN_PAD,), dtype=np.int32)
         buf[0] = uix
         buf[1 : 1 + len(seen)] = seen
@@ -487,6 +605,15 @@ class ALSModel:
                 self.ann_index.shortlist_width(nprobe, rescore),
                 int(uv.shape[0]))
             return vals, idxs
+        mesh = self._serving_mesh()
+        if mesh is not None and allow_v.ndim == 1:
+            # deployed-sharded dispatch (docs/parallelism.md): local
+            # top-k per model shard, candidate all-gather, global merge
+            return topk_ops.recommend_topk_sharded(
+                uv, self.item_factors,
+                jnp.asarray(np.asarray(seen_cols, dtype=np.int32)),
+                jnp.asarray(np.asarray(seen_mask, dtype=np.float32)),
+                allow_v, k, mesh)
         return topk_ops.recommend_topk_fused(
             uv, self.item_factors,
             # NumPy stays NumPy on purpose: the dispatcher's host-side
@@ -550,11 +677,18 @@ class ALSModel:
                 nlist = int(os.environ.get("PIO_SERVING_ANN_NLIST", "0"))
             except ValueError:
                 nlist = 0
-            self.ann_index = ann_ops.build_index(
-                np.asarray(self.item_factors), nlist=nlist)
+            # build_index gathers sharded tables to host itself
+            # (chunked per-shard device_get, pinned warning)
+            self.ann_index = ann_ops.build_index(self.item_factors,
+                                                 nlist=nlist)
         if self.ann_index is not None:
             save_sharded(os.path.join(directory, _ANN_SUBDIR),
                          self.ann_index.to_arrays())
+        # a model trained with shard_factors persists the fact: load()
+        # reads it to restore straight onto a serving mesh (row-sharded
+        # tables, sharded top-k dispatch) instead of replicating
+        ways = max(_model_shard_ways(self.user_factors),
+                   _model_shard_ways(self.item_factors))
         meta = {
             "rank": self.rank,
             "user_ids": self.user_ids.id_to_ix.to_dict(),
@@ -563,19 +697,37 @@ class ALSModel:
             **({"ann": {"nlist": self.ann_index.nlist,
                         "n_items": self.ann_index.n_items}}
                if self.ann_index is not None else {}),
+            **({"sharded": {"axis": "model", "ways": ways}}
+               if ways > 1 else {}),
         }
         with open(os.path.join(directory, "model.json"), "w") as f:
             json.dump(meta, f)
 
     @staticmethod
-    def load(directory: str, shardings: dict | None = None) -> "ALSModel":
+    def load(directory: str, shardings: dict | None = None,
+             mesh=None) -> "ALSModel":
         """``shardings`` optionally maps "user"/"item" to target
-        ``NamedSharding``s so factors restore straight onto a mesh."""
+        ``NamedSharding``s so factors restore straight onto a mesh.
+
+        ``mesh`` is the higher-level knob: row-shard both tables over
+        its ``"model"`` axis (tables whose row count does not divide
+        the axis stay replicated, with a warning — degrade-don't-die).
+        With neither argument, a model *persisted* sharded (``sharded``
+        in model.json — it was trained with ``shardFactors``) restores
+        straight back onto a serving mesh over the available devices,
+        so `pio deploy` serves it through the sharded top-k dispatch
+        without any template change; ``PIO_SERVING_SHARD_FACTORS=1``
+        forces that for replicated-persisted models too (a grown
+        catalog that stopped fitting), ``=0`` disables it."""
         from predictionio_tpu.utils.checkpoint import (
             default_mmap_mode,
             load_sharded,
         )
 
+        with open(os.path.join(directory, "model.json")) as f:
+            meta = json.load(f)
+        if shardings is None:
+            shardings = _resolve_serving_shardings(meta, mesh)
         # an orbax dir without meta means a crash interrupted save() after
         # the checkpoint write — still newer than any legacy factors.npz
         has_new = os.path.exists(
@@ -586,16 +738,23 @@ class ALSModel:
             legacy = np.load(os.path.join(directory, "factors.npz"))
             data = {"user": legacy["user"], "item": legacy["item"]}
             if shardings:
-                import jax
-
                 data = {
                     k: jax.device_put(v, shardings[k]) if k in shardings else v
                     for k, v in data.items()
                 }
         else:
             data = load_sharded(directory, shardings=shardings)
-        with open(os.path.join(directory, "model.json")) as f:
-            meta = json.load(f)
+            if not shardings:
+                # orbax restores a sharded-persisted checkpoint with
+                # its SAVED layout when no target is given; a vetoed
+                # (PIO_SERVING_SHARD_FACTORS=0) or degraded resolution
+                # means replicated, so gather any sharded table to host
+                # and let the constructor re-put it on the default
+                # device
+                data = {
+                    k: np.asarray(v) if _model_shard_ways(v) > 1 else v
+                    for k, v in data.items()
+                }
         ann_index = None
         if "ann" in meta:
             # the meta names an index: a missing/corrupt ann/ payload is

@@ -38,6 +38,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import math
+import os
 from functools import partial
 from typing import Sequence
 
@@ -1299,6 +1300,22 @@ def solve_half(
 class ALSFactors:
     user: jax.Array  # (num_users, K)
     item: jax.Array  # (num_items, K)
+
+
+def resolve_shard_factors(param: bool) -> bool:
+    """The engine-params ``shardFactors`` knob with its fleet-wide env
+    override applied: ``PIO_TRAIN_SHARD_FACTORS=1`` forces DP×MP factor
+    sharding on (retraining a grown catalog without editing every
+    engine.json), ``=0`` forces replicated (an incident lever — sharded
+    training needs a healthy multi-device mesh), unset defers to the
+    param. All the ALS-family templates route through here so the env
+    contract cannot drift between them (docs/parallelism.md)."""
+    raw = os.environ.get("PIO_TRAIN_SHARD_FACTORS", "").strip().lower()
+    if raw in ("1", "true", "on", "yes"):
+        return True
+    if raw in ("0", "false", "off", "no"):
+        return False
+    return bool(param)
 
 
 def als_train(

@@ -49,6 +49,7 @@ fresh compile behind the micro-batcher.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from functools import partial
 from typing import Any, Mapping
@@ -60,6 +61,8 @@ import numpy as np
 from predictionio_tpu.obs.compile import instrumented_jit
 
 from predictionio_tpu.ops.topk import NEG_INF
+
+logger = logging.getLogger(__name__)
 
 #: below this catalog size the flat matmul beats any probe+gather trip
 #: and the index is pure overhead — build refuses, serving falls back
@@ -288,6 +291,57 @@ def _assign_balanced(x: np.ndarray, centroids: np.ndarray, cap: int,
     return assign
 
 
+#: rows per device_get chunk when the index build must gather a
+#: sharded factor table to host — bounds the staging buffer to
+#: ~chunk*rank*4 bytes (64 MiB at rank 512) regardless of table size
+_GATHER_CHUNK_ROWS = 32768
+
+
+def _host_vectors(item_f: Any) -> np.ndarray:
+    """The item-factor table as host float32 rows, WITHOUT assuming it
+    already lives on the host. Three sources, three behaviors:
+
+    - plain ndarray / ``np.memmap`` (``--model-mmap`` deploys): pass
+      through — ``ascontiguousarray`` on a contiguous f32 memmap is a
+      view, so the page-cache sharing survives and no full copy is
+      staged up front;
+    - replicated / single-device ``jax.Array``: one device_get, as the
+      build always did;
+    - **row-sharded** ``jax.Array`` (a ``shard_factors`` model): the
+      shards are gathered one bounded chunk at a time
+      (:data:`_GATHER_CHUNK_ROWS` rows per ``device_get``) into one
+      preallocated host buffer, with a pinned WARNING — the k-means
+      build is the one consumer that genuinely needs the whole table
+      host-resident, and a forced gather should be visible in deploy
+      logs. Never replicates on device (the sharded table may not FIT
+      replicated) and never stages more than one chunk of transfer at
+      a time."""
+    if isinstance(item_f, jax.Array) and not isinstance(item_f, np.ndarray):
+        shards = list(getattr(item_f, "addressable_shards", ()) or ())
+        if len(shards) > 1 and not item_f.is_fully_replicated:
+            out = np.empty(item_f.shape, dtype=np.float32)
+            logger.warning(
+                "ann index build forcing a chunked host gather of the "
+                "sharded item table (%d rows x %d, %d shards, %d-row "
+                "chunks)", item_f.shape[0], item_f.shape[1],
+                len(shards), _GATHER_CHUNK_ROWS)
+            done_rows: set[int] = set()
+            for shard in shards:
+                rows = shard.index[0] if shard.index else slice(None)
+                start = int(rows.start or 0)
+                if start in done_rows:
+                    continue  # data-axis replica of a row block
+                done_rows.add(start)
+                data = shard.data
+                for lo in range(0, int(data.shape[0]), _GATHER_CHUNK_ROWS):
+                    hi = min(lo + _GATHER_CHUNK_ROWS, int(data.shape[0]))
+                    out[start + lo : start + hi] = np.asarray(
+                        data[lo:hi], dtype=np.float32)
+            return out
+        return np.ascontiguousarray(np.asarray(item_f), dtype=np.float32)
+    return np.ascontiguousarray(np.asarray(item_f), dtype=np.float32)
+
+
 def build_index(item_f: Any, nlist: int = 0, seed: int = 0,
                 iters: int = 8, sample: int = 131072,
                 balance: float = 2.0) -> AnnIndex | None:
@@ -305,7 +359,7 @@ def build_index(item_f: Any, nlist: int = 0, seed: int = 0,
     Returns None for catalogs under :data:`MIN_INDEX_ITEMS`, where the
     flat matmul wins outright and an index is pure overhead.
     """
-    x = np.ascontiguousarray(np.asarray(item_f), dtype=np.float32)
+    x = _host_vectors(item_f)
     n = int(x.shape[0])
     if n < MIN_INDEX_ITEMS:
         return None

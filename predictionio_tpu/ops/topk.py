@@ -267,15 +267,43 @@ def recommend_topk_sharded(
     ``model`` — k entries per shard, not the (B, I) score matrix — and
     a second ``top_k`` picks the global winners in global item
     coordinates. Per-device traffic is O(B_local * n_model * k), the
-    classic distributed top-k merge; ICI carries only candidates."""
+    classic distributed top-k merge; ICI carries only candidates.
+
+    Shape contracts match the other top-k paths where the mesh allows:
+    ``k`` clamps to the catalog (a shard's local top-k clamps to its
+    own rows and the merge recovers the global k — tall-skinny meshes
+    like 1×8 serve k > rows-per-shard correctly), and a query batch
+    not divisible by the ``data`` axis pads with zero query rows whose
+    results are sliced off (B=1 single-query serving works on any
+    mesh). The catalog itself MUST divide the ``model`` axis — the
+    table is persistent sharded state, so padding it per call would
+    copy the one array this path exists to avoid copying; callers pad
+    once at staging/load time (models/als.py does)."""
     I = item_f.shape[0]
     n_model = int(mesh.shape["model"])
     if I % n_model:
         raise ValueError(
             f"catalog rows ({I}) must divide the model axis ({n_model}); "
             "pad the item table")
+    k = min(k, I)                   # the shared clamp-not-assert contract
+    n_data = int(mesh.shape["data"])
+    b = user_vecs.shape[0]
+    pad = (-b) % n_data
+    if pad:
+        user_vecs = jnp.concatenate(
+            [user_vecs, jnp.zeros((pad, user_vecs.shape[1]),
+                                  dtype=user_vecs.dtype)])
+        seen_cols = jnp.concatenate(
+            [jnp.asarray(seen_cols, dtype=jnp.int32),
+             jnp.zeros((pad, seen_cols.shape[1]), dtype=jnp.int32)])
+        sm = jnp.asarray(seen_mask)
+        seen_mask = jnp.concatenate(
+            [sm, jnp.zeros((pad, sm.shape[1]), dtype=sm.dtype)])
     fn = _sharded_topk_fn(mesh, k, I // n_model)
-    return fn(user_vecs, item_f, seen_cols, seen_mask, allow)
+    vals, idxs = fn(user_vecs, item_f, seen_cols, seen_mask, allow)
+    if pad:
+        vals, idxs = vals[:b], idxs[:b]
+    return vals, idxs
 
 
 @functools.lru_cache(maxsize=16)
@@ -287,6 +315,12 @@ def _sharded_topk_fn(mesh, k: int, shard_rows: int):
 
     from predictionio_tpu.utils.jax_compat import shard_map
 
+    # a shard can only contribute its own rows: on tall-skinny meshes
+    # (model axis > I/k, e.g. 1×8 serving a small catalog) the local
+    # top-k clamps to shard_rows and the gathered n_model * k_loc >= k
+    # candidates still recover the exact global top-k
+    k_loc = min(k, shard_rows)
+
     def local(uv, itf, sc, sm, al):
         start = jax.lax.axis_index("model") * shard_rows
         scores = jnp.einsum("bk,ik->bi", uv, itf)           # (b, rows)
@@ -296,7 +330,7 @@ def _sharded_topk_fn(mesh, k: int, shard_rows: int):
         rows = jnp.broadcast_to(jnp.arange(uv.shape[0])[:, None], sc.shape)
         hide = jnp.where(in_shard, NEG_INF, jnp.float32(jnp.inf))
         scores = scores.at[rows, jnp.clip(loc, 0, shard_rows - 1)].min(hide)
-        v, i = jax.lax.top_k(scores, k)                     # local winners
+        v, i = jax.lax.top_k(scores, k_loc)                 # local winners
         gi = (i + start).astype(jnp.int32)
         vg = jax.lax.all_gather(v, "model", axis=1, tiled=True)
         ig = jax.lax.all_gather(gi, "model", axis=1, tiled=True)

@@ -39,7 +39,11 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.base import PersistentModelManifest
 from predictionio_tpu.models.als import ALSModel, build_allow_vector
 from predictionio_tpu.ops import topk as topk_ops
-from predictionio_tpu.ops.als import RatingsCOO, als_train
+from predictionio_tpu.ops.als import (
+    RatingsCOO,
+    als_train,
+    resolve_shard_factors,
+)
 from predictionio_tpu.utils.bimap import EntityIdIxMap
 
 
@@ -278,7 +282,8 @@ class ALSAlgorithmParams(Params):
     use_mesh: bool = True
     exclude_seen: bool = True
     #: row-shard the factor tables over the mesh's "model" axis (DP×MP
-    #: tensor parallelism, engine.json "shardFactors") — for catalogs
+    #: tensor parallelism, engine.json "shardFactors";
+    #: env PIO_TRAIN_SHARD_FACTORS=1/0 overrides fleet-wide) — for catalogs
     #: whose tables exceed one device's HBM; see docs/parallelism.md
     shard_factors: bool = False
 
@@ -306,7 +311,7 @@ class ALSAlgorithm(ShardedAlgorithm):
             alpha=p.alpha,
             seed=p.seed,
             mesh=mesh,
-            shard_factors=p.shard_factors,
+            shard_factors=resolve_shard_factors(p.shard_factors),
         )
         return ALSModel(
             rank=p.rank,

@@ -28,7 +28,11 @@ from predictionio_tpu.controller import (
 )
 from predictionio_tpu.controller.base import PersistentModelManifest
 from predictionio_tpu.models.als import ALSModel, build_allow_vector
-from predictionio_tpu.ops.als import RatingsCOO, als_train
+from predictionio_tpu.ops.als import (
+    RatingsCOO,
+    als_train,
+    resolve_shard_factors,
+)
 from predictionio_tpu.templates.recommendation import ALSPreparator, TrainingData
 from predictionio_tpu.utils.bimap import EntityIdIxMap
 
@@ -152,7 +156,8 @@ class ALSAlgorithmParams(Params):
     alpha: float = 1.0
     seed: int = 3
     use_mesh: bool = True
-    #: DP×MP tensor parallelism (engine.json "shardFactors"); see
+    #: DP×MP tensor parallelism (engine.json "shardFactors";
+    #: env PIO_TRAIN_SHARD_FACTORS=1/0 overrides fleet-wide); see
     #: docs/parallelism.md
     shard_factors: bool = False
 
@@ -192,7 +197,7 @@ class SimilarALSAlgorithm(ShardedAlgorithm):
             alpha=p.alpha,
             seed=p.seed,
             mesh=mesh,
-            shard_factors=p.shard_factors,
+            shard_factors=resolve_shard_factors(p.shard_factors),
         )
         als = ALSModel(
             rank=p.rank,

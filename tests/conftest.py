@@ -27,6 +27,43 @@ def storage():
 
 
 @pytest.fixture(scope="session")
+def run_mesh_child():
+    """Runner for forced-multi-device subprocess children (the `mesh`
+    lane): spawns a ``tests/`` script with a FRESH jax process pinned
+    to ``--xla_force_host_platform_device_count=N`` — the in-process
+    8-device topology is fixed at conftest import, so anything needing
+    a different device count, clean env knobs (PIO_TRAIN_SHARD_FACTORS
+    / PIO_SERVING_SHARD_FACTORS), or virgin jit caches goes through
+    here. Returns ``(returncode, stdout, stderr)``; callers assert on
+    the child's printed verdict so its traceback lands in the pytest
+    failure message."""
+    import subprocess
+    import sys
+
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(tests_dir)
+
+    def run(child: str, *, devices: int = 8, env: dict | None = None,
+            timeout: float = 300):
+        base = {
+            k: v for k, v in os.environ.items()
+            if not k.startswith(("PIO_", "XLA_", "JAX_"))
+        }
+        base["PYTHONPATH"] = repo
+        base["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices}")
+        base["JAX_PLATFORMS"] = "cpu"
+        if env:
+            base.update(env)
+        p = subprocess.run(
+            [sys.executable, os.path.join(tests_dir, child)],
+            env=base, capture_output=True, text=True, timeout=timeout)
+        return p.returncode, p.stdout, p.stderr
+
+    return run
+
+
+@pytest.fixture(scope="session")
 def mesh8():
     """An 8-device 2D mesh (4 data x 2 model), the standard test topology."""
     import jax

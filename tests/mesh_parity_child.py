@@ -1,0 +1,101 @@
+"""Forced-8-device child for the `mesh` lane (spawned by the conftest
+``run_mesh_child`` helper — tests/test_mesh_conformance.py): proves the
+DP×MP story end to end in a FRESH process where the env knobs actually
+steer training and load, exactly as `pio train` / `pio deploy` would
+see them.
+
+With ``PIO_TRAIN_SHARD_FACTORS=1`` in the environment (set by the
+parent): trains the flagship fused layout twice — replicated baseline
+vs env-forced ``shard_factors`` over every serving mesh shape (1×8,
+2×4, 4×2) — pins factor parity, then saves the sharded model, reloads
+it through the auto-sharding ``ALSModel.load`` path, and pins sharded
+top-k serving equal to the replicated brute dispatch. Prints the
+per-shape verdicts and ``MESH PARITY OK`` on success.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from predictionio_tpu.utils.testing import force_cpu_devices
+
+force_cpu_devices(8)
+
+import jax  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from predictionio_tpu.models.als import ALSModel  # noqa: E402
+from predictionio_tpu.ops.als import (  # noqa: E402
+    RatingsCOO,
+    als_train,
+    resolve_shard_factors,
+)
+from predictionio_tpu.utils.bimap import BiMap, EntityIdIxMap  # noqa: E402
+
+assert jax.device_count() == 8, jax.device_count()
+# the parent exports PIO_TRAIN_SHARD_FACTORS=1: the env override, not a
+# call-site param, is what turns sharding on below (the fleet knob)
+assert os.environ.get("PIO_TRAIN_SHARD_FACTORS") == "1"
+assert resolve_shard_factors(False) is True
+
+rng = np.random.default_rng(7)
+nnz = 10_000
+users, items = 96, 64  # divide every model-axis width below exactly
+coo = RatingsCOO(
+    (users * rng.random(nnz) ** 1.6).astype(np.int32),
+    (items * rng.random(nnz) ** 1.6).astype(np.int32),
+    (rng.random(nnz) * 5).astype(np.float32), users, items,
+)
+
+replicated = als_train(coo, rank=8, iterations=3, lam=0.05, seed=3,
+                       layout="fused", matmul_dtype="float32")
+
+for shape in ((1, 8), (2, 4), (4, 2)):
+    mesh = Mesh(np.asarray(jax.devices()).reshape(shape),
+                ("data", "model"))
+    sharded = als_train(
+        coo, rank=8, iterations=3, lam=0.05, seed=3, mesh=mesh,
+        layout="fused", matmul_dtype="float32",
+        shard_factors=resolve_shard_factors(False))
+    np.testing.assert_allclose(np.asarray(replicated.user),
+                               np.asarray(sharded.user),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(replicated.item),
+                               np.asarray(sharded.item),
+                               rtol=2e-4, atol=2e-4)
+    model_ax = int(shape[1])
+    spec = sharded.item.sharding.spec
+    assert spec and spec[0] == "model", spec
+    print(f"parity {shape[0]}x{shape[1]}: OK")
+
+# train-sharded model -> save (persists `sharded` meta) -> plain load()
+# (the template/deploy call shape) -> sharded serving == replicated
+mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("data", "model"))
+factors = als_train(coo, rank=8, iterations=3, lam=0.05, seed=3,
+                    mesh=mesh, layout="fused", matmul_dtype="float32",
+                    shard_factors=resolve_shard_factors(False))
+user_ids = EntityIdIxMap(BiMap({f"u{i}": i for i in range(users)}))
+item_ids = EntityIdIxMap(BiMap({f"i{i}": i for i in range(items)}))
+seen = {0: np.asarray([1, 2, 3], dtype=np.int32)}
+model = ALSModel(rank=8, user_factors=factors.user,
+                 item_factors=factors.item, user_ids=user_ids,
+                 item_ids=item_ids, seen_by_user=seen)
+assert model.factor_shard_ways == 4
+
+os.environ["PIO_SERVING_ANN_BUILD"] = "0"
+with tempfile.TemporaryDirectory() as d:
+    model.save(d)
+    loaded = ALSModel.load(d)            # auto-resharded from meta
+    assert loaded.factor_shard_ways > 1, loaded.factor_shard_ways
+    os.environ["PIO_SERVING_SHARD_FACTORS"] = "0"
+    brute = ALSModel.load(d)             # env veto: replicated
+    assert brute.factor_shard_ways == 1
+
+for uid in ("u0", "u7", "u41"):
+    a = brute.recommend(uid, 10)
+    b = loaded.recommend(uid, 10)
+    assert [x[0] for x in a] == [x[0] for x in b], (uid, a, b)
+    assert np.allclose([x[1] for x in a], [x[1] for x in b], atol=1e-5)
+print("serving equality: OK")
+print("MESH PARITY OK")

@@ -256,7 +256,12 @@ class TestLockOrderRule:
         assert run_rule("lock-order", "lockorder_good.py") == []
 
 
-JIT_RECOMPILE_OPTS = {"snap_calls": ["snap_width"]}
+JIT_RECOMPILE_OPTS = {
+    "snap_calls": ["snap_width"],
+    # factory-backed wrapper: plain function whose `k` keys a cached
+    # jit program (the ops/topk._sharded_topk_fn shape)
+    "extra_entries": {"sharded_lookup": ["k"]},
+}
 
 
 class TestJitRecompileRiskRule:
@@ -268,7 +273,9 @@ class TestJitRecompileRiskRule:
         assert "'k'" in messages and "'width'" in messages
         # shape-varying inline array at the call site
         assert "comprehension" in messages
-        assert len(findings) == 3
+        # drifting compile key through the factory-backed wrapper
+        assert "sharded_lookup" in messages
+        assert len(findings) == 4
 
     def test_good_fixture_clean(self):
         # literals, module constants, snap calls, .shape-derived values

@@ -112,6 +112,24 @@ def tiny_bench(monkeypatch):
                               "elasticity_burst_admitted_control": 5,
                               "elasticity_host_cores": 2,
                               "elasticity_host_cores_caveat": None})
+    # train_sharding spawns a forced-8-device jax subprocess child
+    # (bench_sharding.py) — stubbed here; the real tiny harness is the
+    # slow-marked test below
+    monkeypatch.setattr(
+        bench, "bench_train_sharding",
+        lambda shrunk=False: {
+            "train_sharding_devices": 8,
+            "train_sharding_model_axis": 2,
+            "train_sharding_replicated_mfu": None,
+            "train_sharding_sharded_mfu": None,
+            "train_sharding_replicated_hbm_peak_bytes": None,
+            "train_sharding_sharded_hbm_peak_bytes": None,
+            "train_sharding_replicated_table_bytes_per_device": 5120,
+            "train_sharding_sharded_table_bytes_per_device": 2560,
+            "train_sharding_parity_max_abs_diff": 0.0,
+            "train_sharding_r512_completed": True,
+            "train_sharding_r512_fits_replicated": False,
+            "train_sharding_r512_fits_sharded": True})
     # keep calibration real but tiny (2048^3 bf16 chains are for the chip)
     real_calib = bench.bench_calibration
     monkeypatch.setattr(bench, "bench_calibration",
@@ -155,7 +173,14 @@ def test_single_json_line_with_primary_contract(tiny_bench, capsys, monkeypatch)
                 # train_profile runs REAL (tiny train, seconds): the
                 # device/compiler observability trajectory keys
                 "train_profile_mfu", "train_profile_compile_seconds",
-                "train_profile_compiles", "train_profile_wall_seconds"):
+                "train_profile_compiles", "train_profile_wall_seconds",
+                # the DP×MP factor-sharding trajectory keys (PR 19)
+                "train_sharding_devices", "train_sharding_model_axis",
+                "train_sharding_parity_max_abs_diff",
+                "train_sharding_replicated_table_bytes_per_device",
+                "train_sharding_sharded_table_bytes_per_device",
+                "train_sharding_r512_completed",
+                "train_sharding_r512_fits_sharded"):
         assert key in line, key
     # MFU is honest-or-nothing: a float when a peak is known, else
     # null — never absent, never fabricated
@@ -367,3 +392,33 @@ def test_workers_harness_contract_tiny():
     assert r["host_cores"] >= 1
     assert r["workers_reported_in_merged_metrics"] == 2.0
     assert r["errors"] == 0
+
+
+@pytest.mark.mesh
+@pytest.mark.slow
+def test_sharding_harness_contract_tiny():
+    """bench_sharding.py's real child at tiny (shrunk) scale: one
+    forced-8-device subprocess running replicated-vs-sharded matched
+    shapes through `pio train --profile` plus the sharded-only point —
+    the keys and invariants BENCH_sharding_rNN.json records.
+    Slow-marked: a jax-importing child training four models."""
+    import bench_sharding
+
+    r = bench_sharding.bench_sharding_section(shrunk=True)
+    assert r["train_sharding_devices"] == 8
+    assert r["train_sharding_model_axis"] >= 2
+    # the parity number IS the numerics claim: sharded == replicated
+    assert r["train_sharding_parity_max_abs_diff"] <= 2e-4
+    # per-device table bytes shrink by exactly the model axis
+    assert (r["train_sharding_sharded_table_bytes_per_device"]
+            == r["train_sharding_replicated_table_bytes_per_device"]
+            // r["train_sharding_model_axis"])
+    # MFU/HBM are honest-or-null (CPU backend: null)
+    for key in ("train_sharding_replicated_mfu",
+                "train_sharding_sharded_mfu"):
+        assert r[key] is None or isinstance(r[key], float)
+    assert r["train_sharding_r512_completed"] is True
+    assert r["train_sharding_r512_fits_sharded"] is True
+    assert (r["train_sharding_r512_sharded_table_bytes_per_device"]
+            == r["train_sharding_r512_replicated_table_bytes"]
+            // r["train_sharding_devices"])

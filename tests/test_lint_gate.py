@@ -60,6 +60,21 @@ def test_package_lints_clean():
     assert stats.files > 100
 
 
+def test_recompile_scope_covers_factory_backed_entries():
+    """The jit-recompile-risk scope must include the factory-backed
+    sharded serving dispatch: ``recommend_topk_sharded`` is a plain
+    function, but its ``k`` keys the lru-cached shard_map program in
+    ``ops/topk._sharded_topk_fn`` — invisible to the decorator scan, so
+    it rides the ``extra_entries`` option. Dropping it from the policy
+    silently un-lints every sharded-serving call site."""
+    config = default_config()
+    opts = config.rules["jit-recompile-risk"].options
+    assert opts.get("extra_entries", {}).get(
+        "recommend_topk_sharded") == ["k"]
+    assert set(opts.get("snap_calls", ())) >= {"serving_k",
+                                               "serving_batch"}
+
+
 def test_warm_cache_run_is_not_slower_than_module_only(tmp_path):
     """The per-file cache must make a warm full run (all nine rules,
     project passes included) no slower than the pre-cache per-module-only
