@@ -816,6 +816,18 @@ def bench_elasticity_section(shrunk: bool = False):
     return bench_elasticity.bench_section(shrunk=shrunk)
 
 
+def bench_experiment_section(shrunk: bool = False):
+    """Experimentation plane (bench_experiment.py; committed
+    artifacts: BENCH_experiment_rNN.json): parallel-grid throughput
+    1-vs-N (report-not-pin on a 1-core host — the ratio carries
+    host_core_ratio_caveat) plus assign()/record() round-trips per
+    second on the routed-query path. Fork children + one controller
+    loop, no device — runs (shrunk) under --skip-heavy."""
+    import bench_experiment
+
+    return bench_experiment.bench_section(shrunk=shrunk)
+
+
 def bench_freshness_section(shrunk: bool = False):
     """Real-time freshness plane (bench_freshness.py; committed
     artifacts: BENCH_freshness_rNN.json): event→recommendation lag
@@ -1358,6 +1370,8 @@ def main() -> None:
          lambda: bench_freshness_section(shrunk=args.skip_heavy)),
         ("elasticity",
          lambda: bench_elasticity_section(shrunk=args.skip_heavy)),
+        ("experiment",
+         lambda: bench_experiment_section(shrunk=args.skip_heavy)),
         ("train_profile", bench_train_profile),
         ("train_sharding",
          lambda: bench_train_sharding(shrunk=args.skip_heavy)),
@@ -1376,6 +1390,8 @@ def main() -> None:
         # device involvement
         # elasticity rides along shrunk: router threads + stdlib echo
         # backends + a ManualClock timeline, no device involvement
+        # experiment rides along shrunk: fork eval children + a
+        # single-threaded controller loop, no device involvement
         # shm_cache rides along shrunk: subprocess serving pools +
         # loopback HTTP + one POSIX shm segment, no device involvement
         # train_sharding rides along shrunk: a seconds-scale forced-8-
@@ -1383,7 +1399,8 @@ def main() -> None:
         # sharded point — same contract as the full artifact)
         keep = ("quality", "ingest", "data_plane", "ann_retrieval",
                 "workers_scaling", "freshness", "train_profile",
-                "gateway", "elasticity", "shm_cache", "train_sharding")
+                "gateway", "elasticity", "experiment", "shm_cache",
+                "train_sharding")
         failed.extend(s[0] for s in sections if s[0] not in keep)
         sections = [s for s in sections if s[0] in keep]
     for section, fn in sections:

@@ -1,0 +1,265 @@
+"""bench_experiment — the experimentation plane (docs/experimentation.md).
+
+Phases (BENCH_experiment_rNN.json):
+
+- **grid throughput 1-vs-N** — the same EngineParams grid through
+  ``run_parallel_grid`` at ``parallel=1`` and ``parallel=N`` (same
+  harness both times, so the ratio isolates fan-out minus fork/spool
+  overhead, not a different code path). Grid points are embarrassingly
+  parallel, so the ceiling is min(N, host cores); on the 1-core bench
+  host the ratio is time-slice bound and REPORTED with
+  ``host_core_ratio_caveat`` instead of pinned (memory note
+  bench-host-cores).
+- **assignment overhead** — ``ExperimentController.assign()`` +
+  ``record()`` round-trips per second, single-threaded. This pair sits
+  on every bare routed query while an experiment is live, so it must
+  stay far above any realistic router QPS.
+
+Self-contained engine (no tests/ import): each grid point's train
+burns a fixed slice of CPU, standing in for real per-point eval work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import time
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    DataSource,
+    Engine,
+    EngineParams,
+    Evaluation,
+    LocalAlgorithm,
+    MetricEvaluator,
+    Params,
+    Preparator,
+    Serving,
+)
+from predictionio_tpu.experiment.controller import (
+    ExperimentConfig,
+    ExperimentController,
+    VariantSpec,
+)
+from predictionio_tpu.experiment.grid import (
+    FAILED,
+    result_from_points,
+    run_parallel_grid,
+)
+from predictionio_tpu.fleet.canary import GuardrailConfig
+from predictionio_tpu.workflow.context import EngineContext
+
+from bench_serving import host_core_ratio_caveat
+
+
+# ---------------------------------------------------------------------------
+# a DASE engine whose eval cost is a tunable CPU burn
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class BenchDSParams(Params):
+    n_folds: int = 2
+    n_queries: int = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchAlgoParams(Params):
+    mult: int = 1
+    #: CPU burned per fold train — the stand-in for real model fitting
+    work_ms: float = 25.0
+
+
+@dataclasses.dataclass(frozen=True)
+class _TD:
+    n: int
+
+
+@dataclasses.dataclass(frozen=True)
+class _Query:
+    x: int
+
+
+@dataclasses.dataclass(frozen=True)
+class _Prediction:
+    value: float
+
+
+class BenchDataSource(DataSource):
+    params_class = BenchDSParams
+
+    def read_training(self, ctx) -> _TD:
+        return _TD(n=self.params.n_queries)
+
+    def read_eval(self, ctx):
+        p = self.params
+        folds = []
+        for k in range(p.n_folds):
+            qa = [(_Query(x=i), float(i)) for i in range(p.n_queries)]
+            folds.append((_TD(n=p.n_queries), {"fold": k}, qa))
+        return folds
+
+
+class BenchPreparator(Preparator):
+    def prepare(self, ctx, td: _TD) -> _TD:
+        return td
+
+
+class BenchAlgorithm(LocalAlgorithm):
+    params_class = BenchAlgoParams
+    query_class = _Query
+
+    def train(self, ctx, pd: _TD) -> float:
+        deadline = time.perf_counter() + self.params.work_ms / 1000.0
+        acc = 0.0
+        while time.perf_counter() < deadline:
+            acc += sum(i * i for i in range(256))
+        return float(self.params.mult)
+
+    def predict(self, model: float, query: _Query) -> _Prediction:
+        return _Prediction(value=query.x * model)
+
+
+class BenchServing(Serving):
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class _ValueMetric(AverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(p.value)
+
+
+class BenchEvaluation(Evaluation):
+    def __init__(self):
+        super().__init__()
+        engine = Engine(
+            data_source_class_map=BenchDataSource,
+            preparator_class_map=BenchPreparator,
+            algorithm_class_map={"bench": BenchAlgorithm},
+            serving_class_map=BenchServing,
+        )
+        self.engine_evaluator = (engine, MetricEvaluator(_ValueMetric()))
+
+
+def _grid(points: int, work_ms: float) -> list[EngineParams]:
+    return [
+        EngineParams.of(
+            data_source=BenchDSParams(n_folds=2, n_queries=8),
+            algorithms=[("bench",
+                         BenchAlgoParams(mult=m + 1, work_ms=work_ms))],
+        )
+        for m in range(points)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# phase: grid throughput 1-vs-N
+# ---------------------------------------------------------------------------
+
+def bench_grid(points: int = 8, parallel: int = 4,
+               work_ms: float = 50.0) -> dict:
+    evaluation = BenchEvaluation()
+    evaluator = evaluation.evaluator
+    ctx = EngineContext()
+    params_list = _grid(points, work_ms)
+
+    def run(width: int) -> tuple[float, int]:
+        t0 = time.perf_counter()
+        point_results = run_parallel_grid(
+            evaluation, evaluator, params_list, ctx, width)
+        elapsed = time.perf_counter() - t0
+        result = result_from_points(evaluator, params_list, point_results)
+        assert len(result.engine_params_scores) == points
+        failed = sum(1 for p in point_results if p.status == FAILED)
+        return elapsed, failed
+
+    # warm the fork path once so neither side pays first-use costs
+    run_parallel_grid(evaluation, evaluator, params_list[:1], ctx, 1)
+
+    seq_s, seq_failed = run(1)
+    par_s, par_failed = run(parallel)
+    return {
+        "benchmark": "experiment_grid",
+        "value": round(seq_s / par_s, 3) if par_s > 0 else 0.0,
+        "unit": "speedup_x",
+        "points": points,
+        "parallel": parallel,
+        "work_ms_per_fold": work_ms,
+        "seq_s": round(seq_s, 3),
+        "par_s": round(par_s, 3),
+        "failed_points": seq_failed + par_failed,
+        "host_cores": os.cpu_count() or 1,
+        "host_cores_caveat": host_core_ratio_caveat(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# phase: assignment + outcome overhead on the routed-query path
+# ---------------------------------------------------------------------------
+
+def bench_assign(ops: int = 20_000) -> dict:
+    ctl = ExperimentController(rng=random.Random(11))
+    ctl.define(
+        ExperimentConfig(name="bench", ramp_s=3600.0, measure_s=3600.0,
+                         min_requests=10 ** 9,
+                         guardrail=GuardrailConfig(min_requests=10 ** 9)),
+        [VariantSpec("a", 50.0), VariantSpec("b", 50.0)])
+    t0 = time.perf_counter()
+    for i in range(ops):
+        _, variant = ctl.assign()
+        ctl.record(variant, ok=True, latency_s=0.001)
+    elapsed = time.perf_counter() - t0
+    return {
+        "benchmark": "experiment_assign",
+        "value": round(ops / elapsed, 1) if elapsed > 0 else 0.0,
+        "unit": "ops_per_s",
+        "ops": ops,
+        "elapsed_s": round(elapsed, 3),
+    }
+
+
+def bench_experiment(points: int = 8, parallel: int = 4,
+                     work_ms: float = 50.0, ops: int = 20_000) -> dict:
+    grid = bench_grid(points=points, parallel=parallel, work_ms=work_ms)
+    assign = bench_assign(ops=ops)
+    return {
+        "benchmark": "experiment",
+        "value": grid["value"],
+        "unit": "grid_speedup_x",
+        "grid": grid,
+        "assign": assign,
+        "host_cores": grid["host_cores"],
+        "host_cores_caveat": grid["host_cores_caveat"],
+    }
+
+
+def bench_section(shrunk: bool = False) -> dict:
+    """The bench.py ``experiment`` section (fork children + a
+    single-threaded controller loop: cheap enough to ride along under
+    --skip-heavy shrunk; full artifacts: BENCH_experiment_rNN.json)."""
+    if shrunk:
+        r = bench_experiment(points=4, parallel=2, work_ms=20.0,
+                             ops=4_000)
+    else:
+        r = bench_experiment()
+    return {
+        "experiment_grid_speedup_x": r["grid"]["value"],
+        "experiment_grid_points": r["grid"]["points"],
+        "experiment_grid_parallel": r["grid"]["parallel"],
+        "experiment_grid_seq_s": r["grid"]["seq_s"],
+        "experiment_grid_par_s": r["grid"]["par_s"],
+        "experiment_grid_failed_points": r["grid"]["failed_points"],
+        "experiment_assign_ops_per_s": r["assign"]["value"],
+        "experiment_host_cores": r["host_cores"],
+        "experiment_host_cores_caveat": r["host_cores_caveat"],
+    }
+
+
+if __name__ == "__main__":
+    result = bench_experiment()
+    print(json.dumps(result, indent=2))
+    with open("BENCH_experiment_r01.json", "w") as f:
+        json.dump(result, f, indent=2)

@@ -120,8 +120,14 @@ COMPUTE_PATHS = ("ops/", "models/", "e2/")
 #: read-retry loop must stay sleep-free (readers never wait on the
 #: writer — serving/shm_cache.py is in banned_sleep_paths to keep it
 #: that way)
+#: the experimentation plane (PR 20: experiment/) rides here because
+#: the variant-assignment + attribution-stamp path sits on EVERY bare
+#: /queries.json through the router, the controller's tick runs inside
+#: record() on the request path, and the grid scheduler's join loop
+#: must stay on bounded waits — experiment/ is in banned_sleep_paths
+#: so neither ever grows a bare sleep
 HOT_PATHS = ("api/", "workflow/deploy.py", "serving/", "data/", "obs/",
-             "fleet/", "ops/ann.py", "online/")
+             "fleet/", "ops/ann.py", "online/", "experiment/")
 
 
 def default_config() -> LintConfig:
@@ -236,7 +242,8 @@ def default_config() -> LintConfig:
             # fetch growing there must carry a timeout
             "untimed-blocking-io": RuleConfig(
                 paths=("api/", "storage/", "fleet/", "obs/", "cli/",
-                       "serving/", "data/wal.py", "online/"),
+                       "serving/", "data/wal.py", "online/",
+                       "experiment/"),
                 options={
                     "policed_calls": {
                         "urlopen": 2, "create_connection": 1,
@@ -260,11 +267,17 @@ def default_config() -> LintConfig:
                     # sleep — a sleeping reader inside /queries.json is
                     # exactly the reader-blocks-on-writer coupling the
                     # seqlock exists to remove
+                    # experiment/ (PR 20): the controller ticks inside
+                    # the request path and the grid's join loop must
+                    # stay on ProcessHandle.wait(timeout) — a bare
+                    # sleep in either stalls every routed query or
+                    # makes the scheduler untestable
                     "banned_sleep_paths": ["fleet/",
                                            "serving/workers.py",
                                            "serving/shm_cache.py",
                                            "data/wal.py",
-                                           "online/"],
+                                           "online/",
+                                           "experiment/"],
                 },
             ),
             "lock-discipline": RuleConfig(paths=("",)),

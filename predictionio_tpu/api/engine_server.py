@@ -1128,12 +1128,24 @@ class EngineService:
             response = encode_wire(prediction)
         if not isinstance(response, dict):
             response = {"result": response}
+        # experiment attribution (experiment/controller.py): the router
+        # stamps the assigned variant on the forwarded request; echo it
+        # as prId-style response fields so the client can attach the
+        # ids to conversion events — the loop serving → event store →
+        # online score closes on exactly these two fields
+        attribution = None
+        experiment_id = headers.get("x-pio-experiment")
+        if experiment_id:
+            attribution = {"experimentId": experiment_id,
+                           "variantId": headers.get("x-pio-variant", "")}
+            response.update(attribution)
         if self.config.feedback:
             # feedback loop (CreateServer.scala:514-576): tag the response
             # with a prId and post the (query, prediction) as events
             pr_id = pr_id_in or uuid.uuid4().hex
             response["prId"] = pr_id
-            self._post_feedback(pr_id, body, response)
+            self._post_feedback(pr_id, body, response,
+                                attribution=attribution)
         if not self._compile_warmup_marked:
             # the first answered query ends serving warmup: from here
             # on, any jit compile under a request is an incident the
@@ -1206,7 +1218,8 @@ class EngineService:
                 self._reloads_in_flight -= 1
 
     # -- feedback loop ------------------------------------------------------
-    def _post_feedback(self, pr_id: str, query_json: dict, response: dict) -> None:
+    def _post_feedback(self, pr_id: str, query_json: dict, response: dict,
+                       attribution: dict | None = None) -> None:
         """Fire-and-forget POST to the event server
         (CreateServer.scala:550-566). Forwards the ambient trace
         context (captured HERE, on the handler thread — the posting
@@ -1229,7 +1242,11 @@ class EngineService:
                 "event": "predict",
                 "entityType": "pio_pr",
                 "entityId": pr_id,
-                "properties": {"query": query_json, "prediction": response},
+                # attribution rides as top-level properties so the
+                # conversion-count sweep (`pio experiment conversions`)
+                # never has to dig through prediction payloads
+                "properties": {"query": query_json, "prediction": response,
+                               **(attribution or {})},
             }
             headers = {"Content-Type": "application/json"}
             if trace is not None:

@@ -73,6 +73,7 @@ from predictionio_tpu.obs.exporter import CONTENT_TYPE as PROMETHEUS_CONTENT_TYP
 from predictionio_tpu.obs.exporter import render_prometheus
 from predictionio_tpu.obs.registry import (
     HistogramFamily,
+    Metric,
     MetricRegistry,
     ingest_collector,
     resilience_collector,
@@ -279,6 +280,15 @@ class EventService:
         #: (obs/slo.py; docs/fleet.md autoscaler contract)
         self.slo = SLOEngine()
         self.registry.register(self.slo.collector())
+        #: conversion attribution (experiment/controller.py): accepted
+        #: client events carrying the served experimentId/variantId
+        #: stamp, counted per variant — what `pio experiment
+        #: conversions` sweeps into the online score. The server's own
+        #: "predict" feedback events are excluded: serving a rec is
+        #: not the user acting on it.
+        self._conversion_lock = threading.Lock()
+        self._conversions: dict[tuple[str, str], int] = {}
+        self.registry.register(self._conversions_collector)
         #: auth results served while the metadata store was REACHABLE,
         #: replayed stale during an outage: without this every POST of
         #: the ride-through dies at authenticate() before the journal
@@ -469,7 +479,43 @@ class EventService:
             EventInfo(auth.app_id, auth.channel_id, event))
         if self.stats:
             self.stats.update(auth.app_id, status, event)
+        if status < 300:
+            self._count_conversion(event)
         return status, body
+
+    def _count_conversion(self, event) -> None:
+        """Fold one ACCEPTED event into the per-variant conversion
+        counters when it carries the served attribution stamp
+        (experimentId/variantId properties)."""
+        if event.event == "predict":
+            return
+        try:
+            experiment = event.properties.get("experimentId")
+            variant = event.properties.get("variantId")
+        except Exception:  # noqa: BLE001 — properties are client data
+            return
+        if not experiment or not variant:
+            return
+        key = (str(experiment), str(variant))
+        with self._conversion_lock:
+            self._conversions[key] = self._conversions.get(key, 0) + 1
+
+    def _conversions_collector(self) -> list[Metric]:
+        with self._conversion_lock:
+            samples = [({"experiment": e, "variant": v}, float(n))
+                       for (e, v), n in sorted(self._conversions.items())]
+        return [Metric(
+            "pio_experiment_conversions_ingested_total", "counter",
+            "Accepted events carrying experiment attribution "
+            "(conversion candidates), per variant.", samples=samples)]
+
+    def conversion_counts(self, experiment: str) -> dict[str, int]:
+        """Per-variant conversion totals for one experiment — what
+        ``pio experiment conversions`` sweeps into the router's online
+        score."""
+        with self._conversion_lock:
+            return {v: n for (e, v), n in self._conversions.items()
+                    if e == experiment}
 
     def _journal(self, event, auth: AuthData,
                  cause: BaseException | None = None) -> tuple[int, dict]:
@@ -515,6 +561,8 @@ class EventService:
             EventInfo(auth.app_id, auth.channel_id, event))
         if self.stats:
             self.stats.update(auth.app_id, status, event)
+        if status < 300:
+            self._count_conversion(event)
         return {"status": status, **body}
 
     def get_event(
@@ -709,6 +757,7 @@ class EventService:
                         EventInfo(auth.app_id, auth.channel_id, event))
                     if self.stats:
                         self.stats.update(auth.app_id, 201, event)
+                    self._count_conversion(event)
                     # counted as size-1 inserts, which is what storage
                     # actually did on this path — folding them into one
                     # synthetic batch would skew the histogram exactly
@@ -721,6 +770,7 @@ class EventService:
                     )
                     if self.stats:
                         self.stats.update(auth.app_id, 201, event)
+                    self._count_conversion(event)
                     results[pos] = {"status": 201, "eventId": event_id}
                 self.ingest_stats.record_batch(len(pending))
         return 200, results

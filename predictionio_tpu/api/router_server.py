@@ -33,6 +33,12 @@ A thin HTTP process fronting N engine-server replicas. Routes:
                            POST ``{"weight": 25}`` to start/resize,
                            ``{"action": "abort"}`` to kill it
                            (key-authenticated when ``--router-key``)
+- ``GET|POST /fleet/experiments`` the online A/B plane
+                           (experiment/controller.py): define an
+                           experiment over registered variant engines,
+                           fold attributed conversions in, read the
+                           lifecycle + per-variant online scores;
+                           mutations propagate over the admin spool
 - ``GET /healthz``         router process liveness
 - ``GET /readyz``          503 until at least one replica is routable
 - ``GET /stats.json``      router counters + upstream latency
@@ -72,8 +78,21 @@ from predictionio_tpu.api.http_base import (
     resolve_request_id,
     retry_after_header,
 )
+from predictionio_tpu.experiment.controller import (
+    EXPERIMENT_FIELD,
+    EXPERIMENT_HEADER,
+    VARIANT_FIELD,
+    VARIANT_HEADER,
+    ExperimentConfig,
+    ExperimentController,
+    VariantSpec,
+)
+from predictionio_tpu.experiment.grid import eval_points_collector
 from predictionio_tpu.fleet.canary import GuardrailConfig
-from predictionio_tpu.fleet.gateway import EngineGateway
+from predictionio_tpu.fleet.gateway import (
+    QUERIES_PATH,
+    EngineGateway,
+)
 from predictionio_tpu.fleet.router import (
     FleetRouter,
     RouterConfig,
@@ -182,6 +201,18 @@ class RouterService:
         self.supervisor = None
         self.controller = None
         self.scale_set = None
+        #: online A/B (experiment/controller.py): splits bare-path
+        #: query traffic across variant engines, auto-promotes through
+        #: the guardrail discipline; every verdict publishes to the
+        #: admin spool (the `experiment` key of the cumulative doc).
+        #: Ticks ride the admin sync loop's Event.wait below plus the
+        #: outcome feed — the controller itself never sleeps.
+        self.experiment = ExperimentController(
+            gateway=self.gateway,
+            on_change=lambda: self._publish_admin(
+                {"action": "experiment"}))
+        self.registry.register(self.experiment.collector)
+        self.registry.register(eval_points_collector)
         if self.worker_hub is not None:
             self._wire_abort_hooks()
             self._sync_admin_once()     # respawn adoption
@@ -248,6 +279,12 @@ class RouterService:
                 self._sync_admin_once()
             except Exception:  # noqa: BLE001 — a torn read is the next pass's problem
                 logger.exception("admin-state sync failed")
+            try:
+                # experiment lifecycle ticks ride this Event.wait loop
+                # (the controller never sleeps on its own)
+                self.experiment.tick()
+            except Exception:  # noqa: BLE001
+                logger.exception("experiment tick failed")
 
     def _sync_admin_once(self) -> None:
         hub = self.worker_hub
@@ -269,6 +306,16 @@ class RouterService:
         # latest document — register/retire/quota/weight/abort all ride
         # the same diff-apply. The legacy action fields remain for
         # operator readability (and the pinned abort-doc shape).
+        experiment = doc.get("experiment")
+        if isinstance(experiment, dict):
+            try:
+                if self.experiment.adopt_state(experiment):
+                    logger.info("adopted shared experiment state "
+                                "(seq %s): %s", doc.get("seq"),
+                                experiment.get("state"))
+            except Exception:  # noqa: BLE001 — a bad doc must not kill the sync loop
+                logger.exception("adopting shared experiment state "
+                                 "failed (seq %s)", doc.get("seq"))
         fleet = doc.get("fleet")
         if isinstance(fleet, dict):
             try:
@@ -320,10 +367,14 @@ class RouterService:
         if hub is None:
             return
         # every publish is CUMULATIVE: the whole engine table (specs +
-        # per-engine canary state) rides along, so the LATEST document
-        # alone is sufficient for a respawned sibling — an action log
-        # would strand whichever mutation was published second-to-last
+        # per-engine canary state) and the experiment state ride along,
+        # so the LATEST document alone is sufficient for a respawned
+        # sibling — an action log would strand whichever mutation was
+        # published second-to-last
         doc = {**doc, "fleet": self.gateway.table_doc()}
+        experiment_doc = self.experiment.state_doc()
+        if experiment_doc is not None:
+            doc["experiment"] = experiment_doc
         # publish AND advance _admin_seq under the one lock: the sync
         # loop compares seq under the same lock, so it can never read
         # the freshly-committed document in a gap before the seq
@@ -374,12 +425,29 @@ class RouterService:
         server's ``(status, payload[, headers])`` tuple shape."""
         try:
             if method == "POST" and self.gateway.is_query_path(path):
+                # experiment split first: a bare-path query with no
+                # explicit engine selection may be assigned to a
+                # variant (experiment/controller.py) — the assignment
+                # rides the X-PIO-Engine header into the same O(1)
+                # resolution everything else uses, and the attribution
+                # pair is forwarded to the replica + stamped on the
+                # response
+                assigned = self._experiment_assign(path, headers)
+                if assigned is not None:
+                    experiment_id, variant = assigned
+                    headers = {**headers,
+                               "x-pio-engine": variant,
+                               "x-pio-experiment": experiment_id,
+                               "x-pio-variant": variant}
                 # O(1) engine resolution on the path (bare
                 # /queries.json → default engine or X-PIO-Engine
                 # header), per-engine quota, then the engine's own
                 # pick/forward/retry/hedge (fleet/gateway.py)
-                return self.gateway.route(path, body, headers,
-                                          request_id)
+                out = self.gateway.route(path, body, headers,
+                                         request_id)
+                if assigned is not None:
+                    self._stamp_attribution(out, experiment_id, variant)
+                return out
             if method == "GET" and path in ("/", "/fleet"):
                 return (200, self.fleet_doc())
             if method == "GET" and path == "/stats.json":
@@ -414,6 +482,14 @@ class RouterService:
                 if method == "POST":
                     self._check_router_key(params)
                     return self.canary_admin(body)
+            if path == "/fleet/experiments":
+                if method == "GET":
+                    self.experiment.tick()
+                    return (200,
+                            {"experiment": self.experiment.snapshot()})
+                if method == "POST":
+                    self._check_router_key(params)
+                    return self.experiments_admin(body)
             if method == "POST" and path == "/stop":
                 self._check_router_key(params)
                 threading.Thread(target=self.on_stop, daemon=True).start()
@@ -667,6 +743,9 @@ class RouterService:
                if self.controller is not None else {}),
             **({"elasticity": self.scale_set.snapshot()}
                if self.scale_set is not None else {}),
+            **({"experiment": exp_snap}
+               if (exp_snap := self.experiment.snapshot()) is not None
+               else {}),
         }
 
     def engines_doc(self) -> dict:
@@ -696,6 +775,11 @@ class RouterService:
                     "lastDecision": snap.get("lastDecision"),
                     "lastReason": snap.get("lastReason"),
                 }
+        exp_snap = self.experiment.snapshot()
+        if exp_snap is not None:
+            # `pio status --router` reads this key for the experiment
+            # block (cli/pio.py)
+            doc["experiment"] = exp_snap
         return doc
 
     def engines_admin(self, body: bytes) -> tuple:
@@ -803,6 +887,99 @@ class RouterService:
                     group.name)
         return (200, canary.snapshot())
 
+    # -- experimentation (experiment/controller.py) --------------------------
+    def _experiment_assign(self, path: str,
+                           headers: Mapping[str, str]) -> tuple | None:
+        """A bare-path query with no explicit engine selection is
+        eligible for the experiment split; path- or header-addressed
+        queries keep their explicit routing — an experiment must never
+        hijack a client that asked for a specific tenant."""
+        if path != QUERIES_PATH or headers.get("x-pio-engine"):
+            return None
+        return self.experiment.assign()
+
+    def _stamp_attribution(self, out: RouterResponse, experiment_id: str,
+                           variant: str) -> None:
+        """Attribution on the way out: headers always; the prId-style
+        body fields only when the replica didn't already stamp them
+        (it does when the forwarded attribution headers reached it).
+        Only experiment-ASSIGNED responses pay this parse — the normal
+        hot path keeps its bytes-through-untouched contract."""
+        out.headers[EXPERIMENT_HEADER] = experiment_id
+        out.headers[VARIANT_HEADER] = variant
+        if out.status != 200 or not out.body \
+                or "json" not in (out.content_type or ""):
+            return
+        try:
+            doc = json.loads(out.body)
+        except ValueError:
+            return
+        if not isinstance(doc, dict) or EXPERIMENT_FIELD in doc:
+            return
+        doc[EXPERIMENT_FIELD] = experiment_id
+        doc[VARIANT_FIELD] = variant
+        out.body = json.dumps(doc).encode()
+
+    def experiments_admin(self, body: bytes) -> tuple:
+        """POST /fleet/experiments (key-authed):
+
+        - ``{"action": "define", "experiment": {...}, "variants":
+          [...]}`` starts THE experiment over already-registered
+          gateway engines (``pio experiment start`` registers them
+          first via POST /fleet/engines);
+        - ``{"action": "conversions", "experiment": <name>,
+          "conversions": {<variant>: <total>, ...}}`` folds attributed
+          conversion totals into the online score (cumulative totals —
+          replays never double-count);
+        - ``{"action": "abort"[, "reason": ...]}`` kills it.
+
+        Every mutation publishes the seq'd cumulative experiment doc
+        to the worker spool (sync-before-mutate, same as the engine
+        table) so siblings and respawns agree."""
+        try:
+            doc = json.loads(body or b"{}")
+        except json.JSONDecodeError:
+            raise _Reject(400, "the request body is not valid JSON")
+        if not isinstance(doc, dict):
+            raise _Reject(400, "the request body must be a JSON object")
+        self._sync_admin_once()
+        action = doc.get("action", "define")
+        if action == "define":
+            try:
+                config = ExperimentConfig.from_doc(doc["experiment"])
+                variants = [VariantSpec.from_doc(v)
+                            for v in doc["variants"]]
+            except (KeyError, TypeError, ValueError) as exc:
+                raise _Reject(400, f"invalid experiment definition: {exc}")
+            missing = [v.name for v in variants
+                       if self.gateway.get(v.name) is None]
+            if missing:
+                raise _Reject(400, "variants are not registered engines: "
+                                   f"{missing} (POST /fleet/engines first)")
+            try:
+                self.experiment.define(config, variants)
+            except ValueError as exc:
+                raise _Reject(400, str(exc))
+        elif action == "conversions":
+            counts = doc.get("conversions")
+            if not isinstance(counts, dict):
+                raise _Reject(400, 'expected {"conversions": '
+                                   '{<variant>: <total>}}')
+            for variant, count in counts.items():
+                try:
+                    self.experiment.record_conversions(
+                        str(variant), int(count))
+                except (TypeError, ValueError):
+                    raise _Reject(400, f"invalid conversion count for "
+                                       f"{variant!r}: {count!r}")
+        elif action == "abort":
+            self.experiment.abort(str(doc.get("reason")
+                                      or "operator abort"))
+        else:
+            raise _Reject(400, f"unknown experiment action {action!r}")
+        self.experiment.tick()
+        return (200, {"experiment": self.experiment.snapshot()})
+
 
 #: canned reason phrases for the statuses the router emits (the full
 #: http.HTTPStatus table costs a lookup per response; this is a dict hit)
@@ -892,6 +1069,7 @@ class _Handler(socketserver.StreamRequestHandler):
         "/fleet": "fleet",
         "/fleet/canary": "fleet",
         "/fleet/engines": "fleet",
+        "/fleet/experiments": "fleet",
         "/metrics": "metrics",
         "/fleet/metrics": "metrics",
         "/traces.json": "traces",
@@ -1013,6 +1191,12 @@ class _Handler(socketserver.StreamRequestHandler):
                 self.service.slo.record(ok=status < 500, latency_s=dt)
                 self.service.gateway.record_outcome(
                     engine, ok=status < 500, latency_s=dt)
+                if engine:
+                    # same outcome feeds the experiment plane: the
+                    # controller ignores engines that are not variants
+                    # of a live experiment (experiment/controller.py)
+                    self.service.experiment.record(
+                        engine, ok=status < 500, latency_s=dt)
             if trace is not None:
                 trace.finish(status=status, **{
                     k: v for k, v in log_extra.items() if v or k == "attempts"})

@@ -102,6 +102,19 @@ def _status_router(args) -> int:
                       + (f" | last {last}:{reason}" if last else ""))
         print(f"[INFO]  {marker} {name}: "
               f"{'; '.join(parts) or 'no backends'} | {state}")
+    experiment = doc.get("experiment")
+    if experiment:
+        decision = experiment.get("decision") or {}
+        verdict = (f" — winner {decision.get('winner')}"
+                   if decision.get("winner") else "")
+        print(f"[INFO] Experiment {experiment.get('name')}: "
+              f"{experiment.get('state')}{verdict}")
+        for v in experiment.get("variants", []):
+            flag = "ABORTED" if v.get("aborted") else \
+                f"score {v.get('onlineScore')}"
+            print(f"[INFO]    {v.get('name')} ({v.get('weightPct'):g}%): "
+                  f"{v.get('requests')} req, {v.get('errors')} err, "
+                  f"{v.get('conversions')} conv | {flag}")
     return 0
 
 

@@ -1,0 +1,44 @@
+"""Experimentation platform: parallel grid eval → online A/B → promotion.
+
+Three legs, one closed loop (ROADMAP item 5; PredictionIO capability
+(5) raised from a single-process grid to the thing the multi-tenant
+fleet was built for):
+
+- :mod:`predictionio_tpu.experiment.grid` — fan ``engine.batch_eval``
+  grid points across short-lived eval worker processes with per-point
+  fault isolation (one crashed point = one FAILED result, never a dead
+  grid), streaming per-point results into the evaluation-instances
+  store (``pio eval --parallel N`` / ``PIO_EVAL_PARALLEL``);
+- :mod:`predictionio_tpu.experiment.controller` — the
+  :class:`ExperimentController` state machine (define → ramp → measure
+  → promote|abort) that splits live traffic across top-k grid points
+  deployed as named engines behind the gateway, scores them online
+  from routed outcomes + conversion attribution, and auto-promotes the
+  winner / auto-aborts losers through the CanaryController guardrail
+  discipline — all published over the admin spool so ``--workers``
+  siblings and respawns agree;
+- :mod:`predictionio_tpu.experiment.cli` — ``pio experiment``
+  (define/status/conversions) against a running ``pio router``.
+
+docs/experimentation.md is the operator guide.
+"""
+
+from predictionio_tpu.experiment.controller import (
+    ExperimentConfig,
+    ExperimentController,
+    VariantSpec,
+)
+from predictionio_tpu.experiment.grid import (
+    GridPointResult,
+    eval_points_collector,
+    run_parallel_grid,
+)
+
+__all__ = [
+    "ExperimentConfig",
+    "ExperimentController",
+    "VariantSpec",
+    "GridPointResult",
+    "eval_points_collector",
+    "run_parallel_grid",
+]

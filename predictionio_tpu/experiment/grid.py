@@ -1,0 +1,292 @@
+"""Parallel grid evaluation: per-point eval worker processes.
+
+Grid points are embarrassingly parallel — each point trains and scores
+its own fold set through ``engine.batch_eval`` with no shared state —
+so ``pio eval --parallel N`` fans them over N short-lived child
+processes riding the same :class:`~predictionio_tpu.fleet.supervisor.
+ProcessHandle` discipline the PR 9 supervisor uses for worker
+siblings. The contract the tests pin:
+
+- **per-point fault isolation** — a crashed (or poisoned) grid point
+  becomes ONE ``FAILED`` point result carrying the child's error; the
+  rest of the grid completes and the best point is picked over the
+  survivors. A grid is only lost when EVERY point fails.
+- **deterministic order** — results are assembled by grid index, not
+  completion order, so the evaluation-instance JSON is reproducible
+  regardless of scheduling.
+- **streaming** — the caller's ``on_point`` hook fires as each point
+  lands, which is how workflow/evaluation.py makes the partial grid
+  visible in the metadata store mid-run.
+
+Children hand results back through single-use JSON spool files written
+atomically (``os.replace``) under a per-run temp dir — the same
+crash-safe file discipline as the worker admin spool; a child that
+dies mid-write leaves a ``.tmp`` orphan, never a torn result.
+
+The fan-out only applies when the evaluator is a
+:class:`~predictionio_tpu.controller.evaluation.MetricEvaluator`
+(children ship plain metric scores, not live ``EvalDataSet`` objects);
+a custom evaluator falls back to the sequential path with a warning.
+Note the sequential path is also what preserves
+:class:`~predictionio_tpu.controller.fast_eval.FastEvalEngine` prefix
+sharing ACROSS points — parallelism trades that sharing for cores, a
+trade that only pays on a multi-core host (docs/experimentation.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import multiprocessing
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+from predictionio_tpu.controller.evaluation import (
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+    MetricScores,
+)
+from predictionio_tpu.controller.params import EngineParams
+from predictionio_tpu.fleet.supervisor import ProcessHandle
+from predictionio_tpu.obs.registry import Metric
+
+logger = logging.getLogger(__name__)
+
+#: point statuses (mirrors the evaluation-instance status vocabulary)
+COMPLETED, FAILED = "COMPLETED", "FAILED"
+
+#: how long the parent waits on any single child exit before re-polling
+#: the whole set (bounded join — the untimed-blocking-io contract)
+_JOIN_SLICE_S = 0.05
+
+_counts_lock = threading.Lock()
+_point_counts: dict[str, int] = {COMPLETED: 0, FAILED: 0}
+
+
+def _count_point(status: str) -> None:
+    with _counts_lock:
+        _point_counts[status] = _point_counts.get(status, 0) + 1
+
+
+def eval_points_collector() -> list[Metric]:
+    """``pio_eval_points_total{status}`` — grid points evaluated in
+    this process, by outcome. Registered on the router /metrics so the
+    family is part of the scrape contract; it counts wherever the grid
+    actually runs (the ``pio eval`` process, or tests)."""
+    with _counts_lock:
+        samples = [({"status": s.lower()}, float(n))
+                   for s, n in sorted(_point_counts.items())]
+    return [Metric("pio_eval_points_total", "counter",
+                   "Evaluation grid points finished, by status.",
+                   samples=samples)]
+
+
+@dataclasses.dataclass
+class GridPointResult:
+    """One grid point's outcome, in grid order."""
+
+    idx: int
+    status: str  # COMPLETED | FAILED
+    score: Any = None
+    other_scores: list[Any] = dataclasses.field(default_factory=list)
+    error: str = ""
+    duration_s: float = 0.0
+
+    def to_doc(self) -> dict[str, Any]:
+        doc: dict[str, Any] = {"idx": self.idx, "status": self.status,
+                               "score": self.score,
+                               "otherScores": self.other_scores,
+                               "durationS": round(self.duration_s, 3)}
+        if self.error:
+            doc["error"] = self.error
+        return doc
+
+
+def _json_safe(value: Any) -> Any:
+    """Scores cross the process boundary as JSON; anything exotic a
+    custom metric returns degrades to ``str`` rather than killing the
+    point on the way home."""
+    try:
+        json.dumps(value)
+        return value
+    except (TypeError, ValueError):
+        return str(value)
+
+
+def _eval_point_child(evaluation: Evaluation, evaluator: MetricEvaluator,
+                      ctx: Any, idx: int, engine_params: EngineParams,
+                      out_path: str) -> None:
+    """Child body: evaluate ONE grid point, spool the scores, exit.
+    Raising propagates to a nonzero exitcode, which the parent folds
+    into a FAILED point result — fault isolation is the parent's job,
+    the child just dies honestly."""
+    started = time.monotonic()
+    pairs = evaluation.engine.batch_eval(ctx, [engine_params])
+    if not pairs:
+        raise RuntimeError(f"batch_eval returned no data for point {idx}")
+    _, eval_data = pairs[0]
+    doc = {
+        "idx": idx,
+        "score": _json_safe(evaluator.metric.calculate(eval_data)),
+        "otherScores": [_json_safe(m.calculate(eval_data))
+                        for m in evaluator.other_metrics],
+        "durationS": time.monotonic() - started,
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out_path)
+
+
+def _collect_point(idx: int, exitcode: int | None, out_path: str,
+                   started: float) -> GridPointResult:
+    duration = time.monotonic() - started
+    if exitcode == 0 and os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                doc = json.load(f)
+            return GridPointResult(
+                idx=idx, status=COMPLETED, score=doc.get("score"),
+                other_scores=list(doc.get("otherScores") or []),
+                duration_s=float(doc.get("durationS") or duration))
+        except (OSError, ValueError) as exc:
+            return GridPointResult(
+                idx=idx, status=FAILED, duration_s=duration,
+                error=f"unreadable point result: {exc}")
+    return GridPointResult(
+        idx=idx, status=FAILED, duration_s=duration,
+        error=f"eval worker exited with code {exitcode}"
+              + ("" if os.path.exists(out_path) else " (no result spooled)"))
+
+
+def run_parallel_grid(
+    evaluation: Evaluation,
+    evaluator: MetricEvaluator,
+    params_list: Sequence[EngineParams],
+    ctx: Any,
+    parallel: int,
+    on_point: Callable[[GridPointResult, int, int], None] | None = None,
+) -> list[GridPointResult]:
+    """Fan the grid over ``parallel`` eval worker processes; returns
+    per-point results in grid-index order (module docstring has the
+    isolation/ordering/streaming contract). ``on_point(result, done,
+    total)`` fires after each point lands, in COMPLETION order."""
+    total = len(params_list)
+    width = max(1, min(int(parallel), total))
+    # fork shares the evaluation/engine/storage objects without
+    # pickling — the same start method the router worker pool rides
+    mp = multiprocessing.get_context("fork")
+    results: dict[int, GridPointResult] = {}
+    pending = list(enumerate(params_list))
+    live: dict[int, tuple[ProcessHandle, str, float]] = {}
+    done = 0
+
+    with tempfile.TemporaryDirectory(prefix="pio-eval-grid-") as spool:
+        def _spawn(idx: int, ep: EngineParams) -> None:
+            out_path = os.path.join(spool, f"point_{idx}.json")
+            handle = ProcessHandle(mp.Process(
+                target=_eval_point_child,
+                args=(evaluation, evaluator, ctx, idx, ep, out_path),
+                name=f"pio-eval-point-{idx}", daemon=True))
+            live[idx] = (handle, out_path, time.monotonic())
+
+        try:
+            while pending or live:
+                while pending and len(live) < width:
+                    idx, ep = pending.pop(0)
+                    _spawn(idx, ep)
+                # bounded join on the oldest child, then sweep ALL
+                # exits — one slow point never serializes collection
+                oldest = min(live, key=lambda i: live[i][2])
+                live[oldest][0].wait(timeout=_JOIN_SLICE_S)
+                for idx in [i for i, (h, _, _) in live.items()
+                            if h.poll() is not None]:
+                    handle, out_path, started = live.pop(idx)
+                    result = _collect_point(
+                        idx, handle.poll(), out_path, started)
+                    results[idx] = result
+                    done += 1
+                    _count_point(result.status)
+                    if result.status == FAILED:
+                        logger.warning("grid point %d FAILED: %s",
+                                       idx, result.error)
+                    else:
+                        logger.info("grid point %d/%d: score=%s",
+                                    idx, total, result.score)
+                    if on_point is not None:
+                        on_point(result, done, total)
+        finally:
+            for handle, _, _ in live.values():
+                handle.kill()
+                handle.wait(timeout=5.0)
+
+    return [results[i] for i in sorted(results)]
+
+
+def result_from_points(
+    evaluator: MetricEvaluator,
+    params_list: Sequence[EngineParams],
+    points: Sequence[GridPointResult],
+    evaluation: Evaluation | None = None,
+) -> MetricEvaluatorResult:
+    """Reassemble a :class:`MetricEvaluatorResult` from per-point
+    results: ``engine_params_scores`` covers EVERY grid point in order
+    (failed points carry a ``None`` score so downstream indices line
+    up with the grid), while best-tracking only compares survivors.
+    Raises when every point failed — a grid with no surviving point
+    has no result to persist, and the caller records FAILED."""
+    scores: list[tuple[EngineParams, MetricScores]] = []
+    best_idx = -1
+    for point in points:
+        ms = MetricScores(score=point.score,
+                          other_scores=list(point.other_scores))
+        scores.append((params_list[point.idx], ms))
+        if point.status != COMPLETED:
+            continue
+        if best_idx < 0 or evaluator.metric.compare(
+                ms.score, scores[best_idx][1].score) > 0:
+            best_idx = point.idx
+    if best_idx < 0:
+        raise RuntimeError(
+            "every grid point failed: "
+            + "; ".join(f"[{p.idx}] {p.error}" for p in points))
+    best_params, best_score = scores[best_idx]
+    result = MetricEvaluatorResult(
+        best_score=best_score,
+        best_engine_params=best_params,
+        best_idx=best_idx,
+        metric_header=evaluator.metric.header,
+        other_metric_headers=[m.header for m in evaluator.other_metrics],
+        engine_params_scores=scores,
+        output_path=evaluator.output_path,
+    )
+    if evaluator.output_path and evaluation is not None:
+        evaluator._save_best_json(evaluation, best_params)
+    return result
+
+
+def partial_grid_doc(points: Sequence[GridPointResult],
+                     total: int) -> str:
+    """The mid-run evaluation-instance JSON: which points have landed
+    (by grid index) and how many remain — readable while the grid is
+    still running, which is the round-trip the persistence tests pin."""
+    by_idx = sorted(points, key=lambda p: p.idx)
+    return json.dumps({
+        "gridTotal": total,
+        "gridDone": len(by_idx),
+        "points": [p.to_doc() for p in by_idx],
+    }, indent=2)
+
+
+def count_sequential_points(n_completed: int, failed: bool = False) -> None:
+    """Fold the sequential path's outcome into the same
+    ``pio_eval_points_total`` family the parallel path feeds."""
+    for _ in range(max(0, n_completed)):
+        _count_point(COMPLETED)
+    if failed:
+        _count_point(FAILED)

@@ -75,8 +75,11 @@ from predictionio_tpu.utils.resilience import (
 logger = logging.getLogger(__name__)
 
 #: request headers the router forwards verbatim to the backend (plus
-#: the recomputed deadline and the correlation id)
-_FORWARD_HEADERS = ("content-type", "accept")
+#: the recomputed deadline and the correlation id); the experiment
+#: attribution pair is how an assigned variant id reaches the engine
+#: server's response stamp + feedback event (experiment/controller.py)
+_FORWARD_HEADERS = ("content-type", "accept",
+                    "x-pio-experiment", "x-pio-variant")
 
 
 class UpstreamStatusError(TransientError):

@@ -91,7 +91,10 @@ class EngineInstance:
 class EvaluationInstance:
     """One row per evaluation run. Parity: EvaluationInstances.scala:42-60."""
     id: str
-    status: str              # INIT | EVALUATING | EVALCOMPLETED
+    #: INIT | EVALUATING | EVALCOMPLETED | FAILED — EVALUATING rows
+    #: carry the partial grid (readable mid-run), FAILED rows carry
+    #: the crash that would otherwise strand them at INIT forever
+    status: str
     start_time: datetime
     completion_time: datetime
     evaluation_class: str = ""

@@ -178,18 +178,27 @@ def _configure_eval(sub) -> None:
                    help="EngineParamsGenerator class spec (defaults to the "
                         "evaluation module's own generator if omitted)")
     p.add_argument("--batch", default="")
+    p.add_argument("--parallel", type=int, default=None, metavar="N",
+                   help="fan grid points over N eval worker processes "
+                        "(default: PIO_EVAL_PARALLEL or 1 = sequential)")
 
 
 def _cmd_eval(args, storage) -> int:
     from predictionio_tpu.workflow.evaluation import run_evaluation
 
     generator = args.params_generator or _default_generator(args.evaluation)
-    outcome = run_evaluation(
-        args.evaluation,
-        generator,
-        workflow_params=WorkflowParams(batch=args.batch),
-        storage=storage,
-    )
+    try:
+        outcome = run_evaluation(
+            args.evaluation,
+            generator,
+            workflow_params=WorkflowParams(batch=args.batch),
+            storage=storage,
+            parallel=args.parallel,
+        )
+    except Exception as exc:
+        # the instance row already says FAILED (workflow/evaluation.py)
+        print(f"[ERROR] Evaluation failed: {exc}")
+        return 1
     print(f"[INFO] Evaluation finished: instance {outcome.instance_id}")
     print(f"[INFO] {outcome.result.to_one_liner()}")
     return 0
@@ -836,3 +845,6 @@ register_command("build", _configure_build, _cmd_build)
 register_command("run", _configure_run, _cmd_run)
 register_command("upgrade", _configure_upgrade, _cmd_upgrade)
 register_command("template", _configure_template, _cmd_template)
+
+# `pio experiment` registers itself on import, same extension point
+import predictionio_tpu.experiment.cli  # noqa: E402,F401

@@ -6,12 +6,26 @@ EvaluationWorkflow.scala:32-43, Workflow.scala:82-138}: resolve the
 Evaluation + EngineParamsGenerator, record an INIT EvaluationInstance,
 run ``engine.batch_eval`` over the grid, score with the evaluator, and
 persist the result renders (one-liner / HTML / JSON) on the instance.
+
+Beyond parity:
+
+- a raising ``batch_eval``/evaluator persists a **FAILED** instance
+  (the reference — and the seed — stranded the row at INIT forever,
+  so ``pio status`` could not tell a crash from a run in flight);
+- ``parallel > 1`` (``pio eval --parallel N`` / ``PIO_EVAL_PARALLEL``)
+  fans grid points over short-lived eval worker processes
+  (experiment/grid.py) with per-point fault isolation, streaming each
+  point into the instance row as it lands — the instance is readable
+  MID-RUN (status ``EVALUATING``, partial grid in
+  ``evaluator_results_json``).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
+import os
 from datetime import datetime, timezone
 from typing import Any
 
@@ -19,6 +33,7 @@ from predictionio_tpu.controller.evaluation import (
     BaseEvaluatorResult,
     EngineParamsGenerator,
     Evaluation,
+    MetricEvaluator,
 )
 from predictionio_tpu.storage.base import EvaluationInstance
 from predictionio_tpu.storage.registry import Storage
@@ -43,6 +58,17 @@ def resolve_object(spec: str) -> Any:
     return obj
 
 
+def resolve_parallel(parallel: int | None) -> int:
+    """``--parallel`` beats ``PIO_EVAL_PARALLEL`` beats 1 (the flag
+    pattern every serving knob follows)."""
+    if parallel is not None:
+        return max(1, int(parallel))
+    try:
+        return max(1, int(os.environ.get("PIO_EVAL_PARALLEL", "1")))
+    except ValueError:
+        return 1
+
+
 @dataclasses.dataclass
 class EvalOutcome:
     instance_id: str
@@ -56,11 +82,15 @@ def run_evaluation(
     workflow_params: WorkflowParams = WorkflowParams(),
     storage: Storage | None = None,
     ctx: EngineContext | None = None,
+    parallel: int | None = None,
 ) -> EvalOutcome:
     """Evaluate an engine over a params grid and persist the results.
 
     ``evaluation`` / ``engine_params_generator`` may be instances
-    (programmatic use) or spec strings (CLI path).
+    (programmatic use) or spec strings (CLI path). ``parallel`` > 1
+    fans grid points over that many eval worker processes (None reads
+    ``PIO_EVAL_PARALLEL``; the default stays sequential, which also
+    preserves FastEvalEngine pipeline-prefix sharing across points).
     """
     if isinstance(evaluation, str):
         evaluation = resolve_object(evaluation)
@@ -92,10 +122,33 @@ def run_evaluation(
     engine = evaluation.engine
     evaluator = evaluation.evaluator
     params_list = engine_params_generator.engine_params_list
+    parallel = resolve_parallel(parallel)
 
-    # EvaluationWorkflow.runEvaluation (EvaluationWorkflow.scala:34-42)
-    engine_eval_data_set = engine.batch_eval(ctx, params_list)
-    result = evaluator.evaluate(ctx, evaluation, engine_eval_data_set)
+    try:
+        if parallel > 1 and isinstance(evaluator, MetricEvaluator):
+            result = _run_parallel(evaluation, evaluator, params_list,
+                                   ctx, parallel, instances, instance_id)
+        else:
+            if parallel > 1:
+                logger.warning(
+                    "--parallel %d ignored: %s is not a MetricEvaluator "
+                    "(children ship plain scores, not EvalDataSets) — "
+                    "falling back to the sequential path",
+                    parallel, type(evaluator).__name__)
+            # EvaluationWorkflow.runEvaluation
+            # (EvaluationWorkflow.scala:34-42)
+            engine_eval_data_set = engine.batch_eval(ctx, params_list)
+            result = evaluator.evaluate(ctx, evaluation, engine_eval_data_set)
+            from predictionio_tpu.experiment.grid import (
+                count_sequential_points,
+            )
+            count_sequential_points(len(params_list))
+    except Exception as exc:
+        # the seed stranded a crashed run at INIT forever; persist the
+        # failure so `pio status` (and `pio experiment`) can tell a
+        # crash from a run in flight — then fail the caller honestly
+        _persist_failed(instances, instance_id, exc)
+        raise
 
     # CoreWorkflow.runEvaluation persistence (CoreWorkflow.scala:137-155);
     # noSave results leave the instance row at INIT, like the reference.
@@ -114,3 +167,58 @@ def run_evaluation(
     logger.info("evaluation instance %s: EVALCOMPLETED — %s",
                 instance_id, result.to_one_liner())
     return EvalOutcome(instance_id, "EVALCOMPLETED", result)
+
+
+def _run_parallel(evaluation, evaluator, params_list, ctx, parallel,
+                  instances, instance_id):
+    """The parallel grid: stream each finished point into the instance
+    row (status EVALUATING — partial grid visible mid-run), then
+    reassemble the full MetricEvaluatorResult. Imported lazily so the
+    sequential path never pays for multiprocessing plumbing."""
+    from predictionio_tpu.experiment.grid import (
+        partial_grid_doc,
+        result_from_points,
+        run_parallel_grid,
+    )
+
+    total = len(params_list)
+    seen = []
+
+    def _stream(point, done, _total):
+        seen.append(point)
+        row = dataclasses.replace(
+            instances.get(instance_id),
+            status="EVALUATING",
+            evaluator_results_json=partial_grid_doc(seen, total))
+        instances.update(row)
+
+    logger.info("evaluation instance %s: EVALUATING "
+                "(%d grid points over %d eval workers)",
+                instance_id, total, parallel)
+    points = run_parallel_grid(evaluation, evaluator, params_list, ctx,
+                               parallel, on_point=_stream)
+    result = result_from_points(evaluator, params_list, points,
+                                evaluation=evaluation)
+    # the final JSON keeps the MetricEvaluatorResult contract
+    # (metricHeader/bestIdx/engineParamsScores — what `pio experiment`
+    # consumes) and adds the per-point status ledger
+    doc = json.loads(result.to_json())
+    doc["points"] = [p.to_doc() for p in points]
+    result.to_json = lambda: json.dumps(doc, indent=2)  # type: ignore[method-assign]
+    return result
+
+
+def _persist_failed(instances, instance_id: str, exc: Exception) -> None:
+    try:
+        failed = dataclasses.replace(
+            instances.get(instance_id),
+            status="FAILED",
+            completion_time=_now(),
+            evaluator_results=f"{type(exc).__name__}: {exc}",
+        )
+        instances.update(failed)
+        logger.error("evaluation instance %s: FAILED — %s",
+                     instance_id, exc)
+    except Exception:  # pragma: no cover - metadata store itself down
+        logger.exception("could not persist FAILED status for "
+                         "evaluation instance %s", instance_id)

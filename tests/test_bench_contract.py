@@ -112,6 +112,20 @@ def tiny_bench(monkeypatch):
                               "elasticity_burst_admitted_control": 5,
                               "elasticity_host_cores": 2,
                               "elasticity_host_cores_caveat": None})
+    # experiment forks eval worker children for the grid 1-vs-N ratio
+    # (bench_experiment.py) — stubbed here; the real tiny harness is
+    # the slow-marked test below
+    monkeypatch.setattr(
+        bench, "bench_experiment_section",
+        lambda shrunk=False: {"experiment_grid_speedup_x": 1.0,
+                              "experiment_grid_points": 4,
+                              "experiment_grid_parallel": 2,
+                              "experiment_grid_seq_s": 0.4,
+                              "experiment_grid_par_s": 0.4,
+                              "experiment_grid_failed_points": 0,
+                              "experiment_assign_ops_per_s": 10_000.0,
+                              "experiment_host_cores": 2,
+                              "experiment_host_cores_caveat": None})
     # train_sharding spawns a forced-8-device jax subprocess child
     # (bench_sharding.py) — stubbed here; the real tiny harness is the
     # slow-marked test below
@@ -166,6 +180,11 @@ def test_single_json_line_with_primary_contract(tiny_bench, capsys, monkeypatch)
                 "elasticity_b_http_5xx", "elasticity_throttled_429",
                 "elasticity_burst_admitted_with_credits",
                 "elasticity_host_cores_caveat",
+                # the experimentation-platform trajectory keys (PR 20)
+                "experiment_grid_speedup_x",
+                "experiment_grid_failed_points",
+                "experiment_assign_ops_per_s",
+                "experiment_host_cores_caveat",
                 # the shared-memory serving-plane trajectory keys (PR 18)
                 "shm_qps_2w_private", "shm_qps_2w_shm",
                 "shm_hit_ratio_2w_shm", "shm_rewarm_misses_2w_private",
@@ -228,6 +247,8 @@ def test_skip_heavy_lists_skipped_sections(tiny_bench, capsys, monkeypatch):
     assert "gateway_quota_neighbor_p99_ratio_x" in line
     # elasticity runs SHRUNK under --skip-heavy too
     assert "elasticity_compliant_p99_ratio_x" in line
+    # experiment runs SHRUNK under --skip-heavy too
+    assert "experiment_grid_speedup_x" in line
     # shm_cache runs SHRUNK under --skip-heavy too
     assert "shm_rewarm_misses_2w_shm" in line
 
@@ -332,6 +353,33 @@ def test_elasticity_harness_contract_tiny():
     assert set(r["scale_decisions"]) == {"diurnal", "spiky", "abusive"}
     # honest 1-core caveat: present exactly when the host is too small
     # for multi-process ratios to be pins
+    cores = os.cpu_count() or 1
+    if cores < 2:
+        assert r["host_cores_caveat"] and "NOT a pin" in r["host_cores_caveat"]
+    else:
+        assert r["host_cores_caveat"] is None
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+@pytest.mark.experiment
+def test_experiment_harness_contract_tiny():
+    """bench_experiment.py's real harness at tiny scale: the same grid
+    through run_parallel_grid at width 1 and width 2 (zero failed
+    points on a healthy grid), plus the assign()/record() loop, with
+    the honest 1-core caveat contract (the keys
+    BENCH_experiment_rNN.json records). Slow-marked: deliberate
+    per-point CPU burn times the grid twice."""
+    import os
+
+    import bench_experiment
+
+    r = bench_experiment.bench_experiment(points=3, parallel=2,
+                                          work_ms=10.0, ops=2_000)
+    assert r["grid"]["value"] > 0
+    assert r["grid"]["failed_points"] == 0
+    assert r["grid"]["seq_s"] > 0 and r["grid"]["par_s"] > 0
+    assert r["assign"]["value"] > 0
     cores = os.cpu_count() or 1
     if cores < 2:
         assert r["host_cores_caveat"] and "NOT a pin" in r["host_cores_caveat"]

@@ -1,0 +1,313 @@
+"""ExperimentController state machine (experiment/controller.py).
+
+Everything runs on ManualClock — the whole define → ramp → measure →
+promote|abort lifecycle is deterministic, no sleeps, no servers. The
+e2e round-trip (real router, live traffic) lives in
+tests/test_experiment_e2e.py; this file pins the verdict logic itself.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from predictionio_tpu.experiment.controller import (
+    ABORTED,
+    MEASURE,
+    PROMOTED,
+    RAMP,
+    ExperimentConfig,
+    ExperimentController,
+    VariantSpec,
+)
+from predictionio_tpu.fleet.canary import GuardrailConfig
+from predictionio_tpu.utils.resilience import ManualClock
+
+pytestmark = pytest.mark.experiment
+
+
+class FakeGateway:
+    """Records promotion actions; retire of an unknown engine raises
+    KeyError like the real gateway (the idempotence contract)."""
+
+    def __init__(self):
+        self.engines = {"a", "b", "c"}
+        self.defaults: list[str] = []
+        self.retired: list[str] = []
+
+    def set_default(self, name):
+        if name not in self.engines:
+            raise KeyError(name)
+        self.defaults.append(name)
+
+    def retire(self, name):
+        if name not in self.engines:
+            raise KeyError(name)
+        self.engines.discard(name)
+        self.retired.append(name)
+
+
+def _config(**overrides) -> ExperimentConfig:
+    kwargs = dict(
+        name="exp", ramp_s=5.0, measure_s=30.0, min_requests=4,
+        conversion_weight=0.5,
+        guardrail=GuardrailConfig(min_requests=5, max_error_rate=0.4,
+                                  max_p99_ms=0.0, window=20))
+    kwargs.update(overrides)
+    return ExperimentConfig(**kwargs)
+
+
+def _controller(clock=None, gateway=None, seed=7):
+    return ExperimentController(gateway=gateway,
+                                clock=clock or ManualClock(),
+                                rng=random.Random(seed))
+
+
+def _variants(*names):
+    weight = 100.0 / len(names)
+    return [VariantSpec(name=n, weight_pct=weight, grid_idx=i)
+            for i, n in enumerate(names)]
+
+
+def _feed(ctl, variant, n, ok=True, latency_s=0.01):
+    for _ in range(n):
+        ctl.record(variant, ok=ok, latency_s=latency_s)
+
+
+class TestLifecycle:
+    def test_define_validates(self):
+        ctl = _controller()
+        with pytest.raises(ValueError, match="at least one"):
+            ctl.define(_config(), [])
+        with pytest.raises(ValueError, match="duplicate"):
+            ctl.define(_config(), _variants("a", "a"))
+
+    def test_ramp_then_measure_then_promote(self):
+        clock = ManualClock()
+        gw = FakeGateway()
+        ctl = _controller(clock, gw)
+        ctl.define(_config(), _variants("a", "b"))
+        assert ctl.snapshot()["state"] == RAMP
+
+        # ramp never promotes, however good the numbers
+        _feed(ctl, "a", 10)
+        _feed(ctl, "b", 10)
+        assert not ctl.tick()
+        assert ctl.snapshot()["state"] == RAMP
+
+        clock.advance(5.0)
+        assert ctl.tick()
+        assert ctl.snapshot()["state"] == MEASURE
+
+        # measure window not elapsed → no verdict
+        assert not ctl.tick()
+        clock.advance(30.0)
+        # b carries errors: lower success rate, a must win (record()
+        # ticks opportunistically — the verdict lands with the sample)
+        _feed(ctl, "b", 2, ok=False)
+        snap = ctl.snapshot()
+        assert snap["state"] == PROMOTED
+        assert snap["decision"]["winner"] == "a"
+        assert "scores" in snap["decision"]
+        # promotion = default switch + loser retire on the gateway
+        assert gw.defaults == ["a"]
+        assert gw.retired == ["b"]
+        # terminal: further ticks are no-ops
+        assert not ctl.tick()
+
+    def test_promotion_waits_for_min_requests_on_every_arm(self):
+        clock = ManualClock()
+        ctl = _controller(clock)
+        ctl.define(_config(), _variants("a", "b"))
+        clock.advance(5.0)
+        ctl.tick()
+        clock.advance(30.0)
+        _feed(ctl, "a", 10)
+        _feed(ctl, "b", 3)          # under min_requests=4
+        assert not ctl.tick()
+        assert ctl.snapshot()["state"] == MEASURE
+        _feed(ctl, "b", 1)          # record() ticks opportunistically
+        assert ctl.snapshot()["state"] == PROMOTED
+
+    def test_operator_abort_is_terminal(self):
+        ctl = _controller()
+        ctl.define(_config(), _variants("a", "b"))
+        ctl.abort("rollback")
+        snap = ctl.snapshot()
+        assert snap["state"] == ABORTED
+        assert snap["decision"]["reason"] == "rollback"
+        assert ctl.assign() is None
+
+
+class TestGuardrail:
+    def test_breaching_variant_auto_aborts(self):
+        ctl = _controller()
+        ctl.define(_config(), _variants("a", "b"))
+        _feed(ctl, "a", 10)
+        tripped = [ctl.record("b", ok=False, latency_s=0.01)
+                   for _ in range(6)]
+        assert any(tripped)
+        snap = {v["name"]: v for v in ctl.snapshot()["variants"]}
+        assert snap["b"]["aborted"] and not snap["a"]["aborted"]
+        # an aborted arm never gets traffic again
+        assert all(ctl.assign() == ("exp", "a") for _ in range(20))
+
+    def test_all_arms_breached_aborts_the_experiment(self):
+        gw = FakeGateway()
+        ctl = _controller(gateway=gw)
+        ctl.define(_config(), _variants("a", "b"))
+        _feed(ctl, "a", 6, ok=False)
+        _feed(ctl, "b", 6, ok=False)
+        snap = ctl.snapshot()
+        assert snap["state"] == ABORTED
+        assert snap["decision"]["winner"] is None
+        # nothing promoted; every arm retired, default untouched
+        assert gw.defaults == []
+        assert sorted(gw.retired) == ["a", "b"]
+
+
+class TestConversions:
+    def test_conversions_decide_ties(self):
+        clock = ManualClock()
+        ctl = _controller(clock)
+        ctl.define(_config(), _variants("a", "b"))
+        clock.advance(5.0)
+        ctl.tick()
+        _feed(ctl, "a", 10)
+        _feed(ctl, "b", 10)
+        assert ctl.record_conversions("b", 7)
+        clock.advance(30.0)
+        ctl.tick()
+        snap = ctl.snapshot()
+        assert snap["decision"]["winner"] == "b"
+        scores = snap["decision"]["scores"]
+        # (1-w)*success + w*conversion, w=0.5: a = 0.5, b = 0.5 + 0.35
+        assert scores["a"] == pytest.approx(0.5)
+        assert scores["b"] == pytest.approx(0.85)
+
+    def test_totals_are_cumulative_never_double_counted(self):
+        ctl = _controller()
+        ctl.define(_config(), _variants("a"))
+        _feed(ctl, "a", 10)
+        assert ctl.record_conversions("a", 5)
+        assert ctl.record_conversions("a", 3)      # stale replay: no-op
+        assert ctl.record_conversions("a", 5)      # same total: no-op
+        assert [v["conversions"] for v in ctl.snapshot()["variants"]] == [5]
+        assert not ctl.record_conversions("ghost", 1)
+
+    def test_conversion_rate_capped_at_one(self):
+        ctl = _controller()
+        ctl.define(_config(conversion_weight=1.0), _variants("a"))
+        _feed(ctl, "a", 4)
+        ctl.record_conversions("a", 400)
+        assert ctl.snapshot()["variants"][0]["onlineScore"] == 1.0
+
+
+class TestAssign:
+    def test_weighted_split_respects_weights(self):
+        ctl = _controller(seed=123)
+        ctl.define(_config(), [VariantSpec("a", 90.0),
+                               VariantSpec("b", 10.0)])
+        picks = [ctl.assign()[1] for _ in range(400)]
+        share_a = picks.count("a") / len(picks)
+        assert 0.8 < share_a < 1.0
+        assert picks.count("b") > 0
+
+    def test_no_experiment_no_assignment(self):
+        assert _controller().assign() is None
+
+    def test_terminal_states_stop_splitting(self):
+        clock = ManualClock()
+        ctl = _controller(clock)
+        ctl.define(_config(measure_s=0.0), _variants("a"))
+        clock.advance(5.0)
+        ctl.tick()
+        _feed(ctl, "a", 4)
+        assert ctl.snapshot()["state"] == PROMOTED
+        assert ctl.assign() is None
+
+
+class TestSpoolRoundTrip:
+    """state_doc/adopt_state: the seq'd cumulative doc that rides the
+    worker admin spool (the canary-plane discipline)."""
+
+    def test_adopt_fresh_then_stale_is_ignored(self):
+        src = _controller()
+        src.define(_config(), _variants("a", "b"))
+        src.record_conversions("a", 3)
+        doc = src.state_doc()
+
+        dst = _controller()
+        assert dst.adopt_state(doc)
+        snap = dst.snapshot()
+        assert snap["name"] == "exp" and snap["state"] == RAMP
+        assert {v["name"]: v["conversions"] for v in snap["variants"]} \
+            == {"a": 3, "b": 0}
+        # same seq again: a no-op, local state untouched
+        assert not dst.adopt_state(doc)
+        assert not dst.adopt_state({"seq": 0})
+
+    def test_abort_latch_and_decision_propagate(self):
+        src = _controller()
+        src.define(_config(), _variants("a", "b"))
+        _feed(src, "b", 6, ok=False)               # b trips its guardrail
+        dst = _controller()
+        assert dst.adopt_state(src.state_doc())
+        snap = {v["name"]: v for v in dst.snapshot()["variants"]}
+        assert snap["b"]["aborted"] and not snap["a"]["aborted"]
+        # the sibling's own windows keep feeding ITS copy — a local
+        # re-abort of an adopted abort must not bump seq forever
+        before = dst.snapshot()["seq"]
+        assert not dst.adopt_state(src.state_doc())
+        assert dst.snapshot()["seq"] == before
+
+    def test_conversions_merge_by_max(self):
+        src = _controller()
+        src.define(_config(), _variants("a"))
+        src.record_conversions("a", 2)
+        dst = _controller()
+        dst.adopt_state(src.state_doc())
+        dst.record_conversions("a", 9)             # local knows more
+        src.record_conversions("a", 4)
+        doc = src.state_doc()
+        doc["seq"] = 99                            # force adoption
+        dst.adopt_state(doc)
+        assert dst.snapshot()["variants"][0]["conversions"] == 9
+
+    def test_malformed_docs_never_take_the_plane_down(self):
+        ctl = _controller()
+        ctl.define(_config(), _variants("a"))
+        before = ctl.state_doc()
+        for junk in (None, 17, "x", {}, {"seq": "NaN-ish", "config": {}},
+                     {"seq": 99, "config": {"name": "e"}, "state": RAMP}):
+            assert not ctl.adopt_state(junk)
+        assert ctl.state_doc() == before
+
+
+class TestCollector:
+    def test_metric_families_and_state_codes(self):
+        clock = ManualClock()
+        ctl = _controller(clock)
+        ctl.define(_config(measure_s=0.0), _variants("a", "b", "c"))
+        _feed(ctl, "b", 6, ok=False)               # b aborts
+        clock.advance(5.0)
+        ctl.tick()
+        _feed(ctl, "a", 4)
+        _feed(ctl, "c", 4, ok=False)               # worse, but no trip yet
+        ctl.record_conversions("a", 2)
+        metrics = {m.name: m for m in ctl.collector()}
+        assert set(metrics) == {
+            "pio_experiment_state", "pio_experiment_conversions_total",
+            "pio_experiment_requests_total", "pio_experiment_online_score"}
+        state = {labels["variant"]: value
+                 for labels, value in metrics["pio_experiment_state"].samples}
+        assert state["a"] == 2.0                   # promoted winner
+        assert state["b"] == 0.0                   # aborted
+        conv = {labels["variant"]: value
+                for labels, value
+                in metrics["pio_experiment_conversions_total"].samples}
+        assert conv["a"] == 2.0
+
+    def test_empty_before_define(self):
+        assert _controller().collector() == []

@@ -1,0 +1,274 @@
+"""The experimentation-platform acceptance pin (ISSUE 20).
+
+A live router splits bare /queries.json traffic across deployed
+variants; the breaching variant auto-aborts, the healthy one
+auto-promotes to the gateway default with ZERO 5xx on the survivor;
+served responses carry experiment/variant attribution; conversion
+events swept from the event store fold into the online score; and a
+promotion decided in one ``--workers`` sibling survives both sibling
+adoption and a fresh respawn via the admin spool.
+
+Echo-replica + router plumbing reused from tests/test_fleet_router.py.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import tempfile
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api.router_server import RouterServer
+from predictionio_tpu.core.datamap import DataMap
+from predictionio_tpu.core.event import Event
+from predictionio_tpu.experiment.cli import sweep_conversions
+from predictionio_tpu.fleet.gateway import EngineSpec
+from predictionio_tpu.fleet.router import RouterConfig
+
+from tests.netutil import wait_until
+from tests.test_fleet_router import (
+    echo_server,
+    get_json,
+    get_metrics,
+    post_query,
+)
+
+pytestmark = pytest.mark.experiment
+
+
+def experiments_post(port: int, payload: dict):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/fleet/experiments",
+        data=json.dumps(payload).encode(), method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _experiment_doc(**overrides) -> dict:
+    doc = {"name": "exp", "rampS": 0.0, "measureS": 1.0,
+           "minRequests": 10, "conversionWeight": 0.5,
+           "guardrail": {"minRequests": 5, "maxErrorRate": 0.4,
+                         "maxP99Ms": 0.0, "window": 50}}
+    doc.update(overrides)
+    return doc
+
+
+def _snapshot(port: int) -> dict | None:
+    status, doc = get_json(port, "/fleet/experiments")
+    assert status == 200
+    return doc.get("experiment")
+
+
+class TestExperimentE2E:
+    def test_abort_promote_attribution_zero_5xx_on_survivor(self):
+        good = echo_server("good0")
+        bad = echo_server("bad0", fail=True)
+        base = echo_server("base0")
+        router = RouterServer(RouterConfig(
+            ip="127.0.0.1", port=0,
+            engines=(
+                EngineSpec(name="base",
+                           backends=(f"127.0.0.1:{base.port}",)),
+                EngineSpec(name="expA",
+                           backends=(f"127.0.0.1:{good.port}",)),
+                EngineSpec(name="expB",
+                           backends=(f"127.0.0.1:{bad.port}",)),
+            ),
+            default_engine="base", probe_interval_s=0.25,
+            admin_sync_interval_s=0.1))
+        router.start()
+        try:
+            wait_until(lambda: post_query(router.port, {"q": 0})[0] == 200,
+                       timeout=10.0, message="fleet is up")
+
+            # a variant that is not a registered engine is refused
+            status, doc = experiments_post(router.port, {
+                "action": "define", "experiment": _experiment_doc(),
+                "variants": [{"name": "ghost", "weightPct": 100}]})
+            assert status == 400
+            assert "not registered engines" in doc["message"]
+
+            status, _ = experiments_post(router.port, {
+                "action": "define", "experiment": _experiment_doc(),
+                "variants": [
+                    {"name": "expA", "weightPct": 50, "gridIdx": 0,
+                     "offlineScore": 3.0},
+                    {"name": "expB", "weightPct": 50, "gridIdx": 1,
+                     "offlineScore": 2.0}]})
+            assert status == 200
+
+            # live traffic: bare-path queries split across variants,
+            # every assigned response carries the attribution stamp
+            survivor_5xx = 0
+            attributed = set()
+            for i in range(300):
+                s, body, hdrs = post_query(router.port, {"q": i})
+                variant = hdrs.get("x-pio-variant")
+                if variant:
+                    assert hdrs.get("x-pio-experiment") == "exp"
+                    attributed.add(variant)
+                    if variant == "expA":
+                        assert s == 200
+                        # the replica stamped the body via the
+                        # forwarded attribution headers
+                        assert body["experimentId"] == "exp"
+                        assert body["variantId"] == "expA"
+                        assert body["tag"] == "good0"
+                        if s >= 500:
+                            survivor_5xx += 1
+                snap = _snapshot(router.port)
+                aborted = {v["name"] for v in snap["variants"]
+                           if v["aborted"]}
+                if aborted:
+                    break
+            assert attributed >= {"expA", "expB"}
+            assert aborted == {"expB"}
+            assert survivor_5xx == 0
+
+            # conversions fold into the online score while measuring
+            status, doc = experiments_post(router.port, {
+                "action": "conversions", "experiment": "exp",
+                "conversions": {"expA": 5}})
+            assert status == 200
+            expa = {v["name"]: v
+                    for v in doc["experiment"]["variants"]}["expA"]
+            assert expa["conversions"] == 5
+            # (1-w)*success + w*conv_rate with a clean success record:
+            # the conversion term pushes the score above 0.5
+            assert expa["onlineScore"] > 0.5
+
+            # keep traffic flowing until the measure window closes and
+            # the survivor is promoted
+            def promoted():
+                s, _, _ = post_query(router.port, {"q": "tick"})
+                snap = _snapshot(router.port)
+                return snap["state"] == "PROMOTED"
+            wait_until(promoted, timeout=15.0,
+                       message="survivor promoted to default")
+
+            snap = _snapshot(router.port)
+            assert snap["decision"]["winner"] == "expA"
+            assert {v["name"]: v["conversions"]
+                    for v in snap["variants"]}["expA"] == 5
+
+            # promotion on the gateway: expA is the default engine,
+            # the loser is retired, bare traffic serves the winner
+            # with zero 5xx and no further experiment assignment
+            status, doc = get_json(router.port, "/fleet/engines")
+            assert doc["defaultEngine"] == "expA"
+            names = {e["name"] for e in doc["engines"]}
+            assert "expB" not in names
+            s, body, hdrs = post_query(router.port, {"q": "after"})
+            assert (s, body["tag"]) == (200, "good0")
+            assert "x-pio-variant" not in hdrs
+
+            # the scrape contract: state gauge + conversion counters
+            text = get_metrics(router.port)
+            assert 'pio_experiment_state{' in text
+            assert ('pio_experiment_conversions_total{experiment="exp",'
+                    'variant="expA"} 5' in text)
+            assert "pio_eval_points_total" in text
+        finally:
+            router.stop()
+            for s in (good, bad, base):
+                s.stop()
+
+
+class TestPromotionSurvivesWorkers:
+    def test_spool_carries_verdict_to_sibling_and_respawn(self):
+        """A promotion decided in ONE worker reaches its sibling's sync
+        loop AND a freshly respawned worker — gateway default included
+        (the decision must not evaporate with the process that took it)."""
+        good = echo_server("good0")
+        base = echo_server("base0")
+        spool = tempfile.mkdtemp(prefix="pio-test-experiment-")
+
+        def mk():
+            return RouterServer(RouterConfig(
+                ip="127.0.0.1", port=0,
+                engines=(
+                    EngineSpec(name="base",
+                               backends=(f"127.0.0.1:{base.port}",)),
+                    EngineSpec(name="expA",
+                               backends=(f"127.0.0.1:{good.port}",)),
+                ),
+                default_engine="base", worker_spool_dir=spool,
+                probe_interval_s=0.25, admin_sync_interval_s=0.1))
+
+        w1 = mk()
+        w2 = mk()
+        w1.start()
+        w2.start()
+        w3 = None
+        try:
+            wait_until(lambda: post_query(w1.port, {"q": 0})[0] == 200,
+                       timeout=10.0, message="fleet is up")
+            status, _ = experiments_post(w1.port, {
+                "action": "define",
+                "experiment": _experiment_doc(measureS=0.0, minRequests=1),
+                "variants": [{"name": "expA", "weightPct": 100}]})
+            assert status == 200
+
+            def w1_promoted():
+                s, _, _ = post_query(w1.port, {"q": "x"})
+                snap = _snapshot(w1.port)
+                return snap is not None and snap["state"] == "PROMOTED"
+            wait_until(w1_promoted, timeout=15.0,
+                       message="w1 promoted the lone healthy variant")
+
+            def sibling_adopted():
+                snap = _snapshot(w2.port)
+                return (snap is not None
+                        and snap["state"] == "PROMOTED"
+                        and w2.gateway.default_engine == "expA")
+            wait_until(sibling_adopted, timeout=10.0,
+                       message="sibling adopted the promotion")
+
+            # a respawned worker boots with the verdict AND the
+            # promoted gateway table
+            w3 = mk()
+            w3.start()
+            snap = _snapshot(w3.port)
+            assert snap["state"] == "PROMOTED"
+            assert snap["decision"]["winner"] == "expA"
+            assert w3.gateway.default_engine == "expA"
+            s, body, _ = post_query(w3.port, {"q": "respawn"})
+            assert (s, body["tag"]) == (200, "good0")
+        finally:
+            for w in (w1, w2, w3):
+                if w is not None:
+                    w.stop()
+            good.stop()
+            base.stop()
+            shutil.rmtree(spool, ignore_errors=True)
+
+
+class TestConversionSweep:
+    def test_event_store_sweep_counts_attributed_non_predict(self, storage):
+        events = storage.get_events()
+
+        def put(event, props, app_id=1):
+            events.insert(Event(event=event, entity_type="user",
+                                entity_id="u1",
+                                properties=DataMap(props)), app_id)
+
+        put("buy", {"experimentId": "exp", "variantId": "expA"})
+        put("buy", {"experimentId": "exp", "variantId": "expA"})
+        put("click", {"experimentId": "exp", "variantId": "expB"})
+        # excluded: the server's own feedback events, foreign
+        # experiments, unattributed events, other apps
+        put("predict", {"experimentId": "exp", "variantId": "expA"})
+        put("buy", {"experimentId": "other", "variantId": "expA"})
+        put("buy", {})
+        put("buy", {"experimentId": "exp", "variantId": "expA"}, app_id=2)
+
+        assert sweep_conversions(storage, 1, "exp") \
+            == {"expA": 2, "expB": 1}
+        assert sweep_conversions(storage, 2, "exp") == {"expA": 1}
+        assert sweep_conversions(storage, 3, "exp") == {}

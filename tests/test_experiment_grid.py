@@ -1,0 +1,256 @@
+"""Parallel grid eval (experiment/grid.py + workflow/evaluation.py).
+
+The contracts pinned here (ISSUE 20 / docs/experimentation.md):
+
+- per-point fault isolation: one crashed eval worker = one FAILED
+  point, never a dead grid; only an all-failed grid raises;
+- deterministic assembly: results land under ONE evaluation-instance
+  id in grid-index order regardless of completion order;
+- partial results readable mid-run (status EVALUATING, a
+  ``gridDone``/``points`` ledger in ``evaluator_results_json``);
+- the `pio eval` bugfix: an evaluator crash persists FAILED instead of
+  stranding the instance at INIT forever;
+- noSave stays honored on the --parallel path;
+- ``--parallel`` beats ``PIO_EVAL_PARALLEL`` beats sequential;
+- ``pio_eval_points_total{status}`` counts both outcomes.
+
+The poison pill is an UNKNOWN ALGORITHM name: ``DSParams(fail=True)``
+only trips ``read_training``, which ``batch_eval`` never calls — an
+unresolvable component is the honest way to kill an eval child.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    SumMetric,
+)
+from predictionio_tpu.experiment.grid import (
+    COMPLETED,
+    FAILED,
+    eval_points_collector,
+    result_from_points,
+    run_parallel_grid,
+)
+from predictionio_tpu.workflow.context import EngineContext
+from predictionio_tpu.workflow.evaluation import (
+    resolve_parallel,
+    run_evaluation,
+)
+from predictionio_tpu.workflow.fake import FakeEngineParamsGenerator, FakeRun
+
+from tests.sample_engine import AlgoParams, DSParams, make_engine
+
+pytestmark = pytest.mark.experiment
+
+
+class PredictionValueMetric(AverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(p.value)
+
+
+class SumValueMetric(SumMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(a)
+
+
+def _point(mult: int) -> EngineParams:
+    return EngineParams.of(
+        data_source=DSParams(id=1, n_train=4, n_folds=2),
+        algorithms=[("sample", AlgoParams(id=0, mult=mult))],
+    )
+
+
+def _poison() -> EngineParams:
+    """A grid point whose eval child dies: the engine has no component
+    named 'missing', so batch_eval raises inside the fork."""
+    return EngineParams.of(
+        data_source=DSParams(id=9, n_train=4, n_folds=2),
+        algorithms=[("missing", AlgoParams(id=0, mult=5))],
+    )
+
+
+class SampleEvaluation(Evaluation):
+    def __init__(self, engine, output_path=None):
+        super().__init__()
+        self.engine_evaluator = (
+            engine,
+            MetricEvaluator(PredictionValueMetric(), [SumValueMetric()],
+                            output_path=output_path),
+        )
+
+
+def _run_grid(params_list, parallel=2, on_point=None):
+    engine = make_engine()
+    evaluation = SampleEvaluation(engine)
+    evaluator = evaluation.evaluator
+    points = run_parallel_grid(evaluation, evaluator, params_list,
+                               EngineContext(), parallel,
+                               on_point=on_point)
+    return evaluator, points
+
+
+class TestRunParallelGrid:
+    def test_scores_match_sequential_in_grid_order(self):
+        params = [_point(1), _point(3), _point(2)]
+        evaluator, points = _run_grid(params, parallel=2)
+
+        assert [p.idx for p in points] == [0, 1, 2]
+        assert all(p.status == COMPLETED for p in points)
+        # mean over 3 eval queries of q.x * mult, x in 0..2 → mult
+        assert [p.score for p in points] == pytest.approx([1.0, 3.0, 2.0])
+
+        result = result_from_points(evaluator, params, points)
+        assert result.best_idx == 1
+        assert result.best_score.score == pytest.approx(3.0)
+        assert len(result.engine_params_scores) == 3
+
+    def test_one_crashed_point_never_kills_the_grid(self):
+        params = [_point(1), _poison(), _point(2)]
+        evaluator, points = _run_grid(params, parallel=3)
+
+        assert [p.status for p in points] == [COMPLETED, FAILED, COMPLETED]
+        assert points[1].score is None
+        assert "exited with code" in points[1].error
+
+        result = result_from_points(evaluator, params, points)
+        # best compares survivors only; the failed slot keeps its
+        # index so downstream grid positions line up
+        assert result.best_idx == 2
+        assert result.engine_params_scores[1][1].score is None
+
+    def test_all_points_failed_raises(self):
+        params = [_poison(), _poison()]
+        evaluator, points = _run_grid(params, parallel=2)
+        assert all(p.status == FAILED for p in points)
+        with pytest.raises(RuntimeError, match="every grid point failed"):
+            result_from_points(evaluator, params, points)
+
+    def test_points_total_counts_both_outcomes(self):
+        before = {tuple(sorted(labels.items())): value
+                  for labels, value in eval_points_collector()[0].samples}
+        _run_grid([_point(1), _poison()], parallel=2)
+        after = {tuple(sorted(labels.items())): value
+                 for labels, value in eval_points_collector()[0].samples}
+
+        def delta(status):
+            # the Prometheus label value is lowercased
+            key = (("status", status.lower()),)
+            return after.get(key, 0) - before.get(key, 0)
+
+        assert delta(COMPLETED) == 1
+        assert delta(FAILED) == 1
+
+
+class TestRunEvaluationParallel:
+    def test_one_instance_deterministic_order(self, storage):
+        engine = make_engine()
+        outcome = run_evaluation(
+            SampleEvaluation(engine),
+            EngineParamsGenerator([_point(2), _point(1), _point(3)]),
+            storage=storage, parallel=3)
+
+        assert outcome.status == "EVALCOMPLETED"
+        instances = storage.get_meta_data_evaluation_instances()
+        assert len(instances.get_all()) == 1
+        doc = json.loads(instances.get(outcome.instance_id)
+                         .evaluator_results_json)
+        assert doc["bestIdx"] == 2
+        # the per-point ledger rides the final doc, in grid order
+        assert [p["idx"] for p in doc["points"]] == [0, 1, 2]
+        assert [p["status"] for p in doc["points"]] == [COMPLETED] * 3
+
+    def test_partial_results_readable_mid_run(self, storage, monkeypatch):
+        """Every streamed update is a valid, growing grid ledger under
+        EVALUATING — what a dashboard polling the instance row sees."""
+        instances = storage.get_meta_data_evaluation_instances()
+        seen = []
+        real_update = instances.update
+
+        def spy(instance):
+            seen.append((instance.status, instance.evaluator_results_json))
+            real_update(instance)
+
+        monkeypatch.setattr(instances, "update", spy)
+        outcome = run_evaluation(
+            SampleEvaluation(make_engine()),
+            EngineParamsGenerator([_point(1), _point(2)]),
+            storage=storage, parallel=2)
+
+        partials = [json.loads(js) for status, js in seen
+                    if status == "EVALUATING" and js]
+        assert len(partials) == 2
+        assert [p["gridDone"] for p in partials] == [1, 2]
+        assert all(p["gridTotal"] == 2 for p in partials)
+        # mid-run, at least one snapshot shows an incomplete grid
+        assert partials[0]["gridDone"] < partials[0]["gridTotal"]
+        assert seen[-1][0] == "EVALCOMPLETED"
+        assert outcome.status == "EVALCOMPLETED"
+
+    def test_crashed_point_is_failed_in_final_doc(self, storage):
+        outcome = run_evaluation(
+            SampleEvaluation(make_engine()),
+            EngineParamsGenerator([_point(1), _poison()]),
+            storage=storage, parallel=2)
+        doc = json.loads(storage.get_meta_data_evaluation_instances()
+                         .get(outcome.instance_id).evaluator_results_json)
+        assert doc["bestIdx"] == 0
+        assert doc["points"][1]["status"] == FAILED
+        assert "error" in doc["points"][1]
+
+    def test_nosave_honored_with_parallel_flag(self, storage):
+        # FakeRun is not a MetricEvaluator grid: --parallel warns and
+        # falls back sequential, and noSave still leaves the row INIT
+        outcome = run_evaluation(FakeRun(lambda ctx: None),
+                                 FakeEngineParamsGenerator(),
+                                 storage=storage, parallel=4)
+        assert outcome.status == "NOSAVE"
+        inst = storage.get_meta_data_evaluation_instances().get(
+            outcome.instance_id)
+        assert inst.status == "INIT"
+
+
+class TestFailedInstancePersistence:
+    """The `pio eval` bugfix: the seed stranded a crashed run at INIT
+    forever; a raising evaluator must persist FAILED (and still raise)."""
+
+    def test_sequential_crash_persists_failed(self, storage):
+        with pytest.raises(ValueError, match="missing"):
+            run_evaluation(SampleEvaluation(make_engine()),
+                           EngineParamsGenerator([_poison()]),
+                           storage=storage)
+        insts = storage.get_meta_data_evaluation_instances().get_all()
+        assert len(insts) == 1
+        assert insts[0].status == "FAILED"
+        assert "ValueError" in insts[0].evaluator_results
+
+    def test_all_failed_parallel_grid_persists_failed(self, storage):
+        with pytest.raises(RuntimeError, match="every grid point failed"):
+            run_evaluation(SampleEvaluation(make_engine()),
+                           EngineParamsGenerator([_poison(), _poison()]),
+                           storage=storage, parallel=2)
+        insts = storage.get_meta_data_evaluation_instances().get_all()
+        assert insts[0].status == "FAILED"
+        assert "RuntimeError" in insts[0].evaluator_results
+
+
+class TestResolveParallel:
+    def test_flag_beats_env_beats_default(self, monkeypatch):
+        monkeypatch.delenv("PIO_EVAL_PARALLEL", raising=False)
+        assert resolve_parallel(None) == 1
+        assert resolve_parallel(3) == 3
+        monkeypatch.setenv("PIO_EVAL_PARALLEL", "4")
+        assert resolve_parallel(None) == 4
+        assert resolve_parallel(2) == 2
+
+    def test_garbage_env_falls_back_sequential(self, monkeypatch):
+        monkeypatch.setenv("PIO_EVAL_PARALLEL", "lots")
+        assert resolve_parallel(None) == 1
